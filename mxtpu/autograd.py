@@ -1,0 +1,355 @@
+"""Autograd — imperative tape-based differentiation.
+
+Reference: ``python/mxnet/autograd.py``† (record/pause scopes, backward,
+grad, Function) over ``src/imperative/imperative.cc``† (tape recording,
+``Imperative::Backward`` building and executing the gradient graph).
+
+TPU-native: each recorded eager op is invoked through ``jax.vjp`` so the
+tape stores a ready-made cotangent closure (XLA-compiled on first call);
+``backward`` is a reverse topological sweep accumulating cotangents into
+``attach_grad``-marked leaves.  Hybridized blocks record ONE tape node for
+their whole cached graph, so a hybridized forward+backward is two XLA
+executables, not per-op dispatch (the reference gets the same effect from
+``CachedOp::Backward``, ``src/imperative/cached_op.cc``†).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "backward", "grad", "mark_variables", "Function",
+           "set_recording", "set_training"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(is_rec: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, is_rec
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev, _STATE.training = _STATE.training, train
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ======================================================================
+# tape
+# ======================================================================
+class TapeNode:
+    """One recorded computation: vjp closure + wiring.
+
+    parents[i] describes where input i came from:
+      ("node", TapeNode, out_idx) | ("leaf", NDArray) | None (constant)
+    """
+    __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "out_grads",
+                 "out_avals", "_visited")
+
+    def __init__(self, name, vjp_fn, parents, n_outputs, out_avals=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents
+        self.n_outputs = n_outputs
+        self.out_grads: List[Optional[Any]] = [None] * n_outputs
+        self.out_avals = out_avals or [None] * n_outputs
+        self._visited = False
+
+
+def _needs_grad(x) -> bool:
+    from .ndarray.ndarray import NDArray
+    return isinstance(x, NDArray) and (
+        x._grad_req != "null" or x._tape is not None)
+
+
+def record_op(name: str, fn: Callable, inputs: Sequence[Any],
+              arrays: Sequence[Any]) -> Any:
+    """Run fn through jax.vjp and put a node on the implicit tape.
+
+    Returns the raw output (array or tuple)."""
+    from .ndarray.ndarray import NDArray
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    parents: List[Optional[Tuple]] = []
+    for x in inputs:
+        if isinstance(x, NDArray) and x._tape is not None:
+            parents.append(("node",) + x._tape)
+        elif isinstance(x, NDArray) and x._grad_req != "null":
+            parents.append(("leaf", x))
+        else:
+            parents.append(None)
+    outs_t = out if isinstance(out, tuple) else (out,)
+    avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_t]
+    node = TapeNode(name, vjp_fn, parents, len(outs_t), avals)
+    return out, node
+
+
+def attach_output(nd, node: TapeNode, idx: int) -> None:
+    nd._tape = (node, idx)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Reference API parity (autograd.mark_variables†)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v.grad = g
+
+
+# ======================================================================
+# backward
+# ======================================================================
+def _toposort(roots: List[TapeNode]) -> List[TapeNode]:
+    order: List[TapeNode] = []
+    seen = set()
+
+    def visit(n: TapeNode):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for p in n.parents:
+            if p is not None and p[0] == "node":
+                visit(p[1])
+        order.append(n)
+
+    for r in roots:
+        visit(r)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True) -> None:
+    """Compute gradients of heads w.r.t. all attach_grad leaves reachable
+    on the tape (reference MXAutogradBackwardEx†)."""
+    from .ndarray.ndarray import NDArray
+
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    else:
+        head_grads = [head_grads] if isinstance(head_grads, NDArray) \
+            else list(head_grads)
+
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        if h._tape is None:
+            continue
+        node, idx = h._tape
+        seed = jnp.ones_like(h.data) if hg is None else jnp.asarray(
+            hg.data if isinstance(hg, NDArray) else hg)
+        if node.out_grads[idx] is None:
+            node.out_grads[idx] = seed
+        else:
+            node.out_grads[idx] = node.out_grads[idx] + seed
+        roots.append(node)
+    if not roots:
+        raise MXNetError(
+            "backward called on arrays not produced under autograd.record "
+            "with gradients attached")
+
+    order = _toposort(roots)
+    leaf_grads: dict = {}   # id(leaf NDArray) -> (leaf, accumulated grad)
+    for node in reversed(order):
+        if all(g is None for g in node.out_grads):
+            continue
+        cotangents = []
+        # vjp_fn wants cotangents matching the primal output structure
+        primal_struct_multi = node.n_outputs > 1
+        for i in range(node.n_outputs):
+            g = node.out_grads[i]
+            cotangents.append(g)
+        # fill missing cotangents with zeros of the right aval
+        # (vjp output avals are recoverable from stored seeds only; use
+        #  lazy zeros via the vjp function's expected structure)
+        if primal_struct_multi:
+            ct = tuple(
+                c if c is not None else jnp.zeros(
+                    node.out_avals[i].shape, node.out_avals[i].dtype)
+                for i, c in enumerate(cotangents))
+            in_grads = node.vjp_fn(ct)
+        else:
+            in_grads = node.vjp_fn(cotangents[0])
+        for parent, ig in zip(node.parents, in_grads):
+            if parent is None or ig is None:
+                continue
+            if _is_float0(ig):
+                continue
+            if parent[0] == "node":
+                _, pnode, pidx = parent
+                if pnode.out_grads[pidx] is None:
+                    pnode.out_grads[pidx] = ig
+                else:
+                    pnode.out_grads[pidx] = pnode.out_grads[pidx] + ig
+            else:
+                leaf = parent[1]
+                k = id(leaf)
+                if k in leaf_grads:
+                    leaf_grads[k] = (leaf, leaf_grads[k][1] + ig)
+                else:
+                    leaf_grads[k] = (leaf, ig)
+        # out_grads are per-backward-call scratch: clear even when the
+        # graph is retained, else a second backward accumulates stale
+        # cotangents on top of fresh seeds.
+        node.out_grads = [None] * node.n_outputs
+
+    for leaf, g in leaf_grads.values():
+        if leaf._grad_req == "add" and leaf.grad is not None:
+            leaf.grad._data = leaf.grad._data + g
+        elif leaf.grad is None:
+            leaf.grad = NDArray(g, None, _placed=True)
+        else:
+            leaf.grad._data = g
+
+
+def _is_float0(x) -> bool:
+    return hasattr(x, "dtype") and x.dtype == jax.dtypes.float0
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching .grad
+    (reference ``autograd.grad``†).  create_graph (higher-order) is
+    supported through jax by re-recording — round-2 follow-up."""
+    from .ndarray.ndarray import NDArray
+    if create_graph:
+        raise MXNetError("create_graph=True not yet supported")
+    variables = [variables] if isinstance(variables, NDArray) \
+        else list(variables)
+    saved = [(v._grad_req, v.grad) for v in variables]
+    for v in variables:
+        if v._grad_req == "null":
+            v._grad_req = "write"
+        v.grad = None
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+        outs = []
+        for v in variables:
+            if v.grad is None:
+                raise MXNetError("some variables are unreachable from heads")
+            outs.append(v.grad)
+    finally:
+        # restore both pieces of caller-visible state — this API must
+        # not touch .grad
+        for v, (req, g) in zip(variables, saved):
+            v._grad_req = req
+            v.grad = g
+    return outs[0] if len(outs) == 1 else outs
+
+
+# ======================================================================
+# custom differentiable Function (reference autograd.Function† /
+# src/c_api/c_api_function.cc†)
+# ======================================================================
+class Function:
+    """User-defined differentiable op.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` using nd ops.  Gradients flow
+    through the user backward, not jax AD."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outs = self.forward(*inputs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        if is_recording() and any(_needs_grad(x) for x in inputs):
+            fn_self = self
+
+            def _vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) \
+                    else (cotangents,)
+                with pause():
+                    gin = fn_self.backward(
+                        *[NDArray(c, None, _placed=True) for c in cts])
+                gin_t = (gin,) if isinstance(gin, NDArray) else tuple(gin)
+                return tuple(g.data if isinstance(g, NDArray) else g
+                             for g in gin_t)
+
+            parents = []
+            for x in inputs:
+                if isinstance(x, NDArray) and x._tape is not None:
+                    parents.append(("node",) + x._tape)
+                elif isinstance(x, NDArray) and x._grad_req != "null":
+                    parents.append(("leaf", x))
+                else:
+                    parents.append(None)
+            avals = [jax.ShapeDtypeStruct(o.shape, o.data.dtype)
+                     for o in outs_t]
+            node = TapeNode(type(self).__name__, _vjp_fn, parents,
+                            len(outs_t), avals)
+            for i, o in enumerate(outs_t):
+                attach_output(o, node, i)
+        return outs if not single else outs_t[0]

@@ -1,0 +1,159 @@
+"""Base utilities: errors, env-flag config, generic registry.
+
+TPU-native re-design of the roles played by dmlc-core in the reference
+(``3rdparty/dmlc-core/``†: logging/CHECK, ``dmlc::GetEnv`` env-var config
+catalogued in ``docs/faq/env_var.md``†, and ``DMLC_REGISTRY_*`` generic
+registries).  († = canonical upstream Apache MXNet v1.x path, cited per
+SURVEY.md convention — the reference mount was empty this round.)
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+__all__ = [
+    "MXNetError",
+    "check_call",
+    "get_env",
+    "env_flags",
+    "Registry",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (parity with ``mxnet.base.MXNetError``,
+    ``python/mxnet/base.py``†). There is no C ABI error TLS here; Python
+    exceptions propagate directly, including asynchronous XLA errors
+    re-raised at sync points (see ndarray.NDArray.wait_to_read)."""
+
+
+def check_call(ret: int) -> None:
+    """Compat shim for code written against the reference's ctypes protocol
+    (``python/mxnet/base.py``† ``check_call``)."""
+    if ret != 0:
+        raise MXNetError("non-zero return code %d" % ret)
+
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+def get_env(name: str, default: Any = None, dtype: type = str) -> Any:
+    """``dmlc::GetEnv`` equivalent. Accepts both the new ``MXTPU_*`` and the
+    reference's ``MXNET_*`` spelling (``MXNET_`` is consulted as a fallback
+    so reference-era scripts keep working)."""
+    val = os.environ.get(name)
+    if val is None and name.startswith("MXTPU_"):
+        val = os.environ.get("MXNET_" + name[len("MXTPU_"):])
+    if val is None:
+        return default
+    if dtype is bool:
+        low = val.strip().lower()
+        if low in _TRUTHY:
+            return True
+        if low in _FALSY:
+            return False
+        raise MXNetError(f"invalid boolean env value {name}={val!r}")
+    return dtype(val)
+
+
+class _EnvFlags:
+    """Central catalogue of runtime flags (role of ``docs/faq/env_var.md``†).
+
+    Each flag is read lazily so tests can monkeypatch os.environ."""
+
+    @property
+    def engine_type(self) -> str:
+        # MXNET_ENGINE_TYPE=NaiveEngine forces synchronous execution for
+        # debugging (reference: src/engine/engine.cc† engine selection).
+        return get_env("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+    @property
+    def synchronous(self) -> bool:
+        return self.engine_type == "NaiveEngine"
+
+    @property
+    def exec_bulk(self) -> bool:
+        return get_env("MXTPU_EXEC_BULK_EXEC_TRAIN", True, bool)
+
+    @property
+    def profiler_autostart(self) -> bool:
+        return get_env("MXTPU_PROFILER_AUTOSTART", False, bool)
+
+    @property
+    def test_seed(self) -> Optional[int]:
+        v = get_env("MXTPU_TEST_SEED", None)
+        return None if v is None else int(v)
+
+    @property
+    def kvstore_bigarray_bound(self) -> int:
+        return get_env("MXTPU_KVSTORE_BIGARRAY_BOUND", 1 << 20, int)
+
+    @property
+    def default_dtype(self) -> str:
+        return get_env("MXTPU_DEFAULT_DTYPE", "float32")
+
+
+env_flags = _EnvFlags()
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Generic name->entry registry (role of ``DMLC_REGISTRY_*``†).
+
+    Used for ops, optimizers, metrics, initializers, data iterators and
+    KVStore types, mirroring how the reference registers each of those
+    through dmlc registries (e.g. ``MXNET_REGISTER_IO_ITER``†,
+    ``NNVM_REGISTER_OP``†)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, T] = {}
+        self._lower: Dict[str, T] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: Optional[str] = None, *, aliases: tuple = (),
+                 allow_override: bool = False) -> Callable[[T], T]:
+        def _do(entry: T) -> T:
+            key = name or getattr(entry, "__name__", None)
+            if key is None:
+                raise MXNetError(f"cannot infer registry name for {entry!r}")
+            keys = []
+            for k in (key,) + tuple(aliases):
+                if k not in keys:
+                    keys.append(k)
+            with self._lock:
+                for k in keys:
+                    if k in self._entries and not allow_override:
+                        raise MXNetError(
+                            f"{self.kind} '{k}' already registered")
+                    self._entries[k] = entry
+                    self._lower.setdefault(k.lower(), entry)
+            return entry
+        return _do
+
+    def get(self, name: str) -> T:
+        e = self._entries.get(name) or self._lower.get(name.lower())
+        if e is None:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'. known: "
+                f"{sorted(self._entries)[:40]}")
+        return e
+
+    def find(self, name: str) -> Optional[T]:
+        return self._entries.get(name) or self._lower.get(name.lower())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries or name.lower() in self._lower
+
+    def list(self) -> List[str]:
+        return sorted(self._entries)

@@ -1,0 +1,139 @@
+"""Device context — the thing ``mx.tpu()`` extends.
+
+Reference: ``python/mxnet/context.py``† (``mx.cpu()/mx.gpu()``, Context
+stack with ``with ctx:`` scoping) and ``include/mxnet/base.h``† Context.
+TPU-native: a Context names a jax.Device; ``tpu`` is first-class, ``gpu``
+is an alias for whatever accelerator backend jax exposes so reference-era
+scripts (`ctx=mx.gpu(0)`) run unchanged on a TPU machine.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "cpu_shared",
+           "current_context", "num_gpus", "num_tpus", "device"]
+
+
+class Context:
+    """A device context. devtype in {'cpu','tpu','gpu','cpu_pinned',
+    'cpu_shared'}; 'gpu' and the host-memory flavours map onto the jax
+    backends present on the machine (on TPU hosts, gpu→tpu so reference
+    scripts run unmodified; cpu_pinned/cpu_shared→cpu: XLA manages pinned
+    staging buffers itself)."""
+
+    _stack = threading.local()
+
+    devtype2id = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5,
+                  "tpu": 6}
+    devid2type = {v: k for k, v in devtype2id.items()}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devtype2id:
+            raise MXNetError(f"unknown device type {device_type}")
+        self.device_type = device_type
+        self.device_id = device_id
+
+    # -- jax mapping ---------------------------------------------------
+    @property
+    def _backend(self) -> str:
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            return "cpu"
+        # 'gpu' and 'tpu' both resolve to the accelerator backend; on a
+        # TPU host jax.default_backend() is 'tpu'.
+        return jax.default_backend() if jax.default_backend() != "cpu" else "cpu"
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = jax.devices(self._backend)
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                f"{self} out of range: only {len(devs)} "
+                f"{self._backend} device(s) visible")
+        return devs[self.device_id]
+
+    # -- context stack -------------------------------------------------
+    def __enter__(self) -> "Context":
+        if not hasattr(Context._stack, "ctxs"):
+            Context._stack.ctxs = []
+        Context._stack.ctxs.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        Context._stack.ctxs.pop()
+
+    # -- value semantics -----------------------------------------------
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self) -> str:
+        return repr(self)
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        ctxs = getattr(cls._stack, "ctxs", None)
+        if ctxs:
+            return ctxs[-1]
+        return _default_context()
+
+
+def _default_context() -> Context:
+    # Default to the accelerator if present (the reference defaults to
+    # cpu; a TPU framework defaults to the chip, matching user intent of
+    # `mx.tpu()` in BASELINE.json's north star).
+    if jax.default_backend() != "cpu":
+        return Context("tpu", 0)
+    return Context("cpu", 0)
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def cpu_shared(device_id: int = 0) -> Context:
+    return Context("cpu_shared", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def device(dev: jax.Device) -> Context:
+    """Wrap a raw jax.Device in a Context."""
+    kind = "cpu" if dev.platform == "cpu" else "tpu"
+    return Context(kind, dev.id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_gpus() -> int:
+    """Reference API ``mx.context.num_gpus()``†; counts accelerators."""
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    if jax.default_backend() == "cpu":
+        return 0
+    return len(jax.devices())

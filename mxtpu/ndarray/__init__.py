@@ -1,0 +1,141 @@
+"""``mxtpu.nd`` — the eager NDArray op namespace.
+
+Reference: ``python/mxnet/ndarray/``† where op wrappers are *generated*
+from the C registry at import time.  Here the same generation happens from
+the Python op registry: every registered op becomes a module-level function
+taking/returning NDArray, routed through the autograd tape when recording.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import OP_REGISTRY, get_op, list_ops
+from . import ops_impl  # noqa: F401  (populates the registry)
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concat, stack, save, load, waitall, from_numpy,
+                      linspace, eye, zeros_like as _zeros_like_fn)
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "stack", "save", "load", "waitall", "from_numpy",
+           "linspace", "eye", "random", "sparse", "linalg", "contrib"]
+
+
+def _invoke_op(name: str, *inputs, **kwargs):
+    """Eager dispatch — the role of ``MXImperativeInvokeEx``
+    (``src/c_api/c_api_ndarray.cc``† → ``Imperative::Invoke``†).
+    jax's dispatch cache plays the part of the engine's async push."""
+    op = get_op(name)
+    arrays = []
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            arrays.append(x._data)
+            if ctx is None:
+                ctx = x._ctx
+        else:
+            arrays.append(jnp.asarray(x))
+    resolved = op.resolve_params(kwargs)
+
+    from .. import autograd
+    if (autograd.is_recording() and op.differentiable
+            and any(autograd._needs_grad(x) for x in inputs)):
+        fn = lambda *arrs: op.fn(*arrs, **resolved)  # noqa: E731
+        out, node = autograd.record_op(name, fn, inputs, arrays)
+        if isinstance(out, tuple):
+            wrapped = tuple(NDArray(o, ctx, _placed=True) for o in out)
+            for i, w in enumerate(wrapped):
+                autograd.attach_output(w, node, i)
+            return wrapped
+        w = NDArray(out, ctx, _placed=True)
+        autograd.attach_output(w, node, 0)
+        return w
+
+    out = op.fn(*arrays, **resolved)
+    if isinstance(out, tuple):
+        return tuple(NDArray(o, ctx, _placed=True) for o in out)
+    return NDArray(out, ctx, _placed=True)
+
+
+def _invoke_getitem(nd: NDArray, key):
+    """Basic + advanced indexing, differentiable w.r.t. the data."""
+    def norm(k):
+        if isinstance(k, NDArray):
+            return k._data if k._data.dtype != jnp.float32 \
+                else k._data.astype(jnp.int32)
+        if isinstance(k, tuple):
+            return tuple(norm(e) for e in k)
+        return k
+    jkey = norm(key)
+
+    from .. import autograd
+    if autograd.is_recording() and autograd._needs_grad(nd):
+        fn = lambda d: d[jkey]  # noqa: E731
+        out, node = autograd.record_op("getitem", fn, (nd,), (nd._data,))
+        w = NDArray(out, nd._ctx, _placed=True)
+        autograd.attach_output(w, node, 0)
+        return w
+    return NDArray(nd._data[jkey], nd._ctx, _placed=True)
+
+
+# ----------------------------------------------------------------------
+# generate the namespace from the registry
+# ----------------------------------------------------------------------
+_THIS_MODULE = sys.modules[__name__]
+
+
+def _make_op_fn(opname: str):
+    op = get_op(opname)
+
+    def fn(*args, out=None, **kwargs):
+        res = _invoke_op(opname, *args, **kwargs)
+        if out is not None:
+            out._data = res._data if isinstance(res, NDArray) else res[0]._data
+            return out
+        return res
+    fn.__name__ = opname
+    fn.__qualname__ = opname
+    fn.__doc__ = op.doc
+    return fn
+
+
+_seen = set()
+for _op in list(OP_REGISTRY._entries.values()):
+    for _n in (_op.name,) + _op.aliases:
+        if _n not in _seen:
+            _seen.add(_n)
+            setattr(_THIS_MODULE, _n, _make_op_fn(_n))
+
+# Dropout convenience: auto key + mode from autograd training state
+_raw_dropout = getattr(_THIS_MODULE, "Dropout")
+
+
+def Dropout(data, p=0.5, mode=None, axes=()):  # noqa: N802
+    """Reference nn.Dropout op†; key drawn from the global RNG stream.
+    mode defaults to 'training' under autograd.record(train_mode=True)."""
+    from .. import autograd
+    from . import random as _rnd
+    if mode is None:
+        mode = "training" if autograd.is_training() else "always_off"
+    if mode == "always_off" or p <= 0.0:
+        return data if isinstance(data, NDArray) else array(data)
+    key = _rnd._next_key_nd()
+    return _raw_dropout(data, key, p=p, mode="training", axes=axes)
+
+
+setattr(_THIS_MODULE, "Dropout", Dropout)
+setattr(_THIS_MODULE, "dropout", Dropout)
+
+zeros_like = getattr(_THIS_MODULE, "zeros_like")
+ones_like = getattr(_THIS_MODULE, "ones_like")
+
+from . import random    # noqa: E402
+from . import sparse    # noqa: E402
+from . import linalg    # noqa: E402
+from . import contrib   # noqa: E402

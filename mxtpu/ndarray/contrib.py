@@ -1,0 +1,219 @@
+"""``mx.nd.contrib`` namespace — control flow + experimental ops.
+
+Reference: ``python/mxnet/ndarray/contrib.py``† (foreach / while_loop /
+cond arrived around v1.3, ``src/operator/control_flow.cc``†), plus
+contrib ops in ``src/operator/contrib/``†.
+
+TPU-native: control flow maps directly onto ``lax.scan`` / ``lax
+.while_loop`` / ``lax.cond`` — compiler-friendly structured control flow
+is exactly what the reference was reaching for.  Detection-family ops
+(box_nms / multibox) live here too with padded static-shape contracts
+(SURVEY.md §7 M7).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _wrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda a: NDArray(a, None, _placed=True), t)
+
+
+def foreach(body: Callable, data, init_states):
+    """``mx.nd.contrib.foreach``† — scan body over the leading axis.
+
+    body(data_slice, states) -> (outputs, new_states)
+    """
+    data_r = jax.tree_util.tree_map(_unwrap, data)
+    states_r = jax.tree_util.tree_map(_unwrap, init_states)
+
+    def step(carry, x):
+        xs = _wrap_tree(x)
+        cs = _wrap_tree(carry)
+        out, new_states = body(xs, cs)
+        return (jax.tree_util.tree_map(_unwrap, new_states),
+                jax.tree_util.tree_map(_unwrap, out))
+
+    final, outs = lax.scan(step, states_r, data_r)
+    return _wrap_tree(outs), _wrap_tree(final)
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """``mx.nd.contrib.while_loop``†.  Static max_iterations bound keeps
+    shapes XLA-compatible; outputs are padded to max_iterations."""
+    vars_r = [_unwrap(v) for v in loop_vars]
+
+    def c(state):
+        i, vs = state
+        w = [NDArray(v, None, _placed=True) for v in vs]
+        keep = cond(*w)
+        keep = _unwrap(keep).astype(bool).reshape(())
+        return jnp.logical_and(i < max_iterations, keep)
+
+    def b(state):
+        i, vs = state
+        w = [NDArray(v, None, _placed=True) for v in vs]
+        _, new_vars = func(*w)
+        return (i + 1, [_unwrap(v) for v in new_vars])
+
+    # note: we drop per-step stacked outputs (rarely used); loop vars
+    # carry the result.  Parity gap documented.
+    i, out_vars = lax.while_loop(c, b, (jnp.asarray(0), vars_r))
+    return ([], [NDArray(v, None, _placed=True) for v in out_vars])
+
+
+def cond(pred: Callable, then_func: Callable, else_func: Callable):
+    """``mx.nd.contrib.cond``†."""
+    p = pred() if callable(pred) else pred
+    p = _unwrap(p).astype(bool).reshape(())
+    t = lambda _: jax.tree_util.tree_map(  # noqa: E731
+        _unwrap, then_func())
+    f = lambda _: jax.tree_util.tree_map(  # noqa: E731
+        _unwrap, else_func())
+    out = lax.cond(p, t, f, None)
+    return _wrap_tree(out)
+
+
+# ----------------------------------------------------------------------
+# detection ops — padded static-shape NMS family
+# ----------------------------------------------------------------------
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (reference ``contrib.box_iou``†)."""
+    a = _unwrap(lhs)
+    b = _unwrap(rhs)
+    if format == "center":
+        a = jnp.concatenate([a[..., :2] - a[..., 2:] / 2,
+                             a[..., :2] + a[..., 2:] / 2], -1)
+        b = jnp.concatenate([b[..., :2] - b[..., 2:] / 2,
+                             b[..., :2] + b[..., 2:] / 2], -1)
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[..., 2] - a[..., 0]) *
+                         (a[..., 3] - a[..., 1]), 0.0)
+    area_b = jnp.maximum((b[..., 2] - b[..., 0]) *
+                         (b[..., 3] - b[..., 1]), 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return NDArray(inter / jnp.maximum(union, 1e-12), None, _placed=True)
+
+
+def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk):
+    """Greedy NMS with static shapes: iterates topk times via fori_loop,
+    suppressing overlaps.  Returns keep mask — the padded-max-size
+    contract replacing the reference's dynamic-output NMS
+    (src/operator/contrib/bounding_box.cc†)."""
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    tl = jnp.maximum(boxes_s[:, None, :2], boxes_s[None, :, :2])
+    br = jnp.minimum(boxes_s[:, None, 2:], boxes_s[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = jnp.maximum((boxes_s[:, 2] - boxes_s[:, 0]) *
+                       (boxes_s[:, 3] - boxes_s[:, 1]), 0.0)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+
+    def body(i, keep):
+        # suppress j>i overlapping box i if i kept
+        sup = (iou[i] > iou_thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep0 = scores_s > valid_thresh
+    keep = lax.fori_loop(0, n if topk < 0 else min(topk, n), body, keep0)
+    inv = jnp.argsort(order)
+    return keep[inv], order
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """``contrib.box_nms``† with the padded contract: suppressed entries
+    are set to -1 instead of removed (static output shape)."""
+    d = _unwrap(data)
+    batched = d.ndim == 3
+    if not batched:
+        d = d[None]
+
+    def one(db):
+        scores = db[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(db, coord_start, 4, axis=1)
+        keep, order = _nms_single(scores, boxes, overlap_thresh,
+                                  valid_thresh, topk)
+        out = jnp.where(keep[:, None], db, -jnp.ones_like(db))
+        return out
+
+    out = jax.vmap(one)(d)
+    if not batched:
+        out = out[0]
+    return NDArray(out, None, _placed=True)
+
+
+def boolean_mask(data, index, axis=0):
+    """``contrib.boolean_mask``† — dynamic output in the reference; here
+    the padded contract: masked-out rows are zeroed and compacted to the
+    front, output keeps the input's static length."""
+    d = _unwrap(data)
+    m = _unwrap(index).astype(bool)
+    idx = jnp.argsort(~m)  # true rows first, stable
+    compacted = jnp.take(d, idx, axis=axis)
+    mask_sorted = jnp.sort(~m) == False  # noqa: E712
+    shape = [1] * d.ndim
+    shape[axis] = d.shape[axis]
+    return NDArray(
+        compacted * mask_sorted.reshape(shape).astype(d.dtype),
+        None, _placed=True)
+
+
+def getnnz(data, axis=None):
+    d = _unwrap(data)
+    return NDArray(jnp.asarray(
+        jnp.sum(d != 0) if axis is None else jnp.sum(d != 0, axis=axis)
+    ).astype(jnp.int64), None, _placed=True)
+
+
+def count_sketch(data, h, s, out_dim):
+    """``contrib.count_sketch``† — compact bilinear pooling primitive."""
+    d = _unwrap(data)
+    hh = _unwrap(h).astype(jnp.int32)
+    ss = _unwrap(s)
+    out = jnp.zeros(d.shape[:-1] + (out_dim,), d.dtype)
+    out = out.at[..., hh].add(d * ss)
+    return NDArray(out, None, _placed=True)
+
+
+def fft(data, compute_size=128):
+    d = _unwrap(data)
+    f = jnp.fft.fft(d, axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1).reshape(
+        d.shape[:-1] + (2 * d.shape[-1],))
+    return NDArray(out.astype(d.dtype), None, _placed=True)
+
+
+def ifft(data, compute_size=128):
+    d = _unwrap(data)
+    c = d.reshape(d.shape[:-1] + (d.shape[-1] // 2, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1).real * comp.shape[-1]
+    return NDArray(out.astype(d.dtype), None, _placed=True)
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """The reference's tutorial op (``src/operator/contrib/quadratic_op``†)."""
+    d = _unwrap(data)
+    return NDArray(a * d * d + b * d + c, None, _placed=True)

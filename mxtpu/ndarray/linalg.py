@@ -1,0 +1,45 @@
+"""``mx.nd.linalg`` namespace (reference ``python/mxnet/ndarray/linalg.py``†
+over ``src/operator/tensor/la_op.cc``†)."""
+from __future__ import annotations
+
+from . import _invoke_op
+
+
+def gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+         beta=1.0):
+    return _invoke_op("linalg_gemm", a, b, c, transpose_a=transpose_a,
+                      transpose_b=transpose_b, alpha=alpha, beta=beta)
+
+
+def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    return _invoke_op("linalg_gemm2", a, b, transpose_a=transpose_a,
+                      transpose_b=transpose_b, alpha=alpha)
+
+
+def potrf(a):
+    return _invoke_op("linalg_potrf", a)
+
+
+def trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    return _invoke_op("linalg_trsm", a, b, transpose=transpose,
+                      rightside=rightside, lower=lower, alpha=alpha)
+
+
+def syrk(a, transpose=False, alpha=1.0):
+    return _invoke_op("linalg_syrk", a, transpose=transpose, alpha=alpha)
+
+
+def sumlogdiag(a):
+    return _invoke_op("linalg_sumlogdiag", a)
+
+
+def extractdiag(a, offset=0):
+    return _invoke_op("linalg_extractdiag", a, offset=offset)
+
+
+def inverse(a):
+    return _invoke_op("linalg_inverse", a)
+
+
+def det(a):
+    return _invoke_op("linalg_det", a)

@@ -1,0 +1,528 @@
+"""NDArray — the core array type, async by construction.
+
+Reference: ``src/ndarray/ndarray.cc``† + ``python/mxnet/ndarray/ndarray.py``†.
+The reference's NDArray is lazy: every op is pushed to the dependency engine
+with read/write vars and Python returns immediately; ``wait_to_read`` /
+``asnumpy`` are the sync points where async exceptions re-raise
+(``src/engine/threaded_engine.cc``†).
+
+TPU-native: jax's dispatch already gives exactly these semantics — ops
+enqueue XLA executables on the device stream and return futures
+(jax.Array), with errors surfacing at block_until_ready.  So NDArray is a
+thin mutable handle over a jax.Array plus autograd/tape state; there is no
+hand-rolled engine to maintain (SURVEY.md §2.1-N5: "mostly subsumed").
+NDArray is registered as a jax pytree so values flow through jit/vjp
+transparently.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, env_flags
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "stack", "save", "load", "waitall", "from_numpy",
+           "linspace", "eye"]
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+    "uint32": jnp.uint32, "uint64": jnp.uint64, "int16": jnp.int16,
+}
+
+
+def _as_jax_dtype(dtype) -> Any:
+    if dtype is None:
+        return jnp.dtype(env_flags.default_dtype)
+    if isinstance(dtype, str):
+        return jnp.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+    return jnp.dtype(dtype)
+
+
+def _is_concrete(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) and not isinstance(
+        x, jax.core.Tracer)
+
+
+class NDArray:
+    """Multi-dimensional array on a device context.
+
+    Mutable handle semantics like the reference (``a[:] = b`` and in-place
+    arithmetic rebind the underlying buffer); functional under the hood.
+    """
+
+    __slots__ = ("_data", "_ctx", "grad", "_grad_req", "_tape",
+                 "_deferred_init", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None, _placed=False):
+        if isinstance(data, NDArray):
+            data = data._data
+        if ctx is not None and not _placed and _is_concrete(data):
+            data = jax.device_put(data, ctx.jax_device)
+        elif not isinstance(data, jax.Array) and _is_concrete(data):
+            ctx = ctx or current_context()
+            data = jax.device_put(jnp.asarray(data), ctx.jax_device)
+        self._data = data
+        self._ctx = ctx
+        self.grad: Optional[NDArray] = None
+        self._grad_req: str = "null"
+        self._tape = None          # (TapeNode, out_index) set by autograd
+        self._deferred_init = None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        """The underlying jax.Array (or tracer during jit tracing)."""
+        return self._data
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self._data.dtype)) if str(self._data.dtype) != \
+            "bfloat16" else self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        if _is_concrete(self._data) and isinstance(self._data, jax.Array):
+            from ..context import device
+            try:
+                return device(list(self._data.devices())[0])
+            except Exception:
+                pass
+        return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    # ------------------------------------------------------------------
+    # sync points (reference: WaitToRead / asnumpy; async errors re-raise
+    # here, tested by test_exc_handling.py† in the reference suite)
+    # ------------------------------------------------------------------
+    def wait_to_read(self) -> None:
+        if _is_concrete(self._data) and isinstance(self._data, jax.Array):
+            self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("the array is not scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kw):
+        return self._data.__dlpack__(**kw)
+
+    # ------------------------------------------------------------------
+    # autograd handles (python/mxnet/ndarray/ndarray.py† attach_grad)
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None) -> None:
+        if grad_req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {grad_req}")
+        self._grad_req = grad_req
+        self.grad = zeros_like(self) if grad_req != "null" else None
+        self._tape = None
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, self._ctx, _placed=True)
+        return out
+
+    def backward(self, out_grad: Optional["NDArray"] = None,
+                 retain_graph: bool = False, train_mode: bool = True) -> None:
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None
+                          else None, retain_graph=retain_graph,
+                          train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # conversion / placement
+    # ------------------------------------------------------------------
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        jd = _as_jax_dtype(dtype)
+        if not copy and self._data.dtype == jd:
+            return self
+        from . import _invoke_op
+        return _invoke_op("cast", self, dtype=str(jd))
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device),
+                           other, _placed=True)
+        other._data = jax.device_put(self._data,
+                                     other.context.jax_device)
+        return other
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.array(self._data), self._ctx, _placed=True)
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # mutation (engine write-dep semantics are trivially safe here:
+    # rebinding _data after the functional update preserves program order)
+    # ------------------------------------------------------------------
+    def __setitem__(self, key, value) -> None:
+        if isinstance(value, NDArray):
+            value = value._data
+        if key is None or (isinstance(key, slice) and key == slice(None)):
+            self._data = jnp.broadcast_to(
+                jnp.asarray(value, dtype=self._data.dtype),
+                self.shape) + jnp.zeros_like(self._data)
+        else:
+            self._data = self._data.at[key].set(
+                jnp.asarray(value, dtype=self._data.dtype))
+
+    def __getitem__(self, key):
+        from . import _invoke_getitem
+        return _invoke_getitem(self, key)
+
+    # ------------------------------------------------------------------
+    # operator sugar — routed through the op registry so autograd sees them
+    # ------------------------------------------------------------------
+    def _binop(self, other, opname, reverse=False):
+        from . import _invoke_op
+        if isinstance(other, (int, float, bool, np.number)):
+            other = NDArray(jnp.asarray(other, dtype=self._data.dtype))
+        a, b = (other, self) if reverse else (self, other)
+        return _invoke_op(opname, a, b)
+
+    def __add__(self, o): return self._binop(o, "broadcast_add")
+    def __radd__(self, o): return self._binop(o, "broadcast_add", True)
+    def __sub__(self, o): return self._binop(o, "broadcast_sub")
+    def __rsub__(self, o): return self._binop(o, "broadcast_sub", True)
+    def __mul__(self, o): return self._binop(o, "broadcast_mul")
+    def __rmul__(self, o): return self._binop(o, "broadcast_mul", True)
+    def __truediv__(self, o): return self._binop(o, "broadcast_div")
+    def __rtruediv__(self, o): return self._binop(o, "broadcast_div", True)
+    def __mod__(self, o): return self._binop(o, "broadcast_mod")
+    def __rmod__(self, o): return self._binop(o, "broadcast_mod", True)
+    def __pow__(self, o): return self._binop(o, "broadcast_power")
+    def __rpow__(self, o): return self._binop(o, "broadcast_power", True)
+    def __matmul__(self, o): return self._binop(o, "matmul")
+    def __neg__(self):
+        from . import _invoke_op
+        return _invoke_op("negative", self)
+    def __abs__(self):
+        from . import _invoke_op
+        return _invoke_op("abs", self)
+
+    def __eq__(self, o): return self._binop(o, "broadcast_equal")
+    def __ne__(self, o): return self._binop(o, "broadcast_not_equal")
+    def __lt__(self, o): return self._binop(o, "broadcast_lesser")
+    def __le__(self, o): return self._binop(o, "broadcast_lesser_equal")
+    def __gt__(self, o): return self._binop(o, "broadcast_greater")
+    def __ge__(self, o): return self._binop(o, "broadcast_greater_equal")
+
+    __hash__ = None  # mutable container semantics, like the reference
+
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._data = r._data
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._data = r._data
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._data = r._data
+        return self
+
+    def __itruediv__(self, o):
+        r = self.__truediv__(o)
+        self._data = r._data
+        return self
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise MXNetError("len() of 0-d array")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self) -> str:
+        if _is_concrete(self._data):
+            return f"\n{self.asnumpy()}\n<NDArray {self.shape} " \
+                   f"@{self.context} {self._data.dtype}>"
+        return f"<NDArray {self.shape} {self._data.dtype} (traced)>"
+
+    # ------------------------------------------------------------------
+    # method mirrors of common ops (populated further in __init__.py)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        from . import _invoke_op
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _invoke_op("reshape", self, shape=shape)
+
+    def transpose(self, *axes):
+        from . import _invoke_op
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke_op("transpose", self, axes=axes if axes else None)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def expand_dims(self, axis):
+        from . import _invoke_op
+        return _invoke_op("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        from . import _invoke_op
+        return _invoke_op("squeeze", self, axis=axis)
+
+    def flatten(self):
+        from . import _invoke_op
+        return _invoke_op("flatten", self)
+
+    def sum(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("mean", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("min", self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("argmax", self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        from . import _invoke_op
+        return _invoke_op("argmin", self, axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min, a_max):
+        from . import _invoke_op
+        return _invoke_op("clip", self, a_min=float(a_min),
+                          a_max=float(a_max))
+
+    def abs(self):
+        return self.__abs__()
+
+    def slice_axis(self, axis, begin, end):
+        from . import _invoke_op
+        return _invoke_op("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def tostype(self, stype):
+        if stype != "default":
+            from .sparse import _cast_storage
+            return _cast_storage(self, stype)
+        return self
+
+
+def zeros_like(a: NDArray) -> NDArray:
+    return NDArray(jnp.zeros_like(a._data), a._ctx, _placed=True)
+
+
+# ----------------------------------------------------------------------
+# pytree registration: NDArray flows through jit / vjp / shard_map
+# ----------------------------------------------------------------------
+def _flatten(x: NDArray):
+    return (x._data,), None
+
+
+def _unflatten(aux, children):
+    return NDArray(children[0], None, _placed=True)
+
+
+jax.tree_util.register_pytree_node(NDArray, _flatten, _unflatten)
+
+
+# ----------------------------------------------------------------------
+# creation routines (python/mxnet/ndarray/ndarray.py† equivalents)
+# ----------------------------------------------------------------------
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        src = source._data
+    elif isinstance(source, (np.ndarray, jax.Array)):
+        src = source
+    else:
+        # python scalars / nested lists default to float32 like the
+        # reference (mx.nd.array([1,2]) is float32 there)
+        src = np.asarray(source)
+        if src.dtype == np.float64 or src.dtype == np.int64:
+            src = src.astype(env_flags.default_dtype)
+    if dtype is not None:
+        jd = _as_jax_dtype(dtype)
+    else:
+        sd = str(src.dtype)
+        # 64-bit narrows to 32-bit (jax x64 disabled by default)
+        jd = {"float64": jnp.float32, "int64": jnp.int32,
+              "uint64": jnp.uint32}.get(sd, src.dtype)
+    arr = jnp.asarray(src, dtype=jd)
+    ctx = ctx or current_context()
+    return NDArray(arr, ctx)
+
+
+def from_numpy(a: np.ndarray, zero_copy: bool = False) -> NDArray:
+    return array(a)
+
+
+def zeros(shape, ctx=None, dtype=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, _as_jax_dtype(dtype)),
+                   ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, _as_jax_dtype(dtype)),
+                   ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, val, _as_jax_dtype(dtype)),
+                   ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None,
+           dtype=None) -> NDArray:
+    a = jnp.arange(start, stop, step, _as_jax_dtype(dtype))
+    if repeat > 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(a, ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=_as_jax_dtype(dtype)),
+                   ctx or current_context())
+
+
+def eye(N, M=None, k=0, ctx=None, dtype=None):
+    return NDArray(jnp.eye(N, M, k, _as_jax_dtype(dtype)),
+                   ctx or current_context())
+
+
+def concat(*arrays, dim: int = 1) -> NDArray:
+    from . import _invoke_op
+    return _invoke_op("concat", *arrays, dim=dim)
+
+
+def stack(*arrays, axis: int = 0) -> NDArray:
+    from . import _invoke_op
+    return _invoke_op("stack", *arrays, axis=axis)
+
+
+def waitall() -> None:
+    """Reference ``mx.nd.waitall()``† (Engine::WaitForAll)."""
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:
+            raise
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# ----------------------------------------------------------------------
+# save / load — named-tensor checkpoint files
+# Reference format: dmlc::Stream binary with magic + names
+# (src/ndarray/ndarray.cc† Save/Load, used for .params).  We write an
+# ``MXTPU01`` container: header magic, then a numpy .npz payload; loaders
+# accept plain .npz/.npy too.  Binary parity with the 2018 dmlc stream is
+# a round-2 follow-up (documented divergence).
+# ----------------------------------------------------------------------
+_SAVE_MAGIC = b"MXTPU01\n"
+
+
+def save(fname: str, data) -> None:
+    if isinstance(data, NDArray):
+        payload = {"0": data.asnumpy()}
+    elif isinstance(data, (list, tuple)):
+        payload = {str(i): a.asnumpy() for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise MXNetError("save expects NDArray, list or dict of NDArray")
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+    with open(fname, "wb") as f:
+        f.write(_SAVE_MAGIC)
+        f.write(buf.getvalue())
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        head = f.read(len(_SAVE_MAGIC))
+        rest = f.read()
+    import io as _io
+    if head != _SAVE_MAGIC:
+        rest = head + rest
+    npz = np.load(_io.BytesIO(rest), allow_pickle=False)
+    keys = list(npz.keys())
+    if all(k.isdigit() for k in keys):
+        # list payloads always load as a list, even length-1, matching
+        # the reference's MXNDArrayLoad contract
+        return [array(npz[k]) for k in sorted(keys, key=int)]
+    return {k: array(npz[k]) for k in keys}

@@ -1,0 +1,1122 @@
+"""Operator library: lowering rules for the framework op inventory.
+
+TPU-native re-design of ``src/operator/``† (~500 ops: ``tensor/`` elemwise/
+broadcast/reduce/matrix/indexing/ordering families, ``nn/`` convolution/
+pooling/norm/activation/dropout, optimizer ops, random samplers).  Instead
+of per-device FCompute kernels, every op is ONE pure jax lowering rule that
+XLA fuses and schedules for the MXU; gradients come from jax AD rather than
+hand-written FGradient entries (SURVEY.md §2.1-N8).
+
+Naming parity: op names follow the reference's public API
+(``broadcast_add``, ``FullyConnected``, ``Pooling``…) so reference-era user
+code keeps working.  Parameter names match the reference's
+``dmlc::Parameter`` fields (kernel/stride/pad/num_filter/num_hidden…).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+
+# ======================================================================
+# helpers
+# ======================================================================
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _tuple(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+# ======================================================================
+# unary elementwise family (src/operator/tensor/elemwise_unary_op*.cc†,
+# scalar functors in src/operator/mshadow_op.h†)
+# ======================================================================
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "rint": jnp.rint, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "sigmoid": jax.nn.sigmoid, "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype)
+    if jnp.issubdtype(x.dtype, jnp.floating) else jnp.logical_not(x),
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "identity": lambda x: x,
+}
+_UNARY_NONDIFF = {"sign", "floor", "ceil", "round", "rint", "trunc", "fix",
+                  "logical_not"}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name, differentiable=_name not in _UNARY_NONDIFF,
+                doc=f"elementwise {_name}")(
+        (lambda f: lambda x: f(x))(_fn))
+
+register_op("_copy", aliases=("copy",))(lambda x: x)
+register_op("BlockGrad", aliases=("stop_gradient",))(
+    lambda x: lax.stop_gradient(x))
+register_op("make_loss")(lambda x: x)
+
+
+# ======================================================================
+# binary broadcast family (src/operator/tensor/elemwise_binary_broadcast_op*†)
+# ======================================================================
+def _cmp(fn):
+    return lambda a, b: fn(a, b).astype(
+        a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+
+
+_BINARY = {
+    "broadcast_add": (jnp.add, True, ("elemwise_add", "_plus")),
+    "broadcast_sub": (jnp.subtract, True, ("elemwise_sub", "_minus")),
+    "broadcast_mul": (jnp.multiply, True, ("elemwise_mul", "_mul")),
+    "broadcast_div": (jnp.divide, True, ("elemwise_div", "_div")),
+    "broadcast_mod": (jnp.mod, True, ("_mod",)),
+    "broadcast_power": (jnp.power, True, ("_power", "pow")),
+    "broadcast_maximum": (jnp.maximum, True, ("maximum", "_maximum")),
+    "broadcast_minimum": (jnp.minimum, True, ("minimum", "_minimum")),
+    "broadcast_hypot": (jnp.hypot, True, ("_hypot",)),
+    "arctan2": (jnp.arctan2, True, ("_arctan2",)),
+    "broadcast_equal": (_cmp(jnp.equal), False, ("_equal",)),
+    "broadcast_not_equal": (_cmp(jnp.not_equal), False, ("_not_equal",)),
+    "broadcast_greater": (_cmp(jnp.greater), False, ("_greater",)),
+    "broadcast_greater_equal": (_cmp(jnp.greater_equal), False,
+                                ("_greater_equal",)),
+    "broadcast_lesser": (_cmp(jnp.less), False, ("_lesser",)),
+    "broadcast_lesser_equal": (_cmp(jnp.less_equal), False,
+                               ("_lesser_equal",)),
+    "broadcast_logical_and": (_cmp(jnp.logical_and), False, ()),
+    "broadcast_logical_or": (_cmp(jnp.logical_or), False, ()),
+    "broadcast_logical_xor": (_cmp(jnp.logical_xor), False, ()),
+}
+
+for _name, (_fn, _diff, _aliases) in _BINARY.items():
+    register_op(_name, num_inputs=2, differentiable=_diff,
+                aliases=_aliases)((lambda f: lambda a, b: f(a, b))(_fn))
+
+register_op("smooth_l1", params=[Param("scalar", float, 1.0)])(
+    lambda x, scalar=1.0: jnp.where(
+        jnp.abs(x) < 1.0 / (scalar ** 2),
+        0.5 * (scalar * x) ** 2,
+        jnp.abs(x) - 0.5 / (scalar ** 2)))
+
+
+# ======================================================================
+# reductions (src/operator/tensor/broadcast_reduce_op*†)
+# ======================================================================
+def _reduce(fn, name, diff=True, int_out=False):
+    def rule(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            axt = (ax,) if isinstance(ax, int) else ax
+            ax = tuple(i for i in range(x.ndim) if i not in axt)
+        return fn(x, axis=ax, keepdims=bool(keepdims))
+    register_op(name, params=[
+        Param("axis", tuple, None), Param("keepdims", bool, False),
+        Param("exclude", bool, False)], differentiable=diff)(rule)
+
+
+for _n, _f in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+               ("max", jnp.max), ("min", jnp.min),
+               ("nansum", jnp.nansum), ("nanprod", jnp.nanprod)]:
+    _reduce(_f, _n)
+
+register_op("sum_axis", params=[
+    Param("axis", tuple, None), Param("keepdims", bool, False)],
+    aliases=())(lambda x, axis=None, keepdims=False:
+                jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdims))
+
+for _n, _f in [("argmax", jnp.argmax), ("argmin", jnp.argmin)]:
+    register_op(_n, params=[Param("axis", tuple, None),
+                            Param("keepdims", bool, False)],
+                differentiable=False)(
+        (lambda f: lambda x, axis=None, keepdims=False: f(
+            x, axis=None if axis is None else int(axis[0])
+            if isinstance(axis, tuple) else int(axis),
+            keepdims=keepdims).astype(jnp.float32))(_f))
+
+register_op("norm", params=[Param("ord", int, 2),
+                            Param("axis", tuple, None),
+                            Param("keepdims", bool, False)])(
+    lambda x, ord=2, axis=None, keepdims=False:
+    jnp.linalg.norm(x.reshape(-1) if axis is None and not keepdims else x,
+                    ord=ord, axis=_norm_axis(axis), keepdims=keepdims)
+    if axis is not None or keepdims else
+    jnp.linalg.norm(x.reshape(-1), ord=ord).reshape((1,)))
+
+register_op("L2Normalization", params=[Param("eps", float, 1e-10),
+                                       Param("mode", str, "instance")])(
+    lambda x, eps=1e-10, mode="instance":
+    x / jnp.sqrt(jnp.sum(jnp.square(x),
+                         axis=tuple(range(1, x.ndim)) if mode == "instance"
+                         else (1,), keepdims=True) + eps))
+
+
+# ======================================================================
+# shape / layout ops (src/operator/tensor/matrix_op*†)
+# ======================================================================
+def _reshape(x, shape=None):
+    # supports the reference's special codes 0 (keep) and -1 (infer)
+    shape = tuple(shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i])
+        else:
+            out.append(int(s))
+    return jnp.reshape(x, tuple(out))
+
+
+register_op("reshape", params=[Param("shape", tuple, None)],
+            aliases=("Reshape",))(_reshape)
+register_op("reshape_like", num_inputs=2)(
+    lambda x, y: jnp.reshape(x, y.shape))
+register_op("transpose", params=[Param("axes", tuple, None)])(
+    lambda x, axes=None: jnp.transpose(x, axes))
+register_op("expand_dims", params=[Param("axis", int, 0)])(
+    lambda x, axis=0: jnp.expand_dims(x, axis))
+register_op("squeeze", params=[Param("axis", tuple, None)])(
+    lambda x, axis=None: jnp.squeeze(x, _norm_axis(axis)))
+register_op("flatten", aliases=("Flatten",))(
+    lambda x: jnp.reshape(x, (x.shape[0], -1)))
+register_op("broadcast_to", params=[Param("shape", tuple, None)])(
+    lambda x, shape=None: jnp.broadcast_to(
+        x, tuple(int(x.shape[i]) if s == 0 else int(s)
+                 for i, s in enumerate(shape))))
+register_op("broadcast_like", num_inputs=2)(
+    lambda x, y: jnp.broadcast_to(x, y.shape))
+register_op("broadcast_axis", params=[Param("axis", tuple, ()),
+                                      Param("size", tuple, ())])(
+    lambda x, axis=(), size=():
+    jnp.broadcast_to(x, tuple(
+        int(dict(zip(axis, size)).get(i, x.shape[i]))
+        for i in range(x.ndim))))
+register_op("tile", params=[Param("reps", tuple, None)])(
+    lambda x, reps=None: jnp.tile(x, reps))
+register_op("repeat", params=[Param("repeats", int, 1),
+                              Param("axis", tuple, None)])(
+    lambda x, repeats=1, axis=None: jnp.repeat(
+        x, repeats, axis=None if axis is None else int(axis[0])
+        if isinstance(axis, tuple) else axis))
+register_op("flip", params=[Param("axis", tuple, None)],
+            aliases=("reverse",))(
+    lambda x, axis=None: jnp.flip(x, _norm_axis(axis)))
+register_op("swapaxes", params=[Param("dim1", int, 0),
+                                Param("dim2", int, 0)],
+            aliases=("SwapAxis",))(
+    lambda x, dim1=0, dim2=0: jnp.swapaxes(x, dim1, dim2))
+register_op("diag", params=[Param("k", int, 0)])(
+    lambda x, k=0: jnp.diag(x, k) if x.ndim <= 2 else
+    jnp.diagonal(x, k, -2, -1))
+register_op("depth_to_space", params=[Param("block_size", int, None)])(
+    lambda x, block_size=None: _depth_to_space(x, block_size))
+register_op("space_to_depth", params=[Param("block_size", int, None)])(
+    lambda x, block_size=None: _space_to_depth(x, block_size))
+
+
+def _depth_to_space(x, b):
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+def _space_to_depth(x, b):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+def _slice(x, begin=None, end=None, step=None):
+    nd = x.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = (tuple(step) if step else ()) + (None,) * (
+        nd - (len(step) if step else 0))
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+register_op("slice", params=[Param("begin", tuple, ()),
+                             Param("end", tuple, ()),
+                             Param("step", tuple, None)])(_slice)
+register_op("slice_axis", params=[Param("axis", int, 0),
+                                  Param("begin", int, 0),
+                                  Param("end", tuple, None)])(
+    lambda x, axis=0, begin=0, end=None:
+    lax.slice_in_dim(x, begin,
+                     x.shape[axis] if end is None or
+                     (isinstance(end, tuple) and end[0] is None)
+                     else (int(end[0]) if isinstance(end, tuple) else int(end)),
+                     axis=axis))
+register_op("slice_like", num_inputs=2,
+            params=[Param("axes", tuple, ())])(
+    lambda x, y, axes=(): x[tuple(
+        slice(0, y.shape[i]) if (not axes or i in axes or
+                                 (i - x.ndim) in axes) else slice(None)
+        for i in range(x.ndim))])
+
+
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+register_op("split", params=[Param("num_outputs", int, 1),
+                             Param("axis", int, 1),
+                             Param("squeeze_axis", bool, False)],
+            num_outputs=-1, aliases=("SliceChannel",))(_split)
+
+register_op("concat", num_inputs=-1, params=[Param("dim", int, 1)],
+            aliases=("Concat",))(
+    lambda *xs, dim=1: jnp.concatenate(xs, axis=dim))
+register_op("stack", num_inputs=-1, params=[Param("axis", int, 0)])(
+    lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+register_op("add_n", num_inputs=-1, aliases=("ElementWiseSum",))(
+    lambda *xs: functools.reduce(jnp.add, xs))
+
+register_op("clip", params=[Param("a_min", float, None),
+                            Param("a_max", float, None)])(
+    lambda x, a_min=None, a_max=None: jnp.clip(x, a_min, a_max))
+register_op("cast", params=[Param("dtype", str, "float32")],
+            aliases=("Cast",))(
+    lambda x, dtype="float32": x.astype(
+        jnp.bfloat16 if dtype == "bfloat16" else np.dtype(dtype)))
+register_op("zeros_like")(lambda x: jnp.zeros_like(x))
+register_op("ones_like")(lambda x: jnp.ones_like(x))
+register_op("shape_array", differentiable=False)(
+    lambda x: jnp.asarray(x.shape, dtype=jnp.int64)
+    if jax.config.jax_enable_x64 else jnp.asarray(x.shape, jnp.int32))
+register_op("size_array", differentiable=False)(
+    lambda x: jnp.asarray([math.prod(x.shape)], jnp.int32))
+
+
+def _pad(x, mode="constant", pad_width=None, constant_value=0.0):
+    pw = tuple(pad_width)
+    pairs = tuple((int(pw[2 * i]), int(pw[2 * i + 1]))
+                  for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode="constant",
+                       constant_values=constant_value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+register_op("pad", params=[Param("mode", str, "constant",
+                                 enum=("constant", "edge", "reflect")),
+                           Param("pad_width", tuple, ()),
+                           Param("constant_value", float, 0.0)],
+            aliases=("Pad",))(_pad)
+
+
+# ======================================================================
+# indexing ops (src/operator/tensor/indexing_op*†)
+# ======================================================================
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+register_op("take", num_inputs=2,
+            params=[Param("axis", int, 0),
+                    Param("mode", str, "clip",
+                          enum=("clip", "wrap", "raise"))])(_take)
+
+register_op("Embedding", num_inputs=2,
+            params=[Param("input_dim", int, 0),
+                    Param("output_dim", int, 0),
+                    Param("dtype", str, "float32"),
+                    Param("sparse_grad", bool, False)],
+            aliases=("embedding",))(
+    lambda data, weight, input_dim=0, output_dim=0, dtype="float32",
+    sparse_grad=False: jnp.take(weight, data.astype(jnp.int32), axis=0))
+
+register_op("one_hot", params=[Param("depth", int, None),
+                               Param("on_value", float, 1.0),
+                               Param("off_value", float, 0.0),
+                               Param("dtype", str, "float32")],
+            differentiable=False)(
+    lambda x, depth=None, on_value=1.0, off_value=0.0, dtype="float32":
+    jax.nn.one_hot(x.astype(jnp.int32), depth, dtype=np.dtype(dtype))
+    * (on_value - off_value) + off_value)
+
+register_op("gather_nd", num_inputs=2)(
+    lambda data, indices: data[tuple(indices.astype(jnp.int32))])
+
+
+def _scatter_nd(data, indices, shape=None):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(indices.astype(jnp.int32))].add(data)
+
+
+register_op("scatter_nd", num_inputs=2,
+            params=[Param("shape", tuple, None)])(_scatter_nd)
+
+def _pick(data, index, axis=(-1,), keepdims=False, mode="clip"):
+    ax = int(axis[0]) if isinstance(axis, tuple) else int(axis)
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, ax), axis=ax)
+    return out if keepdims else jnp.squeeze(out, axis=ax)
+
+
+register_op("pick", num_inputs=2,
+            params=[Param("axis", tuple, (-1,)),
+                    Param("keepdims", bool, False),
+                    Param("mode", str, "clip")])(_pick)
+
+register_op("where", num_inputs=3)(
+    lambda cond, x, y: jnp.where(cond.astype(bool), x, y))
+
+register_op("SequenceMask", num_inputs=-1,
+            params=[Param("use_sequence_length", bool, False),
+                    Param("value", float, 0.0),
+                    Param("axis", int, 0)])(
+    lambda data, *seq, use_sequence_length=False, value=0.0, axis=0:
+    _sequence_mask(data, seq[0], value, axis)
+    if use_sequence_length and seq else data)
+
+
+def _sequence_mask(data, seq_len, value, axis):
+    # data: (T, N, ...) if axis=0 else (N, T, ...)
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    if axis == 0:
+        mask = pos[:, None] < seq_len.astype(jnp.int32)[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = pos[None, :] < seq_len.astype(jnp.int32)[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+def _sequence_last(data, seq_len, axis):
+    if seq_len is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (seq_len.astype(jnp.int32) - 1)
+    if axis == 0:
+        idx = idx.reshape((1, -1) + (1,) * (data.ndim - 2))
+    else:
+        idx = idx.reshape((-1, 1) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, idx, axis=axis).squeeze(axis)
+
+
+register_op("SequenceLast", num_inputs=-1,
+            params=[Param("use_sequence_length", bool, False),
+                    Param("axis", int, 0)])(
+    lambda data, *seq, use_sequence_length=False, axis=0:
+    _sequence_last(data, seq[0] if (use_sequence_length and seq) else None,
+                   axis))
+
+register_op("SequenceReverse", num_inputs=-1,
+            params=[Param("use_sequence_length", bool, False),
+                    Param("axis", int, 0)])(
+    lambda data, *seq, use_sequence_length=False, axis=0:
+    _seq_reverse(data, seq[0], axis)
+    if use_sequence_length and seq else jnp.flip(data, axis))
+
+
+def _seq_reverse(data, seq_len, axis):
+    # reverse the first seq_len[n] entries along `axis` per batch row;
+    # batch axis is the other of {0, 1}
+    T = data.shape[axis]
+    batch_axis = 1 - axis
+    pos = jnp.arange(T)
+    sl = seq_len.astype(jnp.int32)
+    if axis == 0:
+        src = jnp.where(pos[:, None] < sl[None, :],
+                        sl[None, :] - 1 - pos[:, None], pos[:, None])
+    else:
+        src = jnp.where(pos[None, :] < sl[:, None],
+                        sl[:, None] - 1 - pos[None, :], pos[None, :])
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=axis)
+
+
+# ======================================================================
+# ordering (src/operator/tensor/ordering_op*†)
+# ======================================================================
+def _sort(x, axis=(-1,), is_ascend=True):
+    ax = int(axis[0]) if isinstance(axis, tuple) else int(axis)
+    s = jnp.sort(x, axis=ax)
+    # flip instead of negate: negation is wrong for unsigned/bool dtypes
+    return s if is_ascend else jnp.flip(s, axis=ax)
+
+
+register_op("sort", params=[Param("axis", tuple, (-1,)),
+                            Param("is_ascend", bool, True)])(_sort)
+
+
+def _argsort(x, axis=(-1,), is_ascend=True, dtype="float32"):
+    ax = int(axis[0]) if isinstance(axis, tuple) else int(axis)
+    idx = jnp.argsort(x, axis=ax)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=ax)
+    return idx.astype(np.dtype(dtype))
+
+
+register_op("argsort", params=[Param("axis", tuple, (-1,)),
+                               Param("is_ascend", bool, True),
+                               Param("dtype", str, "float32")],
+            differentiable=False)(_argsort)
+
+
+def _topk(x, axis=(-1,), k=1, ret_typ="indices", is_ascend=False,
+          dtype="float32"):
+    ax = int(axis[0]) if isinstance(axis, tuple) else int(axis)
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(np.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    raise MXNetError(f"topk ret_typ {ret_typ} unsupported")
+
+
+register_op("topk", params=[Param("axis", tuple, (-1,)),
+                            Param("k", int, 1),
+                            Param("ret_typ", str, "indices"),
+                            Param("is_ascend", bool, False),
+                            Param("dtype", str, "float32")],
+            num_outputs=-1, differentiable=False)(_topk)
+
+
+# ======================================================================
+# linalg (src/operator/tensor/dot.cc†, la_op.cc†)
+# ======================================================================
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+register_op("dot", num_inputs=2,
+            params=[Param("transpose_a", bool, False),
+                    Param("transpose_b", bool, False)])(_dot)
+
+register_op("batch_dot", num_inputs=2,
+            params=[Param("transpose_a", bool, False),
+                    Param("transpose_b", bool, False)])(
+    lambda a, b, transpose_a=False, transpose_b=False:
+    jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+               jnp.swapaxes(b, -1, -2) if transpose_b else b))
+
+register_op("matmul", num_inputs=2)(lambda a, b: jnp.matmul(a, b))
+
+register_op("linalg_gemm2", num_inputs=2,
+            params=[Param("transpose_a", bool, False),
+                    Param("transpose_b", bool, False),
+                    Param("alpha", float, 1.0)])(
+    lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0:
+    alpha * jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                       jnp.swapaxes(b, -1, -2) if transpose_b else b))
+register_op("linalg_gemm", num_inputs=3,
+            params=[Param("transpose_a", bool, False),
+                    Param("transpose_b", bool, False),
+                    Param("alpha", float, 1.0),
+                    Param("beta", float, 1.0)])(
+    lambda a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+    beta=1.0: alpha * jnp.matmul(
+        jnp.swapaxes(a, -1, -2) if transpose_a else a,
+        jnp.swapaxes(b, -1, -2) if transpose_b else b) + beta * c)
+register_op("linalg_potrf")(lambda a: jnp.linalg.cholesky(a))
+register_op("linalg_trsm", num_inputs=2,
+            params=[Param("transpose", bool, False),
+                    Param("rightside", bool, False),
+                    Param("lower", bool, True),
+                    Param("alpha", float, 1.0)])(
+    lambda a, b, transpose=False, rightside=False, lower=True, alpha=1.0:
+    alpha * jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(a, -1, -2) if transpose else a, b,
+        lower=lower != transpose, trans=0)
+    if not rightside else
+    alpha * jnp.swapaxes(jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(a if transpose else jnp.swapaxes(a, -1, -2), -1, -2),
+        jnp.swapaxes(b, -1, -2), lower=not (lower != transpose)), -1, -2))
+register_op("linalg_syrk", params=[Param("transpose", bool, False),
+                                   Param("alpha", float, 1.0)])(
+    lambda a, transpose=False, alpha=1.0:
+    alpha * (jnp.matmul(jnp.swapaxes(a, -1, -2), a) if transpose
+             else jnp.matmul(a, jnp.swapaxes(a, -1, -2))))
+register_op("linalg_sumlogdiag")(
+    lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                      axis=-1))
+register_op("linalg_extractdiag", params=[Param("offset", int, 0)])(
+    lambda a, offset=0: jnp.diagonal(a, offset, -2, -1))
+register_op("linalg_inverse")(lambda a: jnp.linalg.inv(a))
+register_op("linalg_det")(lambda a: jnp.linalg.det(a))
+register_op("khatri_rao", num_inputs=-1)(
+    lambda *xs: functools.reduce(
+        lambda a, b: jnp.einsum("ir,jr->ijr", a, b).reshape(-1, a.shape[1]),
+        xs))
+
+
+# ======================================================================
+# neural-net ops (src/operator/nn/†)
+# ======================================================================
+register_op("FullyConnected", num_inputs=-1,
+            params=[Param("num_hidden", int, 0),
+                    Param("no_bias", bool, False),
+                    Param("flatten", bool, True)],
+            aliases=("fully_connected",))(
+    lambda data, weight, *maybe_bias, num_hidden=0, no_bias=False,
+    flatten=True: _fully_connected(data, weight,
+                                   maybe_bias[0] if maybe_bias else None,
+                                   no_bias, flatten))
+
+
+def _fully_connected(x, w, b, no_bias, flatten):
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, w.T)
+    if b is not None and not no_bias:
+        y = y + b
+    return y
+
+
+_CONV_DN = {  # layout string -> (lhs, rhs, out) dimension numbers
+    "NCHW": ("NCHW", "OIHW", "NCHW"),
+    "NHWC": ("NHWC", "HWIO", "NHWC"),
+    "NCW": ("NCH", "OIH", "NCH"),
+    "NWC": ("NHC", "HIO", "NHC"),
+    "NCDHW": ("NCDHW", "OIDHW", "NCDHW"),
+    "NDHWC": ("NDHWC", "DHWIO", "NDHWC"),
+}
+
+
+def _convolution(x, w, b=None, kernel=(), stride=None, dilate=None,
+                 pad=None, num_filter=0, num_group=1, no_bias=False,
+                 layout=None):
+    nd = len(kernel)
+    layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    dn = _CONV_DN[layout]
+    stride = _tuple(stride, nd)
+    dilate = _tuple(dilate, nd)
+    pad = _tuple(pad, nd) if pad is not None else (0,) * nd
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32
+        if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if b is not None and not no_bias:
+        if layout.endswith("C"):
+            out = out + b
+        else:
+            out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+register_op("Convolution", num_inputs=-1,
+            params=[Param("kernel", tuple, ()),
+                    Param("stride", tuple, None),
+                    Param("dilate", tuple, None),
+                    Param("pad", tuple, None),
+                    Param("num_filter", int, 0),
+                    Param("num_group", int, 1),
+                    Param("no_bias", bool, False),
+                    Param("layout", str, None)],
+            aliases=("convolution",))(
+    lambda data, weight, *b, **kw: _convolution(
+        data, weight, b[0] if b else None, **kw))
+
+
+def _deconvolution(x, w, b=None, kernel=(), stride=None, dilate=None,
+                   pad=None, adj=None, num_filter=0, num_group=1,
+                   no_bias=False, layout=None):
+    nd = len(kernel)
+    layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    dn = _CONV_DN[layout]
+    stride = _tuple(stride, nd)
+    pad = _tuple(pad, nd) if pad is not None else (0,) * nd
+    # MXNet deconv weight layout (in, out, kH, kW) == the forward-conv
+    # OIHW kernel of the conv this op transposes, which is exactly what
+    # lax.conv_transpose(transpose_kernel=True) expects.  Explicit padding
+    # pairs apply to the stride-dilated input, so the reference's `pad`
+    # (forward-conv padding) maps to k-1-p per side, giving
+    # out = (in-1)*stride + kernel - 2*pad like the reference.
+    dil = _tuple(dilate, nd)
+    tpad = [(dil[i] * (int(kernel[i]) - 1) - pad[i],) * 2
+            for i in range(nd)]
+    out = lax.conv_transpose(
+        x, w, strides=stride, padding=tpad, rhs_dilation=dil,
+        dimension_numbers=dn, transpose_kernel=True)
+    if b is not None and not no_bias:
+        out = out + (b.reshape((1, -1) + (1,) * nd)
+                     if layout.startswith("NC") else b)
+    return out
+
+
+register_op("Deconvolution", num_inputs=-1,
+            params=[Param("kernel", tuple, ()),
+                    Param("stride", tuple, None),
+                    Param("dilate", tuple, None),
+                    Param("pad", tuple, None),
+                    Param("adj", tuple, None),
+                    Param("num_filter", int, 0),
+                    Param("num_group", int, 1),
+                    Param("no_bias", bool, False),
+                    Param("layout", str, None)])(
+    lambda data, weight, *b, **kw: _deconvolution(
+        data, weight, b[0] if b else None, **kw))
+
+
+def _pooling(x, kernel=(), pool_type="max", global_pool=False, stride=None,
+             pad=None, count_include_pad=True, layout=None):
+    nd = len(kernel) if kernel else x.ndim - 2
+    layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    channels_last = layout.endswith("C")
+    sp_axes = tuple(range(1, 1 + nd)) if channels_last \
+        else tuple(range(2, 2 + nd))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(x, axis=sp_axes, keepdims=True)
+        return jnp.mean(x, axis=sp_axes, keepdims=True)
+    stride = _tuple(stride, nd)
+    pad = _tuple(pad, nd) if pad is not None else (0,) * nd
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    padding = [(0, 0)] * x.ndim
+    for i, ax in enumerate(sp_axes):
+        window[ax] = int(kernel[i])
+        strides[ax] = int(stride[i])
+        padding[ax] = (int(pad[i]), int(pad[i]))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / math.prod(int(k) for k in kernel)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                padding)
+        return s / cnt
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.square(x), 0.0, lax.add, window,
+                               strides, padding)
+        return jnp.sqrt(p2)
+    raise MXNetError(f"pool_type {pool_type} unsupported")
+
+
+register_op("Pooling",
+            params=[Param("kernel", tuple, ()),
+                    Param("pool_type", str, "max",
+                          enum=("max", "avg", "sum", "lp")),
+                    Param("global_pool", bool, False),
+                    Param("stride", tuple, None),
+                    Param("pad", tuple, None),
+                    Param("count_include_pad", bool, True),
+                    Param("layout", str, None)],
+            aliases=("pooling",))(_pooling)
+
+
+def _activation(x, act_type="relu"):
+    return {
+        "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+    }[act_type](x)
+
+
+register_op("Activation", params=[
+    Param("act_type", str, "relu",
+          enum=("relu", "sigmoid", "tanh", "softrelu", "softsign"))],
+    aliases=("activation",))(_activation)
+
+
+def _leaky_relu(x, *extra, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        gamma = extra[0]
+        if gamma.ndim == 1 and x.ndim > 1:
+            gamma = gamma.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, gamma * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1.0))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x > 0, x, mid * x)
+    raise MXNetError(f"LeakyReLU act_type {act_type} unsupported")
+
+
+register_op("LeakyReLU", num_inputs=-1,
+            params=[Param("act_type", str, "leaky",
+                          enum=("leaky", "prelu", "elu", "selu", "gelu",
+                                "rrelu")),
+                    Param("slope", float, 0.25),
+                    Param("lower_bound", float, 0.125),
+                    Param("upper_bound", float, 0.334)])(_leaky_relu)
+
+
+def _softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+register_op("softmax", params=[Param("axis", int, -1),
+                               Param("temperature", tuple, None)])(
+    lambda x, axis=-1, temperature=None: _softmax(
+        x, axis, None if temperature in (None, ()) else float(
+            temperature[0] if isinstance(temperature, tuple)
+            else temperature)))
+register_op("log_softmax", params=[Param("axis", int, -1)])(
+    lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+register_op("softmin", params=[Param("axis", int, -1)])(
+    lambda x, axis=-1: jax.nn.softmax(-x, axis=axis))
+
+register_op("softmax_cross_entropy", num_inputs=2)(
+    lambda data, label: -jnp.sum(
+        jax.nn.log_softmax(data, axis=-1) *
+        jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1])))
+
+register_op("SoftmaxOutput", num_inputs=2,
+            params=[Param("grad_scale", float, 1.0),
+                    Param("ignore_label", float, -1.0),
+                    Param("use_ignore", bool, False),
+                    Param("multi_output", bool, False),
+                    Param("preserve_shape", bool, False),
+                    Param("normalization", str, "null")],
+            aliases=("Softmax",))(
+    lambda data, label, **kw: jax.nn.softmax(data, axis=-1))
+
+
+def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return (x - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+
+
+register_op("LayerNorm", num_inputs=3,
+            params=[Param("axis", int, -1), Param("eps", float, 1e-5)])(
+    _layer_norm)
+
+
+def _instance_norm(x, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + \
+        beta.reshape(shape)
+
+
+register_op("InstanceNorm", num_inputs=3,
+            params=[Param("eps", float, 1e-3)])(_instance_norm)
+
+
+def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1):
+    """Normalise over all axes but `axis`.  Returns (out, batch_mean,
+    batch_var) — the gluon layer owns the running-stat update (the
+    reference mutates aux states inside the op via FMutateInputs;
+    functionally we return them instead)."""
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    if use_global_stats:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x - mean.reshape(
+            tuple(-1 if i == axis else 1 for i in range(x.ndim)))),
+            axis=axes)
+    shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = (x - mean.reshape(shape)) * lax.rsqrt(
+        var.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
+    return out, mean, var
+
+
+register_op("BatchNorm", num_inputs=5, num_outputs=3,
+            params=[Param("eps", float, 1e-5),
+                    Param("momentum", float, 0.9),
+                    Param("fix_gamma", bool, True),
+                    Param("use_global_stats", bool, False),
+                    Param("output_mean_var", bool, False),
+                    Param("axis", int, 1)],
+            aliases=("batch_norm",))(_batch_norm)
+
+
+def _dropout(x, key, p=0.5, mode="training", axes=()):
+    if mode != "training" or p <= 0.0:
+        return x
+    shape = list(x.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(
+        key.astype(jnp.uint32).reshape(2,) if key.dtype != jnp.uint32
+        else key.reshape(2,), keep, tuple(shape)) \
+        if key.ndim else jax.random.bernoulli(
+            jax.random.PRNGKey(0), keep, tuple(shape))
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+register_op("Dropout", num_inputs=2,
+            params=[Param("p", float, 0.5),
+                    Param("mode", str, "training"),
+                    Param("axes", tuple, ())],
+            aliases=("dropout",))(_dropout)
+
+
+def _lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(nsize):
+        acc = acc + lax.dynamic_slice_in_dim(pad, i, x.shape[1], axis=1)
+    return x / jnp.power(knorm + alpha * acc, beta)
+
+
+register_op("LRN", params=[Param("nsize", int, 5),
+                           Param("alpha", float, 1e-4),
+                           Param("beta", float, 0.75),
+                           Param("knorm", float, 2.0)])(_lrn)
+
+
+def _upsampling(x, scale=2, sample_type="nearest", num_filter=0):
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+
+
+register_op("UpSampling", num_inputs=-1,
+            params=[Param("scale", int, 2),
+                    Param("sample_type", str, "nearest",
+                          enum=("nearest", "bilinear")),
+                    Param("num_filter", int, 0)])(
+    lambda x, *rest, **kw: _upsampling(x, **kw))
+
+
+# ======================================================================
+# optimizer ops (src/operator/optimizer_op.cc† — "optimizers are ops")
+# Functional: return updated tensors instead of mutating; the Optimizer
+# layer rebinds.  All are fused into the compiled train step under jit.
+# ======================================================================
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight
+    return g
+
+
+register_op("sgd_update", num_inputs=2,
+            params=[Param("lr", float), Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(
+    lambda weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+    clip_gradient=-1.0: weight - lr * _rescale_clip(
+        grad, rescale_grad, clip_gradient if clip_gradient > 0 else None,
+        wd, weight))
+
+
+def _sgd_mom(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+             rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    mom_new = momentum * mom - lr * g
+    return weight + mom_new, mom_new
+
+
+register_op("sgd_mom_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float),
+                    Param("momentum", float, 0.0),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_sgd_mom)
+
+
+def _adam(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+          epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w_new = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w_new, mean_new, var_new
+
+
+register_op("adam_update", num_inputs=4, num_outputs=3,
+            params=[Param("lr", float),
+                    Param("beta1", float, 0.9),
+                    Param("beta2", float, 0.999),
+                    Param("epsilon", float, 1e-8),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_adam)
+
+
+def _rmsprop(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+             wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+             clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w_new = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights > 0:
+        w_new = jnp.clip(w_new, -clip_weights, clip_weights)
+    return w_new, n_new
+
+
+register_op("rmsprop_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float),
+                    Param("gamma1", float, 0.9),
+                    Param("epsilon", float, 1e-8),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("clip_weights", float, -1.0)],
+            differentiable=False)(_rmsprop)
+
+
+def _rmspropalex(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                 gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_new = gamma1 * g_state + (1 - gamma1) * g
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(
+        n_new - jnp.square(g_new) + epsilon)
+    return weight + delta_new, n_new, g_new, delta_new
+
+
+register_op("rmspropalex_update", num_inputs=5, num_outputs=4,
+            params=[Param("lr", float),
+                    Param("gamma1", float, 0.95),
+                    Param("gamma2", float, 0.9),
+                    Param("epsilon", float, 1e-8),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_rmspropalex)
+
+
+def _ftrl(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+          rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w_new = jnp.where(
+        jnp.abs(z_new) <= lamda1, 0.0,
+        -(z_new - jnp.sign(z_new) * lamda1) /
+        ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w_new, z_new, n_new
+
+
+register_op("ftrl_update", num_inputs=4, num_outputs=3,
+            params=[Param("lr", float),
+                    Param("lamda1", float, 0.01),
+                    Param("beta", float, 1.0),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_ftrl)
+
+register_op("signsgd_update", num_inputs=2,
+            params=[Param("lr", float), Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(
+    lambda weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+    clip_gradient=-1.0: weight - lr * (jnp.sign(_rescale_clip(
+        grad, rescale_grad, clip_gradient if clip_gradient > 0 else None))
+        + wd * weight))
+
+
+def _signum(weight, grad, mom, lr=0.01, momentum=0.9, wd=0.0,
+            rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None)
+    mom_new = momentum * mom - (1 - momentum) * g
+    w_new = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new) * (-1.0) \
+        * (-1.0) - lr * wd * weight
+    w_new = weight + lr * jnp.sign(mom_new) - lr * wd * weight \
+        if wd_lh == 0.0 else w_new
+    return w_new, mom_new
+
+
+register_op("signum_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float),
+                    Param("momentum", float, 0.9),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("wd_lh", float, 0.0)],
+            differentiable=False)(_signum)

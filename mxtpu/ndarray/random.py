@@ -1,0 +1,171 @@
+"""Random sampling ops (``mx.nd.random``).
+
+Reference: ``src/operator/random/``† (samplers over per-context stateful
+RNG resources from ``src/resource.cc``†) and ``python/mxnet/random.py``†.
+
+TPU-native: counter-based threefry PRNG.  A process-global key stream per
+context preserves the reference's *stateful* seeding API
+(``mx.random.seed``) on top of jax's functional keys (SURVEY.md §7 hard
+part 5 — statistical parity, not bit parity).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import env_flags
+from ..context import Context, current_context
+from .ndarray import NDArray, _as_jax_dtype
+
+__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "randint", "bernoulli"]
+
+_LOCK = threading.Lock()
+_KEYS: Dict[str, jax.Array] = {}
+_DEFAULT_SEED = 0
+
+
+def _ctx_key(ctx: Optional[Context]) -> str:
+    ctx = ctx or current_context()
+    return f"{ctx.device_type}:{ctx.device_id}"
+
+
+def seed(seed_state: int, ctx: str | Context = "all") -> None:
+    """``mx.random.seed``† — reseed the global stream (all ctxs or one)."""
+    global _DEFAULT_SEED
+    with _LOCK:
+        if ctx == "all":
+            _DEFAULT_SEED = seed_state
+            _KEYS.clear()
+        else:
+            _KEYS[_ctx_key(ctx)] = jax.random.PRNGKey(seed_state)
+
+
+def _next_key(ctx: Optional[Context] = None) -> jax.Array:
+    with _LOCK:
+        k = _ctx_key(ctx)
+        if k not in _KEYS:
+            _KEYS[k] = jax.random.PRNGKey(_DEFAULT_SEED)
+        _KEYS[k], sub = jax.random.split(_KEYS[k])
+    return sub
+
+
+def _next_key_nd(ctx: Optional[Context] = None) -> NDArray:
+    return NDArray(jax.random.key_data(_next_key(ctx)), None, _placed=True)
+
+
+def _wrap(arr, ctx) -> NDArray:
+    return NDArray(arr, ctx or current_context())
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.uniform(_next_key(ctx), shape,
+                           _as_jax_dtype(dtype), low, high)
+    if out is not None:
+        out._data = a
+        return out
+    return _wrap(a, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = loc + scale * jax.random.normal(_next_key(ctx), shape,
+                                        _as_jax_dtype(dtype))
+    if out is not None:
+        out._data = a
+        return out
+    return _wrap(a, ctx)
+
+
+def randn(*shape, dtype=None, ctx=None):
+    return normal(0.0, 1.0, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.gamma(_next_key(ctx), alpha, shape,
+                         _as_jax_dtype(dtype)) * beta
+    return _wrap(a, ctx)
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.exponential(_next_key(ctx), shape,
+                               _as_jax_dtype(dtype)) * scale
+    return _wrap(a, ctx)
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.poisson(_next_key(ctx), lam, shape).astype(
+        _as_jax_dtype(dtype))
+    return _wrap(a, ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None,
+                      out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    key1, key2 = jax.random.split(_next_key(ctx))
+    lam = jax.random.gamma(key1, k, shape) * (1 - p) / p
+    a = jax.random.poisson(key2, lam, shape).astype(_as_jax_dtype(dtype))
+    return _wrap(a, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                  dtype=None, ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    key1, key2 = jax.random.split(_next_key(ctx))
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(key1, r, shape) * (1 - p) / p
+    a = jax.random.poisson(key2, lam, shape).astype(_as_jax_dtype(dtype))
+    return _wrap(a, ctx)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", ctx=None):
+    """Sample from categorical distributions given probabilities
+    (reference ``sample_multinomial``†)."""
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = int(np.prod(shape)) if shape else 1
+    logits = jnp.log(jnp.maximum(d, 1e-30))
+    if d.ndim == 1:
+        draw = jax.random.categorical(_next_key(ctx), logits,
+                                      shape=(n,) if shape else ())
+    else:
+        draw = jax.random.categorical(
+            _next_key(ctx), logits[:, None, :] if shape else logits,
+            axis=-1, shape=(d.shape[0], n) if shape else (d.shape[0],))
+    draw = draw.astype(_as_jax_dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            draw.astype(jnp.int32).reshape(d.shape[0], -1) if d.ndim > 1
+            else draw.astype(jnp.int32).reshape(-1)[None, :], axis=-1)
+        return _wrap(draw, ctx), _wrap(lp.reshape(draw.shape), ctx)
+    return _wrap(draw, ctx)
+
+
+def shuffle(data, ctx=None):
+    d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    perm = jax.random.permutation(_next_key(ctx), d.shape[0])
+    return _wrap(jnp.take(d, perm, axis=0), ctx)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.randint(_next_key(ctx), shape, low, high,
+                           _as_jax_dtype(dtype))
+    return _wrap(a, ctx)
+
+
+def bernoulli(prob=0.5, shape=(1,), dtype=None, ctx=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    a = jax.random.bernoulli(_next_key(ctx), prob, shape).astype(
+        _as_jax_dtype(dtype))
+    return _wrap(a, ctx)

@@ -1,0 +1,181 @@
+"""Sparse NDArray flavours — API parity over dense TPU storage.
+
+Reference: ``python/mxnet/ndarray/sparse.py``† (RowSparseNDArray,
+CSRNDArray) over C++ storage types in ``src/ndarray/``†.
+
+TPU has no native sparse storage; per SURVEY.md §7 hard part 3 the API is
+kept (indices/data views, ``tostype``, row_sparse gradient aggregation)
+while the device representation stays dense — gather/scatter/segment-sum
+lower to XLA ops that the compiler handles well.  The compressed fields
+are maintained alongside a dense mirror so ``retain``/``indices`` behave
+like the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
+           "csr_matrix", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def todense(self) -> NDArray:
+        return NDArray(self._data, self._ctx, _placed=True)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        return _cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at ``indices`` hold ``data``; all other rows are zero."""
+    __slots__ = ("_indices",)
+
+    def __init__(self, dense_data, indices, ctx=None):
+        super().__init__(dense_data, ctx)
+        self._indices = jnp.asarray(indices, dtype=jnp.int64) \
+            if indices is not None else None
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        if self._indices is None:
+            nz = np.nonzero(np.any(np.asarray(self._data) != 0,
+                                   axis=tuple(range(1, self._data.ndim))))[0]
+            self._indices = jnp.asarray(nz, dtype=jnp.int64)
+        return NDArray(self._indices, self._ctx, _placed=True)
+
+    @property
+    def data(self):
+        # compressed rows view (reference .data of row_sparse)
+        return NDArray(jnp.take(self._data,
+                                self.indices._data.astype(jnp.int32),
+                                axis=0), self._ctx, _placed=True)
+
+    def retain(self, rsp_indices) -> "RowSparseNDArray":
+        idx = rsp_indices._data if isinstance(rsp_indices, NDArray) \
+            else jnp.asarray(rsp_indices)
+        mask = jnp.zeros((self._data.shape[0],), bool).at[
+            idx.astype(jnp.int32)].set(True)
+        dense = jnp.where(
+            mask.reshape((-1,) + (1,) * (self._data.ndim - 1)),
+            self._data, 0)
+        return RowSparseNDArray(dense, idx, self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed sparse row array."""
+    __slots__ = ("_indptr", "_col_indices")
+
+    def __init__(self, dense_data, indptr=None, indices=None, ctx=None):
+        super().__init__(dense_data, ctx)
+        self._indptr = None if indptr is None else jnp.asarray(
+            indptr, jnp.int64)
+        self._col_indices = None if indices is None else jnp.asarray(
+            indices, jnp.int64)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def _compress(self):
+        d = np.asarray(self._data)
+        indptr = [0]
+        cols = []
+        vals = []
+        for r in range(d.shape[0]):
+            nz = np.nonzero(d[r])[0]
+            cols.extend(nz.tolist())
+            vals.extend(d[r, nz].tolist())
+            indptr.append(len(cols))
+        self._indptr = jnp.asarray(indptr, jnp.int64)
+        self._col_indices = jnp.asarray(cols, jnp.int64)
+        return np.asarray(vals, d.dtype)
+
+    @property
+    def indptr(self) -> NDArray:
+        if self._indptr is None:
+            self._compress()
+        return NDArray(self._indptr, self._ctx, _placed=True)
+
+    @property
+    def indices(self) -> NDArray:
+        if self._col_indices is None:
+            self._compress()
+        return NDArray(self._col_indices, self._ctx, _placed=True)
+
+    @property
+    def data(self):
+        vals = self._compress()
+        return array(vals)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create RowSparseNDArray from (data, indices) or a dense source."""
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not \
+            np.isscalar(arg1[0]):
+        data, indices = arg1
+        data = np.asarray(data, dtype=dtype or np.float32)
+        indices = np.asarray(indices, dtype=np.int64)
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        dense = np.zeros(shape, dtype=data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense), indices, ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(
+        arg1, dtype=dtype or np.float32)
+    return RowSparseNDArray(jnp.asarray(src), None, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = np.asarray(data, dtype=dtype or np.float32)
+        indices = np.asarray(indices, np.int64)
+        indptr = np.asarray(indptr, np.int64)
+        if shape is None:
+            raise MXNetError("csr_matrix((data,indices,indptr)) needs shape")
+        dense = np.zeros(shape, dtype=data.dtype)
+        for r in range(shape[0]):
+            for j in range(int(indptr[r]), int(indptr[r + 1])):
+                dense[r, int(indices[j])] = data[j]
+        return CSRNDArray(jnp.asarray(dense), indptr, indices, ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(
+        arg1, dtype=dtype or np.float32)
+    return CSRNDArray(jnp.asarray(src), None, None, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dense = jnp.zeros(shape, np.dtype(dtype or "float32"))
+    if stype == "row_sparse":
+        return RowSparseNDArray(dense, np.zeros((0,), np.int64), ctx)
+    if stype == "csr":
+        return CSRNDArray(dense, None, None, ctx)
+    return NDArray(dense, ctx)
+
+
+def _cast_storage(nd: NDArray, stype: str):
+    if stype == "row_sparse":
+        return RowSparseNDArray(nd._data, None, nd._ctx)
+    if stype == "csr":
+        if nd._data.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        return CSRNDArray(nd._data, None, None, nd._ctx)
+    raise MXNetError(f"unknown stype {stype}")

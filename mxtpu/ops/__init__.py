@@ -1,0 +1,11 @@
+"""Op registry package — single source of truth for operator metadata.
+
+TPU-native analogue of NNVM's op registry (``3rdparty/tvm/nnvm/``†,
+SURVEY.md §2.1-N3): op descriptors with typed params whose lowering target
+is XLA HLO via jax rules.
+"""
+from .params import Param, ParamSet
+from .registry import Op, OP_REGISTRY, get_op, list_ops, register_op
+
+__all__ = ["Param", "ParamSet", "Op", "OP_REGISTRY", "get_op", "list_ops",
+           "register_op"]

@@ -1,0 +1,88 @@
+"""Operator registry — the single source of truth for ops.
+
+Re-design of the reference's NNVM op registry (``nnvm::Op`` with attributes
+``FCompute``/``FInferShape``/``FGradient``…, registered per-op via
+``NNVM_REGISTER_OP`` across ``src/operator/``†).  The TPU-native difference:
+an op's "FCompute" is a *lowering rule* — a pure jax function from arrays to
+arrays.  Shape/dtype inference falls out of ``jax.eval_shape`` on the same
+rule (one definition serves eager, symbolic, and jit paths), and gradients
+fall out of jax AD instead of hand-written FGradient passes.
+
+Every op registered here is automatically exposed:
+  * eagerly  as ``mxtpu.nd.<name>``   (NDArray in/out, autograd-taped)
+  * lazily   as ``mxtpu.sym.<name>``  (Symbol graph nodes)
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..base import MXNetError, Registry
+from .params import Param, ParamSet
+
+__all__ = ["Op", "register_op", "get_op", "list_ops", "OP_REGISTRY", "Param"]
+
+
+@dataclass
+class Op:
+    """Op metadata + lowering rule.
+
+    fn: the jax lowering rule ``fn(*arrays, **resolved_params) -> array
+        or tuple of arrays``.  Must be pure & traceable (no data-dependent
+        python control flow) so it works under jit/vmap/grad.
+    num_inputs: -1 for variadic (list input ops like concat/add_n).
+    differentiable: ops like argmax/topk-indices get zero/None grads.
+    """
+    name: str
+    fn: Callable[..., Any]
+    params: ParamSet = field(default_factory=ParamSet)
+    num_inputs: int = 1
+    num_outputs: int = 1
+    differentiable: bool = True
+    grad_argnums: Optional[Tuple[int, ...]] = None
+    doc: str = ""
+    aliases: Tuple[str, ...] = ()
+
+    def resolve_params(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        return self.params.resolve(kwargs)
+
+    def infer(self, *avals, **kwargs):
+        """Shape/dtype inference via abstract evaluation — the role of the
+        reference's ``InferShape``/``InferType`` NNVM passes
+        (``src/executor/infer_graph_attr_pass.cc``†)."""
+        resolved = self.resolve_params(kwargs)
+        return jax.eval_shape(functools.partial(self.fn, **resolved), *avals)
+
+    def __call__(self, *arrays, **kwargs):
+        resolved = self.resolve_params(kwargs)
+        return self.fn(*arrays, **resolved)
+
+
+OP_REGISTRY: Registry[Op] = Registry("operator")
+
+
+def register_op(name: str, *, params: Sequence[Param] = (),
+                num_inputs: int = 1, num_outputs: int = 1,
+                differentiable: bool = True,
+                grad_argnums: Optional[Tuple[int, ...]] = None,
+                aliases: Sequence[str] = (), doc: str = ""):
+    """Decorator registering a lowering rule as a framework op."""
+    def _wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
+        op = Op(name=name, fn=fn, params=ParamSet(*params),
+                num_inputs=num_inputs, num_outputs=num_outputs,
+                differentiable=differentiable, grad_argnums=grad_argnums,
+                doc=doc or (fn.__doc__ or ""), aliases=tuple(aliases))
+        OP_REGISTRY.register(name, aliases=tuple(aliases))(op)
+        return fn
+    return _wrap
+
+
+def get_op(name: str) -> Op:
+    return OP_REGISTRY.get(name)
+
+
+def list_ops() -> List[str]:
+    return OP_REGISTRY.list()

@@ -1,0 +1,11 @@
+"""``mx.random`` — global seeding (reference ``python/mxnet/random.py``†).
+
+Delegates to the counter-based key streams in ``mxtpu.ndarray.random``."""
+from .ndarray.random import (seed, uniform, normal, randn, gamma,
+                             exponential, poisson, negative_binomial,
+                             generalized_negative_binomial, multinomial,
+                             shuffle, randint, bernoulli)
+
+__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "randint", "bernoulli"]

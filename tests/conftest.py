@@ -1,0 +1,38 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's trick of testing
+distributed paths with local multiprocess, SURVEY.md §4.5, maps to XLA's
+host-platform device-count flag).  Set MXTPU_TEST_PLATFORM=tpu to run the
+suite against the real chip instead (the check_consistency harness then
+compares cpu↔tpu).
+"""
+import os
+import sys
+
+# Must happen before the first real jax backend use.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if os.environ.get("MXTPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Per-test deterministic seeding — the reference's @with_seed()
+    (tests/python/unittest/common.py†). MXTPU_TEST_SEED overrides."""
+    import mxtpu
+    seed = int(os.environ.get("MXTPU_TEST_SEED",
+                              os.environ.get("MXNET_TEST_SEED", "42")))
+    np.random.seed(seed)
+    mxtpu.random.seed(seed)
+    yield

@@ -1,0 +1,199 @@
+"""Autograd tests — modeled on tests/python/unittest/test_autograd.py†."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2.0
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()),
+                               rtol=1e-6)
+
+
+def test_two_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy() + 1)
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3.0 * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_dot_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((3, 2)) @ b.asnumpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               a.asnumpy().T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2.0 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_pause_and_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 5.0  # not recorded
+        w = y + 1.0
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    assert z._tape is None
+
+    with autograd.record():
+        y = (x * x).detach() * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # d(cx)/dx = c = 4
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), [27.0])
+
+
+def test_nondiff_path():
+    x = nd.array([1.0, 5.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        i = nd.argmax(x)  # non-differentiable: no tape node
+        y = x * 2.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0, 2.0])
+    assert i._tape is None
+
+
+def test_getitem_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0] * 3.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[3.0, 3.0], [0.0, 0.0]])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_multi_output_split_grad():
+    x = nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=0)
+        y = (a * 2.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[2, 2], [0, 0]])
+
+
+def test_mark_variables():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 7.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_retain_graph_fresh_grads():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # not doubled
+
+
+def test_grad_api_preserves_dot_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    with autograd.record():
+        z = x * x
+    g = autograd.grad(z, x)
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])  # untouched

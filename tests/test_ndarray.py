@@ -1,0 +1,296 @@
+"""NDArray core tests — modeled on the reference's
+tests/python/unittest/test_ndarray.py†."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32 or str(a.dtype) == "int32"
+    z = nd.zeros((3, 4))
+    assert z.shape == (3, 4)
+    assert np.all(z.asnumpy() == 0)
+    o = nd.ones((2,), dtype="float32")
+    assert np.all(o.asnumpy() == 1)
+    f = nd.full((2, 2), 7.0)
+    assert np.all(f.asnumpy() == 7)
+    r = nd.arange(0, 10, 2)
+    assert np.array_equal(r.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arith():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 + a).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 ** a).asnumpy(), [[2, 4], [8, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    np.testing.assert_allclose(abs(-a).asnumpy(), a.asnumpy())
+
+
+def test_inplace_arith():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a < b).asnumpy(), [1, 0, 0])
+    np.testing.assert_allclose((a >= b).asnumpy(), [0, 1, 1])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[0, 1].shape == (4,)
+    assert a[0, 1, 2].asscalar() == 6
+    assert a[:, 1].shape == (2, 4)
+    assert a[0, 1:3].shape == (2, 4)
+    idx = nd.array(np.array([0, 1]), dtype="int32")
+    took = a[idx]
+    assert took.shape == (2, 3, 4)
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert a.asnumpy()[1, 1] == 5.0
+    a[0] = 2.0
+    assert np.all(a.asnumpy()[0] == 2.0)
+    a[:] = 1.0
+    assert np.all(a.asnumpy() == 1.0)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a.reshape(4, 3).shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape(-1).shape == (12,)
+    assert a.reshape(0, 2, 2).shape == (3, 2, 2)  # 0 = keep dim
+    assert a.T.shape == (4, 3)
+    assert a.transpose(1, 0).shape == (4, 3)
+    assert nd.expand_dims(a, axis=1).shape == (3, 1, 4)
+    assert nd.squeeze(nd.expand_dims(a, axis=0)).shape == (3, 4)
+    assert a.flatten().shape == (3, 4)
+    assert nd.ones((2, 3, 4)).flatten().shape == (2, 12)
+
+
+def test_reductions():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert a.sum().asscalar() == 66.0
+    np.testing.assert_allclose(a.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    np.testing.assert_allclose(a.mean(axis=1, keepdims=True).shape, (3, 1))
+    assert a.max().asscalar() == 11.0
+    assert a.min().asscalar() == 0.0
+    assert nd.sum(a, axis=1, exclude=True).shape == (4,)
+    assert a.argmax().asscalar() == 11
+    np.testing.assert_allclose(a.argmax(axis=1).asnumpy(), [3, 3, 3])
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    c = nd.dot(a, b)
+    np.testing.assert_allclose(c.asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    d = nd.dot(a, b, transpose_a=False, transpose_b=False)
+    assert d.shape == (3, 5)
+    bt = nd.array(np.random.rand(5, 4).astype(np.float32))
+    np.testing.assert_allclose(
+        nd.dot(a, bt, transpose_b=True).asnumpy(),
+        a.asnumpy() @ bt.asnumpy().T, rtol=1e-5)
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    np.testing.assert_allclose(parts[0].asnumpy(), a.asnumpy())
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert nd.broadcast_add(a, b).shape == (2, 4, 3)
+    assert nd.broadcast_to(nd.ones((1, 3)), shape=(5, 3)).shape == (5, 3)
+    assert nd.broadcast_maximum(a, b).shape == (2, 4, 3)
+
+
+def test_unary_math():
+    x = nd.array([0.5, 1.0, 2.0])
+    np.testing.assert_allclose(nd.exp(x).asnumpy(),
+                               np.exp(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.log(x).asnumpy(),
+                               np.log(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.sqrt(x).asnumpy(),
+                               np.sqrt(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(nd.sigmoid(x).asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+    np.testing.assert_allclose(nd.relu(nd.array([-1.0, 1.0])).asnumpy(),
+                               [0, 1])
+
+
+def test_take_embedding_onehot():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(w, idx)
+    np.testing.assert_allclose(t.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    e = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(e.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(idx, depth=4)
+    np.testing.assert_allclose(oh.asnumpy(),
+                               [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    i = nd.topk(x, k=1)
+    np.testing.assert_allclose(i.asnumpy(), [[0], [1]])
+    s = nd.sort(x)
+    np.testing.assert_allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+    a = nd.argsort(x)
+    np.testing.assert_allclose(a.asnumpy(), [[1, 2, 0], [0, 2, 1]])
+
+
+def test_where_clip_cast():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    np.testing.assert_allclose(nd.where(cond, x, y).asnumpy(), [1, 20, 3])
+    np.testing.assert_allclose(
+        nd.clip(nd.array([-2.0, 0.5, 9.0]), a_min=0.0, a_max=1.0).asnumpy(),
+        [0, 0.5, 1])
+    assert str(nd.cast(x, dtype="float16").data.dtype) == "float16"
+    assert str(x.astype("int32").data.dtype) == "int32"
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "test.params")
+    a = nd.array([[1.0, 2.0]])
+    b = nd.array([3.0])
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    np.testing.assert_allclose(loaded["a"].asnumpy(), a.asnumpy())
+    np.testing.assert_allclose(loaded["b"].asnumpy(), b.asnumpy())
+    nd.save(fname, [a, b])
+    lst = nd.load(fname)
+    assert isinstance(lst, list) and len(lst) == 2
+
+
+def test_wait_sync():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()  # must not raise
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100.0
+
+
+def test_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0) or b.context.device_type == "cpu"
+    c = a.copy()
+    c[0, 0] = 5
+    assert a.asnumpy()[0, 0] == 1.0
+
+
+def test_dtype_propagation():
+    a = nd.zeros((2,), dtype="float16")
+    assert str((a + a).data.dtype) == "float16"
+    b = nd.zeros((2,), dtype="bfloat16")
+    assert "bfloat16" in str(b.data.dtype)
+
+
+def test_norm_pad_tile():
+    x = nd.array([[3.0, 4.0]])
+    np.testing.assert_allclose(nd.norm(x).asnumpy(), [5.0], rtol=1e-6)
+    p = nd.pad(nd.ones((1, 1, 2, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=0.0)
+    assert p.shape == (1, 1, 4, 4)
+    t = nd.tile(nd.array([1.0, 2.0]), reps=(2, 2))
+    assert t.shape == (2, 4)
+
+
+def test_slice_ops():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    s = nd.slice(x, begin=(0, 1), end=(2, 3))
+    assert s.shape == (2, 2, 4)
+    sa = nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert sa.shape == (2, 3, 2)
+    sl = nd.slice_like(nd.ones((4, 4)), nd.ones((2, 3)))
+    assert sl.shape == (2, 3)
+
+
+def test_gather_scatter():
+    data = nd.array(np.arange(9, dtype=np.float32).reshape(3, 3))
+    indices = nd.array([[0, 2], [1, 0]], dtype="int32")
+    g = nd.gather_nd(data, indices)
+    np.testing.assert_allclose(g.asnumpy(), [1.0, 6.0])
+    s = nd.scatter_nd(nd.array([9.0, 8.0]), indices, shape=(3, 3))
+    assert s.asnumpy()[0, 1] == 9.0 and s.asnumpy()[2, 0] == 8.0
+
+
+def test_sequence_ops():
+    data = nd.array(np.ones((4, 2, 3), dtype=np.float32))
+    seq_len = nd.array([2.0, 3.0])
+    masked = nd.SequenceMask(data, seq_len, use_sequence_length=True,
+                             value=0.0)
+    out = masked.asnumpy()
+    assert np.all(out[:2, 0] == 1) and np.all(out[2:, 0] == 0)
+    assert np.all(out[:3, 1] == 1) and np.all(out[3:, 1] == 0)
+    last = nd.SequenceLast(data, seq_len, use_sequence_length=True)
+    assert last.shape == (2, 3)
+
+
+def test_sequence_defaults_and_axis():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+    # use_sequence_length=False => identity mask / plain flip
+    np.testing.assert_allclose(nd.SequenceMask(data).asnumpy(),
+                               data.asnumpy())
+    rev = nd.SequenceReverse(data)
+    np.testing.assert_allclose(rev.asnumpy(), data.asnumpy()[::-1])
+    # axis=1 sequence reverse with lengths
+    d2 = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    sl = nd.array([2.0, 3.0])
+    r2 = nd.SequenceReverse(d2, sl, use_sequence_length=True, axis=1)
+    np.testing.assert_allclose(r2.asnumpy(), [[1, 0, 2], [5, 4, 3]])
+
+
+def test_sort_descending_uint8():
+    x = nd.array(np.array([0, 5, 3], np.uint8))
+    s = nd.sort(x, is_ascend=False)
+    np.testing.assert_allclose(s.asnumpy(), [5, 3, 0])
+
+
+def test_load_single_is_list(tmp_path):
+    f = str(tmp_path / "one.params")
+    nd.save(f, [nd.array([1.0, 2.0])])
+    out = nd.load(f)
+    assert isinstance(out, list) and len(out) == 1
+
+
+def test_optimizer_lr_required():
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError):
+        nd.sgd_update(nd.ones((2,)), nd.ones((2,)))
