@@ -1,0 +1,499 @@
+"""Gluon Block / HybridBlock — the imperative API and its JIT boundary.
+
+Reference: ``python/mxnet/gluon/block.py``† (Block, HybridBlock whose
+``hybridize()`` builds a ``CachedOp``, ``src/imperative/cached_op.cc``†).
+
+TPU-native: ``hybridize()`` makes the block's forward trace ONCE per
+(input shapes/dtypes, train-flag) into a jitted function over
+(param arrays, input arrays, rng key) — i.e. the CachedOp becomes an XLA
+executable cache keyed the way the reference's bucketed executors were.
+Under ``autograd.record`` a hybridized call contributes a single tape
+node whose vjp is the transposed XLA program, so fwd+bwd are two compiled
+executables instead of per-op dispatch (SURVEY.md §3.2 call stack).
+
+Mutable layer state (BatchNorm running stats) flows through an aux-update
+channel: during a traced call layers emit (param, new_value) pairs that
+become extra jit outputs written back after the call — replacing the
+reference's in-op aux mutation (FMutateInputs).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import autograd
+from .. import ndarray as nd_mod
+from ..ndarray import random as _rnd
+from ..ndarray.ndarray import NDArray
+from .parameter import (Parameter, ParameterDict, Constant,
+                        DeferredInitializationError)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "_flatten_args"]
+
+_NAME_COUNTERS: Dict[str, int] = {}
+_NAME_LOCK = threading.Lock()
+
+
+def _gen_prefix(hint: str) -> str:
+    with _NAME_LOCK:
+        idx = _NAME_COUNTERS.get(hint, 0)
+        _NAME_COUNTERS[hint] = idx + 1
+    return f"{hint}{idx}_"
+
+
+# ----------------------------------------------------------------------
+# trace-time parameter substitution (lets nested blocks and user code that
+# calls Parameter.data() see traced values during hybridized execution)
+# ----------------------------------------------------------------------
+class _TraceState(threading.local):
+    def __init__(self):
+        self.param_sub: Optional[Dict[int, NDArray]] = None
+        self.aux_sink: Optional[List[Tuple[Parameter, NDArray]]] = None
+
+
+_TRACE = _TraceState()
+
+
+def _param_lookup(param: Parameter) -> Optional[NDArray]:
+    sub = _TRACE.param_sub
+    if sub is not None:
+        return sub.get(id(param))
+    return None
+
+
+def _emit_aux_update(param: Parameter, value: NDArray) -> None:
+    """BatchNorm-style running-stat update; buffered during trace,
+    immediate otherwise."""
+    if _TRACE.aux_sink is not None:
+        _TRACE.aux_sink.append((param, value))
+    else:
+        param._data._data = value.data \
+            if isinstance(value, NDArray) else value
+
+
+# patch Parameter.data to consult the substitution map
+_orig_param_data = Parameter.data
+
+
+def _patched_data(self, ctx=None):
+    sub = _param_lookup(self)
+    if sub is not None:
+        return sub
+    return _orig_param_data(self, ctx)
+
+
+Parameter.data = _patched_data
+
+
+def _flatten_args(args):
+    flat, treedef = jax.tree_util.tree_flatten(args)
+    return flat, treedef
+
+
+class Block:
+    """Base imperative building block (reference ``gluon.Block``†)."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 params: Optional[ParameterDict] = None):
+        cls = type(self).__name__.lower()
+        self._prefix = prefix if prefix is not None else _gen_prefix(cls)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+
+    # -- attribute registration ---------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    # -- naming / params ----------------------------------------------
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        class _NS:
+            def __enter__(s):
+                return s
+
+            def __exit__(s, *a):
+                return None
+        return _NS()
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All params of self + descendants, optionally regex-filtered
+        (reference semantics)."""
+        out = ParameterDict(self._params.prefix)
+        pattern = re.compile(select) if select else None
+
+        def visit(b: Block):
+            for k, v in b._params.items():
+                if pattern is None or pattern.match(k):
+                    if k not in out:
+                        out._params[k] = v
+            for c in b._children.values():
+                visit(c)
+        visit(self)
+        return out
+
+    # structural parameter map for save/load (stable across runs —
+    # the newer-gluon "structure based" naming)
+    def _collect_params_with_prefix(self, prefix: str = "") \
+            -> Dict[str, Parameter]:
+        if prefix:
+            prefix += "."
+        out: Dict[str, Parameter] = {}
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            out.update(child._collect_params_with_prefix(prefix + cname))
+        return out
+
+    # -- lifecycle ------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already covered by collect_params
+        if hasattr(self, "_dtype"):
+            self._dtype = dtype
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    # -- persistence ----------------------------------------------------
+    def save_parameters(self, filename: str) -> None:
+        params = self._collect_params_with_prefix()
+        arrays = {k: p.data() for k, p in params.items()
+                  if p._data is not None}
+        nd_mod.save(filename, arrays)
+
+    def load_parameters(self, filename: str, ctx=None,
+                        allow_missing: bool = False,
+                        ignore_extra: bool = False,
+                        cast_dtype: bool = False) -> None:
+        loaded = nd_mod.load(filename)
+        params = self._collect_params_with_prefix()
+        if not isinstance(loaded, dict):
+            raise MXNetError("invalid parameter file")
+        for k, p in params.items():
+            if k in loaded:
+                p.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"missing parameter {k} in {filename}")
+        extra = set(loaded) - set(params)
+        if extra and not ignore_extra:
+            raise MXNetError(f"extra parameters in file: {sorted(extra)}")
+
+    # legacy aliases (reference deprecated save_params/load_params)
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- call -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active: bool = True, **kwargs):
+        """No-op on plain Blocks except propagation (reference parity)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        from ..visualization import summary as _summary
+        return _summary(self, *inputs)
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}("]
+        for key, child in self._children.items():
+            mod = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({key}): {mod}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class HybridBlock(Block):
+    """Block that can be traced into cached XLA executables."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._flags: Dict[str, Any] = {}
+        self._cached_entries: Dict[Any, Dict[str, Any]] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_entries.clear()
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    # children of a hybridized top block execute inside the parent's
+    # trace; their own __call__ must stay imperative then.
+    def __call__(self, *args, **kwargs):
+        if self._active and _TRACE.param_sub is None \
+                and not kwargs and args \
+                and all(isinstance(a, NDArray) for a in
+                        jax.tree_util.tree_leaves(args)):
+            return self._call_cached(*args)
+        return super().__call__(*args, **kwargs)
+
+    # -- imperative dispatch: hybrid_forward(F, x, **param_values) ------
+    def forward(self, *args, **kwargs):
+        self._ensure_init(*args)
+        pvals = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, *args, **pvals, **kwargs)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement hybrid_forward or "
+            f"override forward")
+
+    # -- deferred shape inference --------------------------------------
+    def infer_shape(self, *args) -> None:
+        """Layer-specific parameter shape inference from inputs; layers
+        with input-dependent param shapes override _infer_params."""
+        self._infer_params(*args)
+
+    def _infer_params(self, *args) -> None:
+        return None
+
+    def _ensure_init(self, *args) -> None:
+        deferred = [p for p in self._reg_params.values()
+                    if p._data is None and p._deferred_init_args is not None]
+        if deferred:
+            self._infer_params(*args)
+            for p in deferred:
+                p._finish_deferred_init()
+
+    def _ensure_init_recursive(self, *args) -> bool:
+        """True if every param in the subtree is materialized."""
+        ok = True
+        for p in self.collect_params().values():
+            if p._data is None:
+                ok = False
+        return ok
+
+    # -- the JIT boundary ----------------------------------------------
+    def _call_cached(self, *args):
+        leaves, in_treedef = jax.tree_util.tree_flatten(args)
+        if not self._ensure_init_recursive():
+            # one imperative pass completes deferred shape inference
+            # (the reference runs graph InferShape; eager works too)
+            with autograd.pause():
+                self.forward(*args)
+            if not self._ensure_init_recursive():
+                raise DeferredInitializationError(
+                    f"{self.name}: parameters still deferred after a "
+                    f"shape-inference forward")
+
+        params = [p for p in self.collect_params().values()
+                  if p._data is not None]
+        training = autograd.is_training()
+        key = (in_treedef,
+               tuple((tuple(a.shape), str(a.data.dtype)) for a in leaves),
+               training, len(params))
+        entry = self._cached_entries.get(key)
+        if entry is None:
+            entry = self._build_cached(key, in_treedef, leaves, params,
+                                       training)
+            self._cached_entries[key] = entry
+
+        param_arrays = [p.data().data for p in params]
+        rng = _rnd._next_key(None)
+        flat_in = [a.data for a in leaves]
+
+        n_in = len(flat_in)
+        all_inputs = tuple(args if isinstance(args, tuple) else (args,))
+        nd_inputs = list(leaves) + [p.data() for p in params]
+
+        if autograd.is_recording() and any(
+                autograd._needs_grad(x) for x in nd_inputs):
+            raw_arrays = flat_in + param_arrays + [jax.random.key_data(rng)]
+            out, node = autograd.record_op(
+                f"CachedOp[{self.name}]", entry["flat_fn"],
+                nd_inputs + [NDArray(raw_arrays[-1], None, _placed=True)],
+                raw_arrays)
+            outs_flat = list(out[:entry["n_out"]])
+            aux_flat = list(out[entry["n_out"]:])
+            wrapped = []
+            for i, o in enumerate(outs_flat):
+                w = NDArray(o, None, _placed=True)
+                autograd.attach_output(w, node, i)
+                wrapped.append(w)
+        else:
+            out = entry["flat_fn"](*flat_in, *param_arrays,
+                                   jax.random.key_data(rng))
+            outs_flat = list(out[:entry["n_out"]])
+            aux_flat = list(out[entry["n_out"]:])
+            wrapped = [NDArray(o, None, _placed=True) for o in outs_flat]
+
+        # write back aux (running stats) updates
+        for p, new in zip(entry["aux_params"], aux_flat):
+            p._data._data = new
+
+        result = jax.tree_util.tree_unflatten(entry["out_treedef"], wrapped)
+        return result
+
+    def _build_cached(self, key, in_treedef, leaves, params, training):
+        """Trace self.forward into a jitted flat function."""
+        n_in = len(leaves)
+        n_p = len(params)
+        aux_params_order: List[Parameter] = []
+        out_treedef_box = {}
+
+        def raw_fn(*flat):
+            ins = flat[:n_in]
+            pvals = flat[n_in:n_in + n_p]
+            key_data = flat[n_in + n_p]
+            nd_ins = jax.tree_util.tree_unflatten(
+                in_treedef, [NDArray(a, None, _placed=True) for a in ins])
+            sub = {id(p): NDArray(v, None, _placed=True)
+                   for p, v in zip(params, pvals)}
+            prev_sub, prev_sink = _TRACE.param_sub, _TRACE.aux_sink
+            sink: List[Tuple[Parameter, NDArray]] = []
+            _TRACE.param_sub, _TRACE.aux_sink = sub, sink
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            provider = _rnd._TraceKeyProvider(
+                jax.random.wrap_key_data(key_data))
+            _rnd._push_trace_provider(provider)
+            try:
+                out = self.forward(*nd_ins)
+            finally:
+                _rnd._pop_trace_provider()
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+                _TRACE.param_sub, _TRACE.aux_sink = prev_sub, prev_sink
+            outs_flat, out_treedef = jax.tree_util.tree_flatten(out)
+            out_treedef_box["treedef"] = out_treedef
+            out_treedef_box["n_out"] = len(outs_flat)
+            aux_params_order.clear()
+            aux_vals = []
+            for p, v in sink:
+                aux_params_order.append(p)
+                aux_vals.append(v.data if isinstance(v, NDArray) else v)
+            raw_outs = [o.data if isinstance(o, NDArray) else o
+                        for o in outs_flat]
+            return tuple(raw_outs) + tuple(aux_vals)
+
+        flat_fn = jax.jit(raw_fn)
+        # force one trace now to learn output structure (compiles lazily
+        # on first real call; eval_shape avoids device work)
+        jax.eval_shape(raw_fn, *[a.data for a in leaves],
+                       *[p.data().data for p in params],
+                       jax.random.key_data(jax.random.PRNGKey(0)))
+        return {
+            "flat_fn": flat_fn,
+            "out_treedef": out_treedef_box["treedef"],
+            "n_out": out_treedef_box["n_out"],
+            "aux_params": list(aux_params_order),
+        }
+
+    # -- deployment -----------------------------------------------------
+    def export(self, path: str, epoch: int = 0):
+        """Serialize for deployment (reference writes -symbol.json +
+        -0000.params).  Writes the params file plus a json graph stub;
+        full symbol JSON round-trip lives in mxtpu.symbol."""
+        import json as _json
+        params = self._collect_params_with_prefix()
+        arrays = {("arg:" + k): p.data() for k, p in params.items()
+                  if p._data is not None}
+        nd_mod.save(f"{path}-{epoch:04d}.params", arrays)
+        meta = {
+            "nodes": [{"op": "null", "name": k} for k in params],
+            "mxtpu_export": type(self).__name__,
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            _json.dump(meta, f)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params as a block (reference ``SymbolBlock``†)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="symbolblock_")
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        if params:
+            for k, v in params.items():
+                self._params._params[k] = v
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        inputs = [sym.__class__.var(n) if isinstance(n, str) else n
+                  for n in input_names]
+        blk = SymbolBlock(sym, inputs)
+        if param_file:
+            loaded = nd_mod.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[-1]
+                p = Parameter(name, shape=v.shape)
+                p.set_data(v)
+                blk._params._params[name] = p
+        return blk
+
+    def forward(self, *args):
+        from ..symbol import _eval_symbol
+        bindings = {}
+        for inp, val in zip(self._inputs, args):
+            bindings[inp.name] = val
+        for name, p in self.collect_params().items():
+            if p._data is not None:
+                bindings[name] = p.data()
+        return _eval_symbol(self._outputs, bindings)

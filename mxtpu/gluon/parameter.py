@@ -1,0 +1,312 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py``† — deferred shape-inferred
+initialization, per-parameter ``grad_req``/``lr_mult``/``wd_mult``,
+ParameterDict with prefix namespacing and shared-param support.
+
+TPU-native deltas: a Parameter holds ONE NDArray (SPMD sharding replaces
+the reference's per-context replica list — ``list_ctx``/``list_data``
+return views for API parity), and its gradient buffer participates in the
+autograd tape as a leaf.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import initializer as init_mod
+from ..ndarray import ndarray as _nda
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "ParameterDict", "Constant",
+           "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when .data() is called before shapes are known."""
+
+
+class Parameter:
+    def __init__(self, name: str, grad_req: str = "write", shape=None,
+                 dtype="float32", lr_mult: float = 1.0, wd_mult: float = 1.0,
+                 init=None, allow_deferred_init: bool = False,
+                 differentiable: bool = True, stype: str = "default",
+                 grad_stype: str = "default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self.stype = stype
+        self.grad_stype = grad_stype
+        self._data: Optional[NDArray] = None
+        self._deferred_init_args = None
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str) -> None:
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req}")
+        self._grad_req = req
+        if self._data is not None:
+            self._data.attach_grad(req)
+
+    def _shape_is_known(self) -> bool:
+        return self.shape is not None and all(
+            s > 0 for s in self.shape)
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx: Optional[Context] = None,
+                   default_init=None, force_reinit: bool = False) -> None:
+        if self._data is not None and not force_reinit:
+            return
+        if not self._shape_is_known():
+            if self.allow_deferred_init:
+                self._deferred_init_args = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize parameter {self.name}: shape "
+                f"{self.shape} not fully known and deferred init not "
+                f"allowed")
+        self._do_init(init, ctx, default_init)
+
+    def _do_init(self, init, ctx, default_init) -> None:
+        ctx = ctx or current_context()
+        initializer = init_mod.create(
+            init if init is not None else
+            (self.init if self.init is not None else
+             (default_init if default_init is not None else "uniform")))
+        arr = _nda.zeros(self.shape, ctx=ctx, dtype=self.dtype)
+        initializer(init_mod.InitDesc(self.name), arr)
+        self._data = arr
+        self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, inferred_shape=None) -> None:
+        if self._data is not None:
+            return
+        if inferred_shape is not None:
+            if self.shape is not None:
+                merged = tuple(
+                    i if s in (0, -1, None) else s
+                    for s, i in zip(self.shape, inferred_shape))
+            else:
+                merged = tuple(inferred_shape)
+            self.shape = merged
+        if self._deferred_init_args is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} was never initialize()d")
+        init, ctx, default_init = self._deferred_init_args
+        if not self._shape_is_known():
+            raise MXNetError(
+                f"deferred init of {self.name} could not infer shape "
+                f"{self.shape}")
+        self._do_init(init, ctx, default_init)
+        self._deferred_init_args = None
+
+    # ------------------------------------------------------------------
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        if self._data is None:
+            if self._deferred_init_args is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred; run a forward pass "
+                    f"or call initialize() with a known shape")
+            raise MXNetError(
+                f"parameter {self.name} not initialized; call "
+                f".initialize() first")
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def list_ctx(self) -> List[Context]:
+        return [self.data().context]
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        d = self.data(ctx)
+        if d.grad is None:
+            raise MXNetError(
+                f"parameter {self.name} has grad_req='null'")
+        return d.grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def zero_grad(self) -> None:
+        if self._data is not None and self._data.grad is not None:
+            self._data.grad[:] = 0.0
+
+    def set_data(self, data) -> None:
+        nd_data = data if isinstance(data, NDArray) else _nda.array(data)
+        if self._data is None:
+            self.shape = nd_data.shape
+            self._data = nd_data.astype(self.dtype) \
+                if str(nd_data.data.dtype) != self.dtype else nd_data
+            self._data.attach_grad(self._grad_req)
+            self._deferred_init_args = None
+        else:
+            self._data._data = nd_data.astype(
+                str(self._data.data.dtype)).data
+
+    def cast(self, dtype) -> None:
+        self.dtype = dtype
+        if self._data is not None:
+            req = self._grad_req
+            self._data = self._data.astype(dtype)
+            self._data.attach_grad(req)
+
+    def reset_ctx(self, ctx) -> None:
+        if self._data is not None:
+            self._data = self._data.as_in_context(
+                ctx if isinstance(ctx, Context) else ctx[0])
+            self._data.attach_grad(self._grad_req)
+
+    def var(self):
+        from ..symbol import var
+        return var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-learnable constant parameter (reference ``gluon.Constant``†)."""
+
+    def __init__(self, name, value):
+        value = value if isinstance(value, NDArray) else _nda.array(value)
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.data.dtype),
+                         init=init_mod.Constant(0), differentiable=False)
+        self._value = value
+
+    def _do_init(self, init, ctx, default_init):
+        self._data = self._value.copy()
+        self._data.attach_grad("null")
+
+
+class ParameterDict:
+    """Prefix-namespaced dict of Parameters (reference
+    ``gluon.ParameterDict``†) with sharing support."""
+
+    def __init__(self, prefix: str = "", shared: Optional["ParameterDict"]
+                 = None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name) -> Parameter:
+        return self._params[name]
+
+    def __repr__(self):
+        lines = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{lines}\n)"
+
+    def get(self, name: str, **kwargs) -> Parameter:
+        """Get-or-create ``prefix+name`` (sharing consulted first)."""
+        full = self._prefix + name
+        if full in self._params:
+            param = self._params[full]
+            for k, v in kwargs.items():
+                if v is not None and getattr(param, k, None) in (None, 0):
+                    setattr(param, k, v)
+            return param
+        if self._shared is not None and full in self._shared:
+            param = self._shared[full]
+            self._params[full] = param
+            return param
+        param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name: str, value=None) -> Constant:
+        full = self._prefix + name
+        if full in self._params:
+            return self._params[full]
+        c = Constant(full, value)
+        self._params[full] = c
+        return c
+
+    def update(self, other: "ParameterDict") -> None:
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"parameter name clash on {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self._params.values():
+            p.zero_grad()
+
+    def setattr(self, name, value) -> None:
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx) -> None:
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    # ------------------------------------------------------------------
+    def save(self, filename: str, strip_prefix: str = "") -> None:
+        arg = {}
+        for name, p in self._params.items():
+            if p._data is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
+                else name
+            arg[key] = p.data()
+        _nda.save(filename, arg)
+
+    def load(self, filename: str, ctx=None, allow_missing: bool = False,
+             ignore_extra: bool = False, restore_prefix: str = "") -> None:
+        loaded = _nda.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError("parameter file must hold a name->array dict")
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(
+                    f"file {filename} has extra parameters {sorted(extra)}")

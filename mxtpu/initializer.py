@@ -1,0 +1,236 @@
+"""Weight initializers (reference ``python/mxnet/initializer.py``†).
+
+Registry + JSON-string serialization kept because the reference serializes
+initializers into kvstore init and symbol attrs.  Sampling uses the global
+counter-based RNG streams (mxtpu.ndarray.random)."""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import ndarray as _nda
+from .ndarray import random as _rnd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create", "InitDesc"]
+
+_REGISTRY: Registry = Registry("initializer")
+
+
+def register(klass):
+    _REGISTRY.register(klass.__name__)(klass)
+    return klass
+
+
+def create(init, **kwargs) -> "Initializer":
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, str):
+        # accept plain names and the reference's JSON form '["xavier", {}]'
+        if init.startswith("["):
+            name, kw = json.loads(init)
+            return _REGISTRY.get(name)(**kw)
+        return _REGISTRY.get(init)(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers (reference
+    ``initializer.InitDesc``†)."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray) -> None:
+        self.init_weight(desc, arr)
+
+    def init_weight(self, name: str, arr: NDArray) -> None:
+        # name-based dispatch like the reference's default flow
+        if name.endswith("gamma"):
+            arr[:] = 1.0
+        elif name.endswith("beta") or name.endswith("bias") or \
+                name.endswith("running_mean") or name.endswith("moving_mean"):
+            arr[:] = 0.0
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            arr[:] = 1.0
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name: str, arr: NDArray) -> None:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale: float = 0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._data = _rnd.uniform(-self.scale, self.scale,
+                                 shape=arr.shape,
+                                 dtype=str(arr.data.dtype))._data
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma: float = 0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._data = _rnd.normal(0.0, self.sigma, shape=arr.shape,
+                                dtype=str(arr.data.dtype))._data
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference default for conv/dense in examples)."""
+
+    def __init__(self, rnd_type: str = "uniform", factor_type: str = "avg",
+                 magnitude: float = 3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier requires ndim>=2, got shape {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(np.prod(shape[2:]))
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._data = _rnd.uniform(-scale, scale, shape=shape,
+                                     dtype=str(arr.data.dtype))._data
+        else:
+            arr._data = _rnd.normal(0, scale, shape=shape,
+                                    dtype=str(arr.data.dtype))._data
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type: str = "avg", slope: float = 0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale: float = 1.414, rand_type: str = "uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._data = _nda.array(
+            self.scale * q.reshape(arr.shape).astype(np.float32))._data
+
+
+@register
+class Bilinear(Initializer):
+    """For UpSampling deconv weights."""
+
+    def _init_weight(self, name, arr):
+        weight = np.zeros(arr.shape, np.float32)
+        shape = arr.shape
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = _nda.array(weight)._data
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference ``initializer.LSTMBias``†)."""
+
+    def __init__(self, forget_bias: float = 1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = arr.shape[0] // 4
+        a = arr.asnumpy()
+        a[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._data = _nda.array(a)._data
+
+
+class Mixed:
+    """Pattern-based initializer mixing (reference ``Mixed``†)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers length mismatch")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name}")

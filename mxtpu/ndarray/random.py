@@ -30,6 +30,32 @@ _KEYS: Dict[str, jax.Array] = {}
 _DEFAULT_SEED = 0
 
 
+class _TraceKeyProvider:
+    """During a hybridized trace, RNG keys derive from a traced input key
+    (fold_in with a per-trace counter) instead of the global stream, so
+    each compiled call sees fresh randomness from its key argument."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+    def next(self):
+        k = jax.random.fold_in(self.base_key, self.counter)
+        self.counter += 1
+        return k
+
+
+_TRACE_PROVIDERS: list = []
+
+
+def _push_trace_provider(p: _TraceKeyProvider) -> None:
+    _TRACE_PROVIDERS.append(p)
+
+
+def _pop_trace_provider() -> None:
+    _TRACE_PROVIDERS.pop()
+
+
 def _ctx_key(ctx: Optional[Context]) -> str:
     ctx = ctx or current_context()
     return f"{ctx.device_type}:{ctx.device_id}"
@@ -47,6 +73,8 @@ def seed(seed_state: int, ctx: str | Context = "all") -> None:
 
 
 def _next_key(ctx: Optional[Context] = None) -> jax.Array:
+    if _TRACE_PROVIDERS:
+        return _TRACE_PROVIDERS[-1].next()
     with _LOCK:
         k = _ctx_key(ctx)
         if k not in _KEYS:
@@ -56,7 +84,7 @@ def _next_key(ctx: Optional[Context] = None) -> jax.Array:
 
 
 def _next_key_nd(ctx: Optional[Context] = None) -> NDArray:
-    return NDArray(jax.random.key_data(_next_key(ctx)), None, _placed=True)
+    return NDArray(_next_key(ctx), None, _placed=True)
 
 
 def _wrap(arr, ctx) -> NDArray:
