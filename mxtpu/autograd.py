@@ -99,15 +99,19 @@ class TapeNode:
       ("node", TapeNode, out_idx) | ("leaf", NDArray) | None (constant)
     """
     __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "out_grads",
-                 "out_avals", "_visited")
+                 "out_avals", "out_is_tuple", "_visited")
 
-    def __init__(self, name, vjp_fn, parents, n_outputs, out_avals=None):
+    def __init__(self, name, vjp_fn, parents, n_outputs, out_avals=None,
+                 out_is_tuple=False):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.n_outputs = n_outputs
         self.out_grads: List[Optional[Any]] = [None] * n_outputs
         self.out_avals = out_avals or [None] * n_outputs
+        # jax.vjp cotangents must mirror the primal output structure: a
+        # 1-element tuple primal still needs a 1-element tuple cotangent
+        self.out_is_tuple = out_is_tuple
         self._visited = False
 
 
@@ -134,7 +138,8 @@ def record_op(name: str, fn: Callable, inputs: Sequence[Any],
             parents.append(None)
     outs_t = out if isinstance(out, tuple) else (out,)
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_t]
-    node = TapeNode(name, vjp_fn, parents, len(outs_t), avals)
+    node = TapeNode(name, vjp_fn, parents, len(outs_t), avals,
+                    out_is_tuple=isinstance(out, tuple))
     return out, node
 
 
@@ -173,9 +178,15 @@ def _toposort(roots: List[TapeNode]) -> List[TapeNode]:
 
 
 def backward(heads, head_grads=None, retain_graph: bool = False,
-             train_mode: bool = True) -> None:
+             train_mode: bool = True, _sink: Optional[dict] = None,
+             _watch: Optional[dict] = None) -> None:
     """Compute gradients of heads w.r.t. all attach_grad leaves reachable
-    on the tape (reference MXAutogradBackwardEx†)."""
+    on the tape (reference MXAutogradBackwardEx†).
+
+    _sink/_watch are internal hooks for ``grad()``: when _sink is given,
+    leaf gradients are collected into it (id(leaf) -> (leaf, grad)) and
+    ``.grad`` buffers are left untouched; _watch maps (id(node), out_idx)
+    -> cotangent for requested non-leaf variables."""
     from .ndarray.ndarray import NDArray
 
     heads = [heads] if isinstance(heads, NDArray) else list(heads)
@@ -207,23 +218,22 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     for node in reversed(order):
         if all(g is None for g in node.out_grads):
             continue
-        cotangents = []
-        # vjp_fn wants cotangents matching the primal output structure
-        primal_struct_multi = node.n_outputs > 1
-        for i in range(node.n_outputs):
-            g = node.out_grads[i]
-            cotangents.append(g)
-        # fill missing cotangents with zeros of the right aval
-        # (vjp output avals are recoverable from stored seeds only; use
-        #  lazy zeros via the vjp function's expected structure)
-        if primal_struct_multi:
+        # reversed-topological order means every consumer of this node has
+        # already run: out_grads are final here — snapshot watched ones
+        if _watch:
+            for i, g in enumerate(node.out_grads):
+                if g is not None and (id(node), i) in _watch:
+                    _watch[(id(node), i)] = g
+        # fill missing cotangents with zeros of the right aval; the
+        # cotangent structure must mirror the primal output structure
+        if node.out_is_tuple:
             ct = tuple(
                 c if c is not None else jnp.zeros(
                     node.out_avals[i].shape, node.out_avals[i].dtype)
-                for i, c in enumerate(cotangents))
+                for i, c in enumerate(node.out_grads))
             in_grads = node.vjp_fn(ct)
         else:
-            in_grads = node.vjp_fn(cotangents[0])
+            in_grads = node.vjp_fn(node.out_grads[0])
         for parent, ig in zip(node.parents, in_grads):
             if parent is None or ig is None:
                 continue
@@ -246,6 +256,10 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         # graph is retained, else a second backward accumulates stale
         # cotangents on top of fresh seeds.
         node.out_grads = [None] * node.n_outputs
+
+    if _sink is not None:
+        _sink.update(leaf_grads)
+        return
 
     for leaf, g in leaf_grads.values():
         if leaf._grad_req == "add" and leaf.grad is not None:
@@ -270,25 +284,29 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         raise MXNetError("create_graph=True not yet supported")
     variables = [variables] if isinstance(variables, NDArray) \
         else list(variables)
-    saved = [(v._grad_req, v.grad) for v in variables]
+    # gradients flow into a side map — no .grad buffer (of the requested
+    # variables OR of bystander leaves) is ever touched by this API
+    sink: dict = {}
+    watch: dict = {}
     for v in variables:
-        if v._grad_req == "null":
-            v._grad_req = "write"
-        v.grad = None
-    try:
-        backward(heads, head_grads, retain_graph=bool(retain_graph),
-                 train_mode=train_mode)
-        outs = []
-        for v in variables:
-            if v.grad is None:
-                raise MXNetError("some variables are unreachable from heads")
-            outs.append(v.grad)
-    finally:
-        # restore both pieces of caller-visible state — this API must
-        # not touch .grad
-        for v, (req, g) in zip(variables, saved):
-            v._grad_req = req
-            v.grad = g
+        if v._tape is not None:
+            node, idx = v._tape
+            watch[(id(node), idx)] = None
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode, _sink=sink, _watch=watch)
+    outs = []
+    for v in variables:
+        g = None
+        if v._tape is not None:
+            g = watch.get((id(v._tape[0]), v._tape[1]))
+        if g is None:
+            got = sink.get(id(v))
+            g = got[1] if got is not None else None
+        if g is None:
+            raise MXNetError(
+                "some variables are unreachable from the heads' graph; "
+                "mark them with attach_grad() before recording")
+        outs.append(NDArray(g, None, _placed=True))
     return outs[0] if len(outs) == 1 else outs
 
 
@@ -349,7 +367,7 @@ class Function:
             avals = [jax.ShapeDtypeStruct(o.shape, o.data.dtype)
                      for o in outs_t]
             node = TapeNode(type(self).__name__, _vjp_fn, parents,
-                            len(outs_t), avals)
+                            len(outs_t), avals, out_is_tuple=not single)
             for i, o in enumerate(outs_t):
                 attach_output(o, node, i)
         return outs if not single else outs_t[0]

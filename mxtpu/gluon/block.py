@@ -34,7 +34,7 @@ from .. import ndarray as nd_mod
 from ..ndarray import random as _rnd
 from ..ndarray.ndarray import NDArray
 from .parameter import (Parameter, ParameterDict, Constant,
-                        DeferredInitializationError)
+                        DeferredInitializationError, _TRACE)
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "_flatten_args"]
 
@@ -49,26 +49,8 @@ def _gen_prefix(hint: str) -> str:
     return f"{hint}{idx}_"
 
 
-# ----------------------------------------------------------------------
-# trace-time parameter substitution (lets nested blocks and user code that
-# calls Parameter.data() see traced values during hybridized execution)
-# ----------------------------------------------------------------------
-class _TraceState(threading.local):
-    def __init__(self):
-        self.param_sub: Optional[Dict[int, NDArray]] = None
-        self.aux_sink: Optional[List[Tuple[Parameter, NDArray]]] = None
-
-
-_TRACE = _TraceState()
-
-
-def _param_lookup(param: Parameter) -> Optional[NDArray]:
-    sub = _TRACE.param_sub
-    if sub is not None:
-        return sub.get(id(param))
-    return None
-
-
+# trace-time parameter substitution lives first-class in parameter._TRACE
+# (Parameter.data consults it natively — no monkey-patching)
 def _emit_aux_update(param: Parameter, value: NDArray) -> None:
     """BatchNorm-style running-stat update; buffered during trace,
     immediate otherwise."""
@@ -79,22 +61,14 @@ def _emit_aux_update(param: Parameter, value: NDArray) -> None:
             if isinstance(value, NDArray) else value
 
 
-# patch Parameter.data to consult the substitution map
-_orig_param_data = Parameter.data
-
-
-def _patched_data(self, ctx=None):
-    sub = _param_lookup(self)
-    if sub is not None:
-        return sub
-    return _orig_param_data(self, ctx)
-
-
-Parameter.data = _patched_data
+def _is_nd(x) -> bool:
+    return isinstance(x, NDArray)
 
 
 def _flatten_args(args):
-    flat, treedef = jax.tree_util.tree_flatten(args)
+    # NDArray is a registered pytree node: without is_leaf it dissolves
+    # into raw jax.Array leaves, which broke the CachedOp path entirely
+    flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
     return flat, treedef
 
 
@@ -283,10 +257,10 @@ class HybridBlock(Block):
     # trace; their own __call__ must stay imperative then.
     def __call__(self, *args, **kwargs):
         if self._active and _TRACE.param_sub is None \
-                and not kwargs and args \
-                and all(isinstance(a, NDArray) for a in
-                        jax.tree_util.tree_leaves(args)):
-            return self._call_cached(*args)
+                and not kwargs and args:
+            leaves = jax.tree_util.tree_leaves(args, is_leaf=_is_nd)
+            if leaves and all(isinstance(a, NDArray) for a in leaves):
+                return self._call_cached(*args)
         return super().__call__(*args, **kwargs)
 
     # -- imperative dispatch: hybrid_forward(F, x, **param_values) ------
@@ -327,7 +301,7 @@ class HybridBlock(Block):
 
     # -- the JIT boundary ----------------------------------------------
     def _call_cached(self, *args):
-        leaves, in_treedef = jax.tree_util.tree_flatten(args)
+        leaves, in_treedef = _flatten_args(args)
         if not self._ensure_init_recursive():
             # one imperative pass completes deferred shape inference
             # (the reference runs graph InferShape; eager works too)
@@ -354,8 +328,6 @@ class HybridBlock(Block):
         rng = _rnd._next_key(None)
         flat_in = [a.data for a in leaves]
 
-        n_in = len(flat_in)
-        all_inputs = tuple(args if isinstance(args, tuple) else (args,))
         nd_inputs = list(leaves) + [p.data() for p in params]
 
         if autograd.is_recording() and any(
@@ -416,7 +388,8 @@ class HybridBlock(Block):
                 autograd.set_training(prev_train)
                 autograd.set_recording(prev_rec)
                 _TRACE.param_sub, _TRACE.aux_sink = prev_sub, prev_sink
-            outs_flat, out_treedef = jax.tree_util.tree_flatten(out)
+            outs_flat, out_treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=_is_nd)
             out_treedef_box["treedef"] = out_treedef
             out_treedef_box["n_out"] = len(outs_flat)
             aux_params_order.clear()

@@ -12,8 +12,9 @@ autograd tape as a leaf.
 from __future__ import annotations
 
 import re
+import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..base import MXNetError
 from ..context import Context, current_context
@@ -23,6 +24,24 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["Parameter", "ParameterDict", "Constant",
            "DeferredInitializationError"]
+
+
+class _TraceState(threading.local):
+    """Trace-time parameter substitution (first-class, not monkey-patched).
+
+    While a HybridBlock trace is active, ``param_sub`` maps id(Parameter) →
+    traced NDArray so any ``Parameter.data()`` call inside the traced
+    forward sees the traced value; ``aux_sink`` buffers BatchNorm-style
+    running-stat updates emitted during the trace (they become extra jit
+    outputs written back after the call — replacing the reference's in-op
+    aux mutation, FMutateInputs†)."""
+
+    def __init__(self):
+        self.param_sub: Optional[Dict[int, NDArray]] = None
+        self.aux_sink: Optional[List[Tuple["Parameter", NDArray]]] = None
+
+
+_TRACE = _TraceState()
 
 
 class DeferredInitializationError(MXNetError):
@@ -116,6 +135,11 @@ class Parameter:
 
     # ------------------------------------------------------------------
     def data(self, ctx: Optional[Context] = None) -> NDArray:
+        sub = _TRACE.param_sub
+        if sub is not None:
+            traced = sub.get(id(self))
+            if traced is not None:
+                return traced
         if self._data is None:
             if self._deferred_init_args is not None:
                 raise DeferredInitializationError(

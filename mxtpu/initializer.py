@@ -24,9 +24,16 @@ __all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
 _REGISTRY: Registry = Registry("initializer")
 
 
-def register(klass):
-    _REGISTRY.register(klass.__name__)(klass)
-    return klass
+def register(klass=None, *, aliases=()):
+    """Register an initializer class under its name, lowercase name, and
+    any aliases (the reference registers ``Zero`` as ``'zeros'`` etc. —
+    ``python/mxnet/initializer.py``† ``@register`` + ``alias``)."""
+    def _do(k):
+        _REGISTRY.register(k.__name__, aliases=tuple(aliases))(k)
+        return k
+    if klass is not None:
+        return _do(klass)
+    return _do
 
 
 def create(init, **kwargs) -> "Initializer":
@@ -105,13 +112,13 @@ class Normal(Initializer):
                                 dtype=str(arr.data.dtype))._data
 
 
-@register
+@register(aliases=("zeros",))
 class Zero(Initializer):
     def _init_weight(self, name, arr):
         arr[:] = 0.0
 
 
-@register
+@register(aliases=("ones",))
 class One(Initializer):
     def _init_weight(self, name, arr):
         arr[:] = 1.0

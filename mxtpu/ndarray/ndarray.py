@@ -228,7 +228,10 @@ class NDArray:
     def _binop(self, other, opname, reverse=False):
         from . import _invoke_op
         if isinstance(other, (int, float, bool, np.number)):
-            other = NDArray(jnp.asarray(other, dtype=self._data.dtype))
+            # result_type promotion (python float vs int array must give a
+            # float op, e.g. int_array >= 1.5 — not truncate to >= 1)
+            other = NDArray(jnp.asarray(
+                other, dtype=jnp.result_type(self._data.dtype, other)))
         a, b = (other, self) if reverse else (self, other)
         return _invoke_op(opname, a, b)
 

@@ -900,6 +900,15 @@ register_op("BatchNorm", num_inputs=5, num_outputs=3,
             aliases=("batch_norm",))(_batch_norm)
 
 
+def _as_prng_key(key):
+    """Accept either a typed PRNG key (trace-time fold_in keys) or raw
+    uint32[2] key data (the eager global stream) — never a constant."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return key
+    return jax.random.wrap_key_data(
+        key.reshape((2,)).astype(jnp.uint32))
+
+
 def _dropout(x, key, p=0.5, mode="training", axes=()):
     if mode != "training" or p <= 0.0:
         return x
@@ -907,11 +916,7 @@ def _dropout(x, key, p=0.5, mode="training", axes=()):
     for ax in axes:
         shape[ax] = 1
     keep = 1.0 - p
-    mask = jax.random.bernoulli(
-        key.astype(jnp.uint32).reshape(2,) if key.dtype != jnp.uint32
-        else key.reshape(2,), keep, tuple(shape)) \
-        if key.ndim else jax.random.bernoulli(
-            jax.random.PRNGKey(0), keep, tuple(shape))
+    mask = jax.random.bernoulli(_as_prng_key(key), keep, tuple(shape))
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 

@@ -197,3 +197,44 @@ def test_grad_api_preserves_dot_grad():
     g = autograd.grad(z, x)
     np.testing.assert_allclose(g.asnumpy(), [6.0])
     np.testing.assert_allclose(x.grad.asnumpy(), [2.0])  # untouched
+
+
+def test_grad_does_not_touch_bystander_grads():
+    """Regression (ADVICE r1): autograd.grad must not overwrite .grad of
+    leaves that were not requested."""
+    a = mx.nd.array([1., 2., 3.])
+    a.attach_grad()
+    b = mx.nd.array([4., 5., 6.])
+    b.attach_grad()
+    with mx.autograd.record():
+        z = (a * b).sum()
+    z.backward()
+    b_before = b.grad.asnumpy().copy()
+    a_before = a.grad.asnumpy().copy()
+    with mx.autograd.record():
+        z2 = (a * b * 2).sum()
+    ga = mx.autograd.grad(z2, [a])
+    ga = ga if isinstance(ga, list) else [ga]
+    np.testing.assert_allclose(b.grad.asnumpy(), b_before)
+    np.testing.assert_allclose(a.grad.asnumpy(), a_before)
+    np.testing.assert_allclose(ga[0].asnumpy(), 2 * np.array([4., 5., 6.]))
+
+
+def test_grad_of_intermediate_variable():
+    a = mx.nd.array([1., 2., 3.])
+    a.attach_grad()
+    with mx.autograd.record():
+        m = a * 2
+        z = (m * m).sum()
+    gm = mx.autograd.grad(z, [m])
+    gm = gm if isinstance(gm, list) else [gm]
+    np.testing.assert_allclose(gm[0].asnumpy(), 2 * (2 * np.array([1., 2., 3.])))
+
+
+def test_scalar_promotion_comparison():
+    """Regression (ADVICE r1): int array vs fractional python scalar."""
+    ia = mx.nd.array(np.array([1, 2, 3], dtype="int32"))
+    np.testing.assert_array_equal((ia >= 1.5).asnumpy(),
+                                  [False, True, True])
+    r = (ia * 0.5).asnumpy()
+    np.testing.assert_allclose(r, [0.5, 1.0, 1.5])
