@@ -1,0 +1,28 @@
+"""``mxtpu.gluon`` — the imperative API with a JIT boundary
+(reference ``python/mxnet/gluon/``†).
+
+``HybridBlock.hybridize()`` compiles the forward (and, under
+``autograd.record``, the backward) into cached XLA executables — the
+TPU-native CachedOp (SURVEY.md §3.2).
+"""
+from .parameter import (Parameter, ParameterDict, Constant,
+                        DeferredInitializationError)
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+
+__all__ = ["Parameter", "ParameterDict", "Constant",
+           "DeferredInitializationError", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "utils", "rnn", "data",
+           "model_zoo", "contrib"]
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxtpu.gluon' has no attribute {name!r}")

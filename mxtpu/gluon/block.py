@@ -258,9 +258,14 @@ class HybridBlock(Block):
     def __call__(self, *args, **kwargs):
         if self._active and _TRACE.param_sub is None \
                 and not kwargs and args:
-            leaves = jax.tree_util.tree_leaves(args, is_leaf=_is_nd)
+            leaves, treedef = _flatten_args(args)
             if leaves and all(isinstance(a, NDArray) for a in leaves):
-                return self._call_cached(*args)
+                for hook in self._forward_pre_hooks:
+                    hook(self, args)
+                out = self._call_cached(args, leaves, treedef)
+                for hook in self._forward_hooks:
+                    hook(self, args, out)
+                return out
         return super().__call__(*args, **kwargs)
 
     # -- imperative dispatch: hybrid_forward(F, x, **param_values) ------
@@ -300,8 +305,7 @@ class HybridBlock(Block):
         return ok
 
     # -- the JIT boundary ----------------------------------------------
-    def _call_cached(self, *args):
-        leaves, in_treedef = _flatten_args(args)
+    def _call_cached(self, args, leaves, in_treedef):
         if not self._ensure_init_recursive():
             # one imperative pass completes deferred shape inference
             # (the reference runs graph InferShape; eager works too)

@@ -1,0 +1,372 @@
+"""Gluon basic neural-network layers.
+
+Reference: ``python/mxnet/gluon/nn/basic_layers.py``† (Dense, Dropout,
+BatchNorm, InstanceNorm, LayerNorm, Embedding, Flatten, Lambda,
+Sequential/HybridSequential).
+
+TPU-native notes: every layer is a thin parameter container whose
+``hybrid_forward`` calls registry ops (jax/lax lowering rules), so a
+hybridized net compiles into ONE XLA executable.  BatchNorm running-stat
+updates flow through the aux-update channel (extra jit outputs) instead
+of the reference's in-op aux mutation (``FMutateInputs``†).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import autograd
+from ..block import Block, HybridBlock, _emit_aux_update
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout",
+           "BatchNorm", "InstanceNorm", "LayerNorm", "Embedding",
+           "Flatten", "Lambda", "HybridLambda"]
+
+
+class Sequential(Block):
+    """Stacks Blocks sequentially (reference ``nn.Sequential``†)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            for l in layers[key]:
+                net.add(l)
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        """Plain Sequential only propagates (children may hybridize)."""
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Stacks HybridBlocks; hybridizes into one executable
+    (reference ``nn.HybridSequential``†)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def forward(self, x, *args):
+        # no own params; just chain children imperatively
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            for l in layers[key]:
+                net.add(l)
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer ``y = act(xW^T + b)``
+    (reference ``nn.Dense``† → ``FullyConnected`` op†)."""
+
+    def __init__(self, units, activation=None, use_bias=True,
+                 flatten=True, dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._units = units
+        self._flatten = flatten
+        self._act = activation
+        self.weight = self.params.get(
+            "weight", shape=(units, in_units), dtype=dtype,
+            init=weight_initializer, allow_deferred_init=True)
+        if use_bias:
+            self.bias = self.params.get(
+                "bias", shape=(units,), dtype=dtype,
+                init=bias_initializer, allow_deferred_init=True)
+        else:
+            self.bias = None
+
+    def _infer_params(self, x, *args):
+        if self.weight.shape and self.weight.shape[1] == 0:
+            in_units = int(np.prod(x.shape[1:])) if self._flatten \
+                else int(x.shape[-1])
+            self.weight.shape = (self._units, in_units)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if bias is None:
+            out = F.FullyConnected(x, weight, no_bias=True,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
+        else:
+            out = F.FullyConnected(x, weight, bias,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} "
+                f"-> {self._units}, "
+                f"{'linear' if self._act is None else self._act})")
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference ``nn.Dropout``†); active only under
+    ``autograd.record(train_mode=True)``."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        if self._rate <= 0:
+            return x
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (reference ``nn.BatchNorm``† →
+    ``BatchNorm`` op†).  Running statistics update via the aux channel."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = self.params.get(
+            "gamma", shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if scale else "null")
+        self.beta = self.params.get(
+            "beta", shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if center else "null")
+        self.running_mean = self.params.get(
+            "running_mean", shape=(in_channels,),
+            init=running_mean_initializer, allow_deferred_init=True,
+            differentiable=False)
+        self.running_var = self.params.get(
+            "running_var", shape=(in_channels,),
+            init=running_variance_initializer, allow_deferred_init=True,
+            differentiable=False)
+
+    def _infer_params(self, x, *args):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            if p.shape and p.shape[0] == 0:
+                p.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        training = autograd.is_training()
+        use_global = self._use_global_stats or not training
+        out, mean, var = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._eps, momentum=self._momentum,
+            fix_gamma=not self._scale, use_global_stats=use_global,
+            axis=self._axis)
+        if training and not self._use_global_stats:
+            m = self._momentum
+            _emit_aux_update(self.running_mean,
+                             running_mean * m + mean * (1 - m))
+            _emit_aux_update(self.running_var,
+                             running_var * m + var * (1 - m))
+        return out
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, eps={self._eps}, "
+                f"momentum={self._momentum}, "
+                f"in_channels={self.gamma.shape[0] if self.gamma.shape else None})")
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (reference ``nn.InstanceNorm``†)."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = self.params.get(
+            "gamma", shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if scale else "null")
+        self.beta = self.params.get(
+            "beta", shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if center else "null")
+
+    def _infer_params(self, x, *args):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta):
+            if p.shape and p.shape[0] == 0:
+                p.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._eps)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (reference ``nn.LayerNorm``†); lowers to the
+    ``LayerNorm`` op, which uses the Pallas fused kernel on TPU when
+    shapes allow."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = self.params.get(
+            "gamma", shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if scale else "null")
+        self.beta = self.params.get(
+            "beta", shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True,
+            grad_req="write" if center else "null")
+
+    def _infer_params(self, x, *args):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta):
+            if p.shape and p.shape[0] == 0:
+                p.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._eps)
+
+
+class Embedding(HybridBlock):
+    """Index → dense vector lookup (reference ``nn.Embedding``† →
+    ``Embedding`` op†, a gather on TPU)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    """Flattens to (batch, -1) (reference ``nn.Flatten``†)."""
+
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    """Wraps a function as a Block (reference ``nn.Lambda``†)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+            if not hasattr(nd, function):
+                raise MXNetError(f"no such nd function {function}")
+            self._func = getattr(nd, function)
+            self._name = function
+        elif callable(function):
+            self._func = function
+            self._name = getattr(function, "__name__", "lambda")
+        else:
+            raise MXNetError("function must be str or callable")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"Lambda({self._name})"
+
+
+class HybridLambda(HybridBlock):
+    """Wraps a function as a HybridBlock (reference ``nn.HybridLambda``†)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix)
+        if isinstance(function, str):
+            self._func_name = function
+            self._func = None
+        elif callable(function):
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+        else:
+            raise MXNetError("function must be str or callable")
+
+    def hybrid_forward(self, F, *args):
+        if self._func is not None:
+            return self._func(F, *args)
+        return getattr(F, self._func_name)(*args)
+
+    def __repr__(self):
+        return f"HybridLambda({self._func_name})"

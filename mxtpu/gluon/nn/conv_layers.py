@@ -1,0 +1,345 @@
+"""Gluon convolution / pooling layers.
+
+Reference: ``python/mxnet/gluon/nn/conv_layers.py``† (Conv1D-3D,
+Conv1-3DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling, ReflectionPad2D).
+
+All lower to the ``Convolution``/``Deconvolution``/``Pooling`` registry
+ops — thin wrappers over ``lax.conv_general_dilated`` /
+``lax.reduce_window``, which XLA tiles onto the MXU / vector units.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+           "Conv2DTranspose", "Conv3DTranspose", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
+           "ReflectionPad2D"]
+
+
+def _to_tuple(v, n):
+    if isinstance(v, (tuple, list)):
+        if len(v) != n:
+            raise MXNetError(f"expected {n}-tuple, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _Conv(HybridBlock):
+    """Shared implementation for N-D convolution layers."""
+
+    _ndim = 2
+    _op = "Convolution"
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", output_padding=None,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        n = self._ndim
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _to_tuple(kernel_size, n)
+        self._strides = _to_tuple(strides, n)
+        self._padding = _to_tuple(padding, n)
+        self._dilation = _to_tuple(dilation, n)
+        self._groups = groups
+        self._layout = layout
+        self._act = activation
+        self._output_padding = (_to_tuple(output_padding, n)
+                                if output_padding is not None else None)
+        if self._op == "Convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0
+                      ) + self._kernel
+        else:  # Deconvolution: weight is (in, out//groups, *kernel)
+            wshape = (in_channels, channels // groups) + self._kernel
+        self.weight = self.params.get(
+            "weight", shape=wshape, init=weight_initializer,
+            allow_deferred_init=True)
+        if use_bias:
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True)
+        else:
+            self.bias = None
+
+    def _infer_params(self, x, *args):
+        c_axis = 1 if self._layout.startswith("NC") else -1
+        in_c = int(x.shape[c_axis])
+        w = self.weight
+        if w.shape and 0 in w.shape:
+            if self._op == "Convolution":
+                w.shape = (self._channels, in_c // self._groups) \
+                    + self._kernel
+            else:
+                w.shape = (in_c, self._channels // self._groups) \
+                    + self._kernel
+            self._in_channels = in_c
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op)
+        kwargs = dict(kernel=self._kernel, stride=self._strides,
+                      dilate=self._dilation, pad=self._padding,
+                      num_filter=self._channels, num_group=self._groups,
+                      layout=self._layout)
+        if self._op == "Deconvolution" and self._output_padding:
+            kwargs["adj"] = self._output_padding
+        if bias is None:
+            out = op(x, weight, no_bias=True, **kwargs)
+        else:
+            out = op(x, weight, bias, **kwargs)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels or None} -> "
+                f"{self._channels}, kernel_size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class Conv1D(_Conv):
+    """1-D convolution (reference ``nn.Conv1D``†)."""
+    _ndim = 1
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    """2-D convolution (reference ``nn.Conv2D``†)."""
+    _ndim = 2
+
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    """3-D convolution (reference ``nn.Conv3D``†)."""
+    _ndim = 3
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    """1-D transposed convolution (reference ``nn.Conv1DTranspose``†)."""
+    _ndim = 1
+    _op = "Deconvolution"
+
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    """2-D transposed convolution (reference ``nn.Conv2DTranspose``†)."""
+    _ndim = 2
+    _op = "Deconvolution"
+
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), output_padding=(0, 0), dilation=(1, 1),
+                 groups=1, layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    """3-D transposed convolution (reference ``nn.Conv3DTranspose``†)."""
+    _ndim = 3
+    _op = "Deconvolution"
+
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         output_padding=output_padding, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    _ndim = 2
+    _pool_type = "max"
+    _global = False
+
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 count_include_pad=True, layout=None, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        n = self._ndim
+        self._layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[n]
+        if not self._global:
+            self._kernel = _to_tuple(pool_size, n)
+            strides = strides if strides is not None else pool_size
+            self._strides = _to_tuple(strides, n)
+            self._padding = _to_tuple(padding, n)
+        self._ceil = ceil_mode
+        self._count_include_pad = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        if self._global:
+            return F.Pooling(x, pool_type=self._pool_type,
+                             global_pool=True, layout=self._layout)
+        return F.Pooling(x, kernel=self._kernel, pool_type=self._pool_type,
+                         stride=self._strides, pad=self._padding,
+                         count_include_pad=self._count_include_pad,
+                         layout=self._layout)
+
+    def __repr__(self):
+        if self._global:
+            return f"{type(self).__name__}()"
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pooling):
+    """Reference ``nn.MaxPool1D``†."""
+    _ndim = 1
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         layout=layout, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    """Reference ``nn.MaxPool2D``†."""
+    _ndim = 2
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         layout=layout, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    """Reference ``nn.MaxPool3D``†."""
+    _ndim = 3
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         layout=layout, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    """Reference ``nn.AvgPool1D``†."""
+    _ndim = 1
+    _pool_type = "avg"
+
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         count_include_pad, layout=layout, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    """Reference ``nn.AvgPool2D``†."""
+    _ndim = 2
+    _pool_type = "avg"
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         count_include_pad, layout=layout, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    """Reference ``nn.AvgPool3D``†."""
+    _ndim = 3
+    _pool_type = "avg"
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, ceil_mode,
+                         count_include_pad, layout=layout, **kwargs)
+
+
+class _GlobalPool(_Pooling):
+    _global = True
+
+    def __init__(self, layout=None, **kwargs):
+        super().__init__(None, None, None, layout=layout, **kwargs)
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    """Reference ``nn.GlobalMaxPool1D``†."""
+    _ndim = 1
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    """Reference ``nn.GlobalMaxPool2D``†."""
+    _ndim = 2
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    """Reference ``nn.GlobalMaxPool3D``†."""
+    _ndim = 3
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    """Reference ``nn.GlobalAvgPool1D``†."""
+    _ndim = 1
+    _pool_type = "avg"
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    """Reference ``nn.GlobalAvgPool2D``†."""
+    _ndim = 2
+    _pool_type = "avg"
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    """Reference ``nn.GlobalAvgPool3D``†."""
+    _ndim = 3
+    _pool_type = "avg"
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H and W (reference ``nn.ReflectionPad2D``†)."""
+
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        if isinstance(padding, int):
+            padding = (padding,) * 4  # (left, right, top, bottom)
+        self._padding = tuple(int(p) for p in padding)
+
+    def hybrid_forward(self, F, x):
+        l, r, t, b = self._padding
+        return F.pad(x, mode="reflect",
+                     pad_width=(0, 0, 0, 0, t, b, l, r))

@@ -102,12 +102,15 @@ class Parameter:
 
     def _do_init(self, init, ctx, default_init) -> None:
         ctx = ctx or current_context()
-        initializer = init_mod.create(
-            init if init is not None else
-            (self.init if self.init is not None else
-             (default_init if default_init is not None else "uniform")))
+        # parameter-specific init rides in InitDesc attrs so it bypasses
+        # the global initializer's name-suffix dispatch (reference gluon
+        # Parameter._init_impl† protocol)
+        specific = init if init is not None else self.init
+        global_init = init_mod.create(
+            default_init if default_init is not None else "uniform")
+        attrs = {"__init__": specific} if specific is not None else {}
         arr = _nda.zeros(self.shape, ctx=ctx, dtype=self.dtype)
-        initializer(init_mod.InitDesc(self.name), arr)
+        global_init(init_mod.InitDesc(self.name, attrs), arr)
         self._data = arr
         self._data.attach_grad(self._grad_req)
 

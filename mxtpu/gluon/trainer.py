@@ -1,0 +1,149 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py``†).
+
+Applies an Optimizer to a set of Parameters:
+``step(batch_size)`` = allreduce_grads (KVStore/in-graph psum when data
+parallel) + update (fused optimizer ops).  In SPMD mode the gradients
+are already globally reduced inside the compiled step (psum over the
+mesh), so ``_allreduce_grads`` is a no-op there — the KVStore facade
+(``mxtpu.kvstore``) documents the mapping from push/pull to in-graph
+collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "params must be a ParameterDict or list of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._optimizer_applied = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(
+                optimizer, param_dict=param_dict, **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Create the KVStore lazily on first step (reference behavior).
+        Types 'local'/'device' map to in-graph reduction; with one
+        device there is nothing to reduce."""
+        if self._kvstore_type in (None, "nccl") or self._kv_initialized:
+            self._kv_initialized = True
+            return
+        try:
+            from .. import kvstore as kv_mod
+            self._kvstore = kv_mod.create(self._kvstore_type)
+            if self._kvstore is not None and self._kvstore.num_devices <= 1:
+                self._kvstore = None
+        except (ImportError, MXNetError):
+            self._kvstore = None
+        self._kv_initialized = True
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # ------------------------------------------------------------------
+    def _check_grads(self):
+        missing = [p.name for p in self._params
+                   if p.grad_req != "null" and
+                   (p._data is None or p._data.grad is None)]
+        if missing:
+            raise MXNetError(
+                f"cannot step: parameters {missing} have no gradient; "
+                f"run forward+backward inside autograd.record() first")
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + update (reference ``Trainer.step``†)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._data is not None \
+                    and param._data.grad is not None:
+                self._kvstore.push(i, param.grad(), priority=-i)
+                self._kvstore.pull(i, param.grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        self._check_grads()
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            updater(i, param.grad(), param.data())
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        """Serialize updater states (reference ``Trainer.save_states``†)."""
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            data = f.read()
+        self._updaters[0].set_states(data)
+        self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {
+            i: p for i, p in enumerate(self._params)}

@@ -1,0 +1,102 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``†).
+
+``split_and_load`` keeps its reference signature but on TPU the fast path
+is SPMD: one global device-sharded array instead of a Python list of
+per-device copies.  ``split_and_load(..., even_split=True)`` returns the
+per-shard views the Trainer/KVStore API expects.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along ``batch_axis`` into ``num_slice`` pieces
+    (reference ``utils.split_data``†)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split axis {batch_axis} of size {size} into "
+            f"{num_slice} slices (set even_split=False)")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis,
+                                    begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split ``data`` across ``ctx_list`` (reference
+    ``utils.split_and_load``†)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale so the joint L2 norm ≤ max_norm (reference
+    ``utils.clip_global_norm``†).  Returns the pre-clip global norm."""
+    if not arrays:
+        raise MXNetError("arrays must be nonempty")
+    total = None
+    for a in arrays:
+        sq = nd.sum(nd.square(a))
+        total = sq if total is None else total + sq
+    total_norm = float(nd.sqrt(total).asscalar())
+    if check_isfinite and not np.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf found during clip_global_norm")
+        return total_norm
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a[:] = a * scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Verify a file's sha1 (reference ``utils.check_sha1``†)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest().startswith(sha1_hash)
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference ``utils.download``† — this build runs with zero egress;
+    only file:// URLs and already-present files are served."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[len("file://"):], fname)
+        return fname
+    raise MXNetError(
+        f"download({url!r}): no network access in this environment; "
+        f"place the file at {fname} manually")

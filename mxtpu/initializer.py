@@ -68,6 +68,15 @@ class Initializer:
         return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr: NDArray) -> None:
+        # A parameter-specific initializer rides in attrs['__init__'] and
+        # bypasses the name-suffix dispatch (reference gluon passes
+        # Parameter.init this way so e.g. bias_initializer='ones' wins
+        # over the default bias→zero rule).
+        if isinstance(desc, InitDesc):
+            specific = desc.attrs.get("__init__", "")
+            if specific:
+                create(specific)._init_weight(desc, arr)
+                return
         self.init_weight(desc, arr)
 
     def init_weight(self, name: str, arr: NDArray) -> None:

@@ -1,0 +1,486 @@
+"""Evaluation metrics (reference ``python/mxnet/metric.py``†).
+
+Metrics update on host from (label, pred) NDArray lists.  Note the
+reference's known TPU foot-gun: ``update()`` calls ``asnumpy()`` — a
+device sync per batch.  Keep metric updates OUT of the hot loop (or use
+a CompositeEvalMetric at epoch granularity) on real chips; SURVEY.md
+§5.5.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
+           "Caffe", "CustomMetric", "np", "create", "register"]
+
+_REGISTRY: Registry[type] = Registry("metric")
+
+
+def register(klass=None, *, aliases=()):
+    def _do(k):
+        _REGISTRY.register(k.__name__, aliases=(k.__name__.lower(),)
+                           + tuple(aliases))(k)
+        return k
+    return _do(klass) if klass is not None else _do
+
+
+def create(metric, *args, **kwargs) -> "EvalMetric":
+    """Reference ``metric.create``† — name / callable / list."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    try:
+        cls = _REGISTRY.get(str(metric))
+    except KeyError:
+        raise MXNetError(f"unknown metric {metric!r}")
+    return cls(*args, **kwargs)
+
+
+def _as_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    """Reference ``metric.check_label_shapes``†."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise MXNetError(
+            f"shape of labels {label_shape} does not match shape of "
+            f"predictions {pred_shape}")
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """Base metric (reference ``metric.EvalMetric``†)."""
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return f"EvalMetric: {dict(zip(*self.get()))}"
+
+    def get_config(self):
+        config = dict(self._kwargs)
+        config.update({"metric": type(self).__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label: Dict[str, Any], pred: Dict[str, Any]):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    """Manage several metrics at once (reference
+    ``CompositeEvalMetric``†)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            names.extend(_as_list(name))
+            values.extend(_as_list(value))
+        return names, values
+
+
+@register(aliases=("acc",))
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference ``metric.Accuracy``†)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if pred.ndim > label.ndim:
+                pred = _np.argmax(pred, axis=self.axis)
+            pred = pred.astype("int32").ravel()
+            label = label.astype("int32").ravel()
+            check_label_shapes(label, pred, shape=True)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register(aliases=("top_k_accuracy", "top_k_acc"))
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference ``metric.TopKAccuracy``†)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        if top_k <= 1:
+            raise MXNetError("top_k should be >1; use Accuracy otherwise")
+        self.name += f"_{top_k}"
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype("int32")
+            assert pred.ndim == 2, "TopKAccuracy expects 2-D predictions"
+            pred = _np.argpartition(pred.astype("float32"), -self.top_k,
+                                   axis=1)[:, -self.top_k:]
+            for j in range(self.top_k):
+                self.sum_metric += float(
+                    (pred[:, j].astype("int32") == label.ravel()).sum())
+            self.num_inst += len(label)
+
+
+@register(aliases=("f1_score",))
+class F1(EvalMetric):
+    """Binary F1 (reference ``metric.F1``†)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype("int32").ravel()
+            if pred.ndim > 1:
+                pred = _np.argmax(pred, axis=-1)
+            pred = pred.astype("int32").ravel()
+            if set(_np.unique(label)) - {0, 1}:
+                raise MXNetError("F1 supports binary classification only")
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        precision = self._tp / max(self._tp + self._fp, 1e-12)
+        recall = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        return (self.name, f1)
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        super().reset()
+
+
+@register
+class Perplexity(EvalMetric):
+    """exp(mean NLL) (reference ``metric.Perplexity``†)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label)
+            label = label.reshape(-1).astype("int64")
+            pred = pred.reshape(-1, pred.shape[-1])
+            probs = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = _np.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(_np.sum(_np.log(_np.maximum(1e-10, probs))))
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (reference ``metric.MAE``†)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (reference ``metric.MSE``†)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(((label - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (reference ``metric.RMSE``†)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(
+                _np.sqrt(((label - pred) ** 2).mean()))
+            self.num_inst += 1
+
+
+@register(aliases=("ce",))
+class CrossEntropy(EvalMetric):
+    """Cross entropy over class probabilities (reference
+    ``metric.CrossEntropy``†)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[_np.arange(label.shape[0]), label.astype("int64")]
+            self.sum_metric += float(
+                (-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register(aliases=("nll_loss",))
+class NegativeLogLikelihood(EvalMetric):
+    """NLL over class probabilities (reference
+    ``metric.NegativeLogLikelihood``†)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    update = CrossEntropy.update
+
+
+@register(aliases=("pearsonr",))
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (reference ``metric.PearsonCorrelation``†)."""
+
+    def __init__(self, name="pearsonr", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(_as_list(labels),
+                                           _as_list(preds), wrap=False)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred).ravel()
+            check_label_shapes(label, pred, shape=True)
+            self.sum_metric += float(_np.corrcoef(pred, label)[0, 1])
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of a loss output (reference ``metric.Loss``†)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            loss = float(_as_numpy(pred).sum())
+            self.sum_metric += loss
+            self.num_inst += _as_numpy(pred).size
+
+
+@register
+class Torch(Loss):
+    """Legacy alias (reference ``metric.Torch``†)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Legacy alias (reference ``metric.Caffe``†)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wrap ``feval(label, pred) -> float`` (reference
+    ``metric.CustomMetric``†)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(" + name + ")"
+        super().__init__(name, output_names, label_names,
+                         feval=feval, allow_extra_outputs=allow_extra_outputs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(_as_list(labels),
+                                               _as_list(preds), wrap=False)
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create a CustomMetric from a numpy function (reference
+    ``metric.np``†)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

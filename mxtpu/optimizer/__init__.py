@@ -1,0 +1,5 @@
+"""``mxtpu.optimizer`` (reference ``python/mxnet/optimizer.py``† +
+``lr_scheduler.py``†)."""
+from .optimizer import *          # noqa: F401,F403
+from .optimizer import Optimizer, Updater, get_updater, register, create
+from . import lr_scheduler        # noqa: F401

@@ -1,0 +1,603 @@
+"""Optimizer registry + Updater (reference ``python/mxnet/optimizer.py``†).
+
+The reference's design — "optimizers are ops" (``src/operator/
+optimizer_op.cc``†) — is kept: each ``update()`` dispatches to a fused
+registry op (``sgd_update``/``adam_update``/…) which is a single XLA
+kernel; under a hybridized Trainer step the whole update fuses into the
+training executable.  States are NDArrays rebound functionally instead of
+mutated in place.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError, Registry
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "Updater", "get_updater", "register", "create",
+           "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "Adamax", "Nadam",
+           "RMSProp", "Ftrl", "Signum", "SGLD", "LBSGD", "Test"]
+
+_REGISTRY: Registry["type"] = Registry("optimizer")
+
+
+def register(klass):
+    """Register an Optimizer subclass under its (lowercased) name
+    (reference ``Optimizer.register``†)."""
+    _REGISTRY.register(klass.__name__, aliases=(klass.__name__.lower(),))(
+        klass)
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    try:
+        cls = _REGISTRY.get(name)
+    except KeyError:
+        raise MXNetError(f"unknown optimizer {name!r}; "
+                         f"choices: {sorted(_REGISTRY._entries)}")
+    return cls(**kwargs)
+
+
+def _assign(dst: NDArray, src: NDArray) -> None:
+    """Rebind dst's buffer to the functionally-updated value."""
+    dst._data = src._data if isinstance(src, NDArray) else src
+
+
+class Optimizer:
+    """Base optimizer (reference ``mx.optimizer.Optimizer``†).
+
+    Tracks per-parameter update counts for lr scheduling, applies
+    ``lr_mult``/``wd_mult`` (by index or name), and delegates the math to
+    fused update ops.
+    """
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = dict(param_dict or {})
+        self.multi_precision = multi_precision
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+
+    # -- registry passthroughs (reference API) -------------------------
+    create_optimizer = staticmethod(create)
+
+    # -- state ----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    # -- hyperparams -----------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference default: no decay on biases and norm params
+            if n.endswith("_weight") or n.endswith("_gamma"):
+                continue
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) \
+            if self.lr_scheduler is not None else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _clip(self):
+        return self.clip_gradient if self.clip_gradient else -1.0
+
+
+@register
+class SGD(Optimizer):
+    """(Momentum) SGD → ``sgd_update``/``sgd_mom_update`` ops
+    (reference ``optimizer.SGD``†)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=str(weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _assign(weight, nd.sgd_update(
+                weight, grad, lr=lr, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip()))
+        else:
+            w, m = nd.sgd_mom_update(
+                weight, grad, state, lr=lr, momentum=self.momentum,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip())
+            _assign(weight, w)
+            _assign(state, m)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference ``optimizer.NAG``†)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=str(weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        if state is None:
+            _assign(weight, weight - lr * grad)
+        else:
+            m = self.momentum * state + grad
+            _assign(state, m)
+            _assign(weight, weight - lr * (grad + self.momentum * m))
+
+
+@register
+class Adam(Optimizer):
+    """Adam → ``adam_update`` op (reference ``optimizer.Adam``†)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        # bias correction folded into lr (reference does the same)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        w, m, v = nd.adam_update(
+            weight, grad, mean, var, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        _assign(weight, w)
+        _assign(mean, m)
+        _assign(var, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference ``optimizer.AdaGrad``†)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=str(weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if wd:
+            grad = grad + wd * weight
+        hist = state + nd.square(grad)
+        _assign(state, hist)
+        _assign(weight, weight - lr * grad /
+                nd.sqrt(hist + self.float_stable_eps))
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference ``optimizer.AdaDelta``†)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if wd:
+            grad = grad + wd * weight
+        acc_g, acc_delta = state
+        g2 = self.rho * acc_g + (1 - self.rho) * nd.square(grad)
+        delta = nd.sqrt(acc_delta + self.epsilon) / \
+            nd.sqrt(g2 + self.epsilon) * grad
+        d2 = self.rho * acc_delta + (1 - self.rho) * nd.square(delta)
+        _assign(acc_g, g2)
+        _assign(acc_delta, d2)
+        _assign(weight, weight - delta)
+
+
+@register
+class Adamax(Optimizer):
+    """Adamax, the inf-norm Adam variant (reference ``optimizer.Adamax``†)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if wd:
+            grad = grad + wd * weight
+        m, u = state
+        m_new = self.beta1 * m + (1 - self.beta1) * grad
+        u_new = nd.maximum(self.beta2 * u, nd.abs(grad))
+        _assign(m, m_new)
+        _assign(u, u_new)
+        _assign(weight, weight - lr * m_new / (u_new + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference ``optimizer.Nadam``†)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        if wd:
+            grad = grad + wd * weight
+        m_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        m_t1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                             ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * m_t
+        sched1 = self.m_schedule * m_t1
+        m, v = state
+        g_prime = grad / (1.0 - self.m_schedule)
+        m_new = self.beta1 * m + (1 - self.beta1) * grad
+        v_new = self.beta2 * v + (1 - self.beta2) * nd.square(grad)
+        m_prime = m_new / (1.0 - sched1)
+        v_prime = v_new / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - m_t) * g_prime + m_t1 * m_prime
+        _assign(m, m_new)
+        _assign(v, v_new)
+        _assign(weight, weight - lr * m_bar /
+                (nd.sqrt(v_prime) + self.epsilon))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (centered=False→Tieleman, True→Graves)
+    → ``rmsprop_update``/``rmspropalex_update`` ops
+    (reference ``optimizer.RMSProp``†)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        z = lambda: nd.zeros(weight.shape, ctx=weight.context,  # noqa:E731
+                             dtype=dtype)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if not self.centered:
+            (n,) = state
+            w, n_new = nd.rmsprop_update(
+                weight, grad, n, lr=lr, gamma1=self.gamma1,
+                epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip(),
+                clip_weights=self.clip_weights or -1.0)
+            _assign(weight, w)
+            _assign(n, n_new)
+        else:
+            n, g, delta = state
+            w, n2, g2, d2 = nd.rmspropalex_update(
+                weight, grad, n, g, delta, lr=lr, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip())
+            _assign(weight, w)
+            _assign(n, n2)
+            _assign(g, g2)
+            _assign(delta, d2)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal → ``ftrl_update`` op (reference ``optimizer.Ftrl``†)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        w, z2, n2 = nd.ftrl_update(
+            weight, grad, z, n, lr=lr, lamda1=self.lamda1, beta=self.beta,
+            wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self._clip())
+        _assign(weight, w)
+        _assign(z, z2)
+        _assign(n, n2)
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD/Signum → ``signsgd_update``/``signum_update`` ops
+    (reference ``optimizer.Signum``†)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=str(weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            _assign(weight, nd.signsgd_update(
+                weight, grad, lr=lr, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip()))
+        else:
+            w, m = nd.signum_update(
+                weight, grad, state, lr=lr, momentum=self.momentum,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip(), wd_lh=self.wd_lh)
+            _assign(weight, w)
+            _assign(state, m)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference
+    ``optimizer.SGLD``†)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 ctx=weight.context)
+        _assign(weight, weight - lr / 2 * grad + noise)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling (reference
+    ``optimizer.LBSGD``†; here the warmup/LARS heuristics reduce to
+    momentum SGD — the multipliers matter on 8k+ batches only)."""
+
+
+@register
+class Test(Optimizer):
+    """Trivial test optimizer (reference ``optimizer.Test``†)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        _assign(weight, weight + grad * self.rescale_grad)
+        _assign(state, weight)
+
+
+# `ccSGD` was an alias of SGD by this era
+ccSGD = SGD
+_REGISTRY.register("ccSGD", aliases=("ccsgd",))(SGD)
+
+
+class Updater:
+    """Applies an optimizer with per-index states (reference
+    ``optimizer.Updater``† — the object a KVStore runs server-side)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced.get(index, True):
+            self.states[index] = self.sync_state_context(
+                self.states[index], weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(
+                self.sync_state_context(s, context) for s in state)
+        return state
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (+ optionally the optimizer) — reference
+        pickle protocol for Trainer.save_states / dist kvstore."""
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(x) for x in s)
+            return s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states_bytes):
+        data = pickle.loads(states_bytes)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            states, self.optimizer = data
+        else:
+            states = data
+
+        def to_nd(s):
+            if isinstance(s, np.ndarray):
+                return nd.array(s)
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_nd(x) for x in s)
+            return s
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    """Reference ``mx.optimizer.get_updater``†."""
+    return Updater(optimizer)

@@ -1,0 +1,334 @@
+"""Layer/loss/trainer tests (modelled on the reference's
+``tests/python/unittest/test_gluon.py``† and ``test_loss.py``†)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+from mxtpu.gluon import nn, loss as gloss, Trainer
+
+
+def test_dense_shapes_values():
+    layer = nn.Dense(4, in_units=3, use_bias=True,
+                     bias_initializer="ones")
+    layer.initialize(init="ones")
+    x = nd.array(np.ones((2, 3), np.float32))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    # W=1, b=1: out = 3*1 + 1 = 4
+    assert np.allclose(out.asnumpy(), 4.0)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(7)
+    layer.initialize()
+    out = layer(nd.array(np.random.randn(5, 3).astype(np.float32)))
+    assert out.shape == (5, 7)
+    assert layer.weight.shape == (7, 3)
+
+
+def test_dense_flatten_false():
+    layer = nn.Dense(6, flatten=False)
+    layer.initialize()
+    out = layer(nd.array(np.random.randn(2, 5, 4).astype(np.float32)))
+    assert out.shape == (2, 5, 6)
+
+
+def test_conv2d_against_numpy():
+    layer = nn.Conv2D(2, kernel_size=3, padding=1, in_channels=1)
+    layer.initialize(init="ones")
+    x = nd.array(np.ones((1, 1, 4, 4), np.float32))
+    out = layer(x)
+    assert out.shape == (1, 2, 4, 4)
+    # center pixels see the full 3x3 window of ones
+    assert np.allclose(out.asnumpy()[0, 0, 1:3, 1:3], 9.0)
+
+
+def test_conv_deferred_and_pool():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3), nn.MaxPool2D(2),
+            nn.GlobalAvgPool2D(), nn.Flatten())
+    net.initialize()
+    out = net(nd.array(np.random.randn(2, 3, 12, 12).astype(np.float32)))
+    assert out.shape == (2, 4)
+
+
+def test_conv1d_conv3d():
+    c1 = nn.Conv1D(3, kernel_size=3)
+    c1.initialize()
+    assert c1(nd.array(np.random.randn(2, 2, 8).astype(
+        np.float32))).shape == (2, 3, 6)
+    c3 = nn.Conv3D(2, kernel_size=2)
+    c3.initialize()
+    assert c3(nd.array(np.random.randn(1, 1, 4, 4, 4).astype(
+        np.float32))).shape == (1, 2, 3, 3, 3)
+
+
+def test_conv2d_transpose_shape():
+    layer = nn.Conv2DTranspose(3, kernel_size=4, strides=2, padding=1)
+    layer.initialize()
+    x = nd.array(np.random.randn(1, 2, 8, 8).astype(np.float32))
+    assert layer(x).shape == (1, 3, 16, 16)
+
+
+def test_batchnorm_train_and_running_stats():
+    layer = nn.BatchNorm(in_channels=3, momentum=0.5)
+    layer.initialize()
+    x = nd.array((np.random.randn(4, 3, 5, 5) * 3 + 1).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+    # normalized output: near zero mean / unit var per channel
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-2
+    rm = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0.0)  # stats updated
+    # inference uses running stats
+    out2 = layer(x)
+    assert not np.allclose(out2.asnumpy(), o)
+
+
+def test_batchnorm_hybrid_matches_imperative():
+    np.random.seed(0)
+    layer = nn.BatchNorm(in_channels=2)
+    layer.initialize()
+    x = nd.array(np.random.randn(3, 2, 4, 4).astype(np.float32))
+    with autograd.record():
+        ref = layer(x).asnumpy()
+    rm_imp = layer.running_mean.data().asnumpy().copy()
+    layer2 = nn.BatchNorm(in_channels=2)
+    layer2.initialize()
+    layer2.hybridize()
+    with autograd.record():
+        out = layer2(x).asnumpy()
+    assert np.allclose(ref, out, atol=1e-5)
+    assert np.allclose(rm_imp, layer2.running_mean.data().asnumpy(),
+                       atol=1e-6)
+
+
+def test_layernorm_embedding():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = nd.array(np.random.randn(2, 6).astype(np.float32))
+    o = ln(x).asnumpy()
+    assert np.allclose(o.mean(axis=-1), 0, atol=1e-5)
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array(np.array([[1, 2], [3, 4]], np.float32))
+    assert emb(idx).shape == (2, 2, 4)
+
+
+def test_activation_layers():
+    for layer, fn in [(nn.Activation("relu"), lambda v: np.maximum(v, 0)),
+                      (nn.LeakyReLU(0.1),
+                       lambda v: np.where(v > 0, v, 0.1 * v)),
+                      (nn.ELU(1.0),
+                       lambda v: np.where(v > 0, v, np.exp(v) - 1))]:
+        layer.initialize()
+        x = np.random.randn(3, 4).astype(np.float32)
+        assert np.allclose(layer(nd.array(x)).asnumpy(), fn(x),
+                           atol=1e-5), type(layer).__name__
+
+
+def test_prelu_swish_gelu_selu():
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    for layer in [nn.PReLU(), nn.Swish(), nn.GELU(), nn.SELU()]:
+        layer.initialize()
+        assert layer(x).shape == (2, 3)
+
+
+def test_dropout_layer():
+    layer = nn.Dropout(0.5)
+    layer.initialize()
+    x = nd.array(np.ones((50, 50), np.float32))
+    # inference: identity
+    assert np.allclose(layer(x).asnumpy(), 1.0)
+    with autograd.record():
+        y = layer(x).asnumpy()
+    assert (y == 0).any() and not (y == 0).all()
+
+
+def test_sequential_getitem_len():
+    net = nn.Sequential()
+    net.add(nn.Dense(3), nn.Dense(4), nn.Dense(5))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    net.initialize()
+    assert net(nd.array(np.ones((2, 2), np.float32))).shape == (2, 5)
+
+
+def test_lambda_blocks():
+    net = nn.HybridSequential()
+    net.add(nn.HybridLambda(lambda F, x: F.relu(x)),
+            nn.HybridLambda("exp"))
+    net.initialize()
+    x = nd.array(np.array([[-1.0, 2.0]], np.float32))
+    out = net(nd.array(np.array([[-1.0, 2.0]], np.float32)))
+    assert np.allclose(out.asnumpy(), np.exp(np.maximum([[-1, 2]], 0)),
+                       atol=1e-6)
+    lam = nn.Lambda("sigmoid")
+    assert np.allclose(lam(x).asnumpy(),
+                       1 / (1 + np.exp(np.array([[1.0, -2.0]]))),
+                       atol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# losses (numpy references, reference test_loss.py† style)
+# ---------------------------------------------------------------------
+def test_l2_l1_loss():
+    pred = np.random.randn(4, 5).astype(np.float32)
+    label = np.random.randn(4, 5).astype(np.float32)
+    l2 = gloss.L2Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert np.allclose(l2, 0.5 * ((pred - label) ** 2).mean(axis=1),
+                       atol=1e-6)
+    l1 = gloss.L1Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert np.allclose(l1, np.abs(pred - label).mean(axis=1), atol=1e-6)
+
+
+def test_softmax_ce_loss():
+    pred = np.random.randn(6, 10).astype(np.float32)
+    label = np.random.randint(0, 10, (6,)).astype(np.float32)
+    l = gloss.SoftmaxCrossEntropyLoss()(nd.array(pred), nd.array(label))
+    logp = pred - pred.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    ref = -logp[np.arange(6), label.astype(int)]
+    assert np.allclose(l.asnumpy(), ref, atol=1e-5)
+    # dense labels
+    onehot = np.eye(10, dtype=np.float32)[label.astype(int)]
+    l2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(pred), nd.array(onehot))
+    assert np.allclose(l2.asnumpy(), ref, atol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    pred = np.random.randn(4, 3).astype(np.float32)
+    label = np.random.randint(0, 2, (4, 3)).astype(np.float32)
+    l = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    ref = (np.maximum(pred, 0) - pred * label +
+           np.log1p(np.exp(-np.abs(pred)))).mean(axis=1)
+    assert np.allclose(l, ref, atol=1e-5)
+
+
+def test_huber_hinge_kl():
+    pred = np.random.randn(4, 3).astype(np.float32)
+    label = np.random.randn(4, 3).astype(np.float32)
+    h = gloss.HuberLoss(rho=1.0)(nd.array(pred), nd.array(label)).asnumpy()
+    err = np.abs(pred - label)
+    ref = np.where(err > 1, err - 0.5, 0.5 * err ** 2).mean(axis=1)
+    assert np.allclose(h, ref, atol=1e-5)
+
+    sign = np.sign(np.random.randn(4, 3)).astype(np.float32)
+    hi = gloss.HingeLoss()(nd.array(pred), nd.array(sign)).asnumpy()
+    assert np.allclose(hi, np.maximum(0, 1 - pred * sign).mean(axis=1),
+                       atol=1e-5)
+
+    prob = np.abs(np.random.randn(3, 5)).astype(np.float32)
+    prob /= prob.sum(1, keepdims=True)
+    logits = np.random.randn(3, 5).astype(np.float32)
+    kl = gloss.KLDivLoss(from_logits=False)(
+        nd.array(logits), nd.array(prob)).asnumpy()
+    logq = logits - logits.max(1, keepdims=True)
+    logq = logq - np.log(np.exp(logq).sum(1, keepdims=True))
+    ref = (prob * (np.log(prob + 1e-12) - logq)).mean(axis=1)
+    assert np.allclose(kl, ref, atol=1e-5)
+
+
+def test_triplet_cosine_losses():
+    a = nd.array(np.random.randn(4, 8).astype(np.float32))
+    p = nd.array(np.random.randn(4, 8).astype(np.float32))
+    n = nd.array(np.random.randn(4, 8).astype(np.float32))
+    t = gloss.TripletLoss()(a, p, n)
+    assert t.shape == (4,) and (t.asnumpy() >= 0).all()
+    lbl = nd.array(np.array([1, -1, 1, -1], np.float32))
+    c = gloss.CosineEmbeddingLoss()(a, p, lbl)
+    assert c.shape == (4,)
+
+
+# ---------------------------------------------------------------------
+# trainer + end-to-end training
+# ---------------------------------------------------------------------
+def _toy_problem(n=256, d=10, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, classes).astype(np.float32)
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1)
+    return x, y.astype(np.float32)
+
+
+@pytest.mark.parametrize("opt,opt_args", [
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+])
+def test_train_mlp_converges(opt, opt_args):
+    x, y = _toy_problem()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(init="xavier")
+    net.hybridize()
+    L = gloss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), opt, opt_args)
+    xb, yb = nd.array(x), nd.array(y)
+    for _ in range(60):
+        with autograd.record():
+            l = L(net(xb), yb)
+            l.backward()
+        trainer.step(x.shape[0])
+    pred = np.argmax(net(xb).asnumpy(), axis=1)
+    acc = (pred == y).mean()
+    assert acc > 0.9, f"{opt} acc={acc}"
+
+
+def test_trainer_lr_and_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    assert trainer.learning_rate == 0.1
+    trainer.set_learning_rate(0.01)
+    assert trainer.learning_rate == 0.01
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    with autograd.record():
+        l = gloss.L2Loss()(net(x), nd.zeros((4, 2)))
+        l.backward()
+    trainer.step(4)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    t2 = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5,
+                                               "momentum": 0.9})
+    t2.load_states(fname)
+    st = t2._updaters[0].states
+    assert len(st) == len(trainer._updaters[0].states)
+
+
+def test_trainer_step_uninitialized_raises():
+    net = nn.Dense(2, in_units=3)
+    trainer = Trainer(net.collect_params(), "sgd")
+    with pytest.raises(mx.MXNetError):
+        trainer.step(1)
+
+
+def test_lenet_hybrid_training_decreases_loss():
+    np.random.seed(42)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, kernel_size=5, padding=2, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(2, 2), nn.Flatten(),
+            nn.Dense(120, activation="relu"),
+            nn.Dense(84, activation="relu"), nn.Dense(10))
+    net.initialize(init="xavier")
+    net.hybridize()
+    L = gloss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.005})
+    x = nd.array(np.random.randn(16, 1, 28, 28).astype(np.float32))
+    y = nd.array(np.random.randint(0, 10, (16,)).astype(np.float32))
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            l = L(net(x), y)
+            l.backward()
+        trainer.step(16)
+        losses.append(float(nd.mean(l).asscalar()))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert len(net._cached_entries) == 1  # one compile, reused

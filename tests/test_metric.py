@@ -1,0 +1,93 @@
+"""Metric tests vs numpy (reference ``test_metric.py``†)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, metric
+
+
+def test_accuracy():
+    pred = nd.array(np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8]],
+                             np.float32))
+    label = nd.array(np.array([1, 0, 0], np.float32))
+    m = metric.Accuracy()
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == "accuracy"
+    assert abs(val - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    np.random.seed(0)
+    pred = np.random.randn(20, 6).astype(np.float32)
+    label = np.random.randint(0, 6, 20).astype(np.float32)
+    m = metric.TopKAccuracy(top_k=3)
+    m.update([nd.array(label)], [nd.array(pred)])
+    top3 = np.argsort(-pred, axis=1)[:, :3]
+    ref = np.mean([l in t for l, t in zip(label.astype(int), top3)])
+    assert abs(m.get()[1] - ref) < 1e-6
+
+
+def test_f1():
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7],
+                              [0.6, 0.4]], np.float32))
+    label = nd.array(np.array([0, 1, 0, 1], np.float32))
+    m = metric.F1()
+    m.update([label], [pred])
+    # tp=1 (idx1), fp=1 (idx2), fn=1 (idx3)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mae_mse_rmse():
+    pred = np.array([[1.0], [2.0]], np.float32)
+    label = np.array([[0.0], [4.0]], np.float32)
+    for name, ref in [("mae", 1.5), ("mse", 2.5),
+                      ("rmse", np.sqrt(2.5))]:
+        m = metric.create(name)
+        m.update([nd.array(label)], [nd.array(pred)])
+        assert abs(m.get()[1] - ref) < 1e-6, name
+
+
+def test_cross_entropy_perplexity():
+    prob = np.array([[0.2, 0.8], [0.6, 0.4]], np.float32)
+    label = np.array([1, 0], np.float32)
+    ce = metric.CrossEntropy()
+    ce.update([nd.array(label)], [nd.array(prob)])
+    ref = -(np.log(0.8) + np.log(0.6)) / 2
+    assert abs(ce.get()[1] - ref) < 1e-6
+    p = metric.Perplexity(ignore_label=None)
+    p.update([nd.array(label)], [nd.array(prob)])
+    assert abs(p.get()[1] - np.exp(ref)) < 1e-5
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.Accuracy())
+    comp.add(metric.MAE())
+    pred = nd.array(np.array([[0.3, 0.7]], np.float32))
+    label = nd.array(np.array([1], np.float32))
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "accuracy" in names and "mae" in names
+
+    custom = metric.np(lambda l, p: float((l == p.argmax(1)).mean()),
+                       name="mycustom")
+    custom.update([label], [pred])
+    assert custom.get()[1] == 1.0
+
+
+def test_create_and_reset():
+    m = metric.create("acc")
+    assert isinstance(m, metric.Accuracy)
+    m = metric.create(["acc", "mse"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    a = metric.Accuracy()
+    assert np.isnan(a.get()[1])
+    with pytest.raises(mx.MXNetError):
+        metric.create("not_a_metric")
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, [nd.array(np.full((2, 2), 3.0, np.float32))])
+    assert abs(m.get()[1] - 3.0) < 1e-6

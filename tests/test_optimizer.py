@@ -1,0 +1,155 @@
+"""Optimizer tests vs numpy reference implementations (the reference's
+``tests/python/unittest/test_optimizer.py``† approach)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, grads):
+    w = nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(5, 4).astype(np.float32)
+    grads = [np.random.randn(5, 4).astype(np.float32) for _ in range(5)]
+    got = _run_updates(opt.SGD(learning_rate=0.1, wd=0.01), w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * (g + 0.01 * w)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.random.randn(6).astype(np.float32)
+    grads = [np.random.randn(6).astype(np.float32) for _ in range(4)]
+    got = _run_updates(opt.SGD(learning_rate=0.2, momentum=0.9), w0, grads)
+    w, m = w0.copy(), np.zeros_like(w0)
+    for g in grads:
+        m = 0.9 * m - 0.2 * g
+        w = w + m
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    grads = [np.random.randn(4, 3).astype(np.float32) for _ in range(6)]
+    got = _run_updates(opt.Adam(learning_rate=0.01), w0, grads)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = np.random.randn(8).astype(np.float32)
+    grads = [np.random.randn(8).astype(np.float32) for _ in range(5)]
+    got = _run_updates(opt.RMSProp(learning_rate=0.01, gamma1=0.9), w0,
+                       grads)
+    w, n = w0.copy().astype(np.float64), np.zeros(8)
+    for g in grads:
+        n = 0.9 * n + 0.1 * g * g
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert np.allclose(got, w, atol=1e-4)
+
+
+def test_adagrad_matches_numpy():
+    w0 = np.random.randn(5).astype(np.float32)
+    grads = [np.random.randn(5).astype(np.float32) for _ in range(5)]
+    got = _run_updates(opt.AdaGrad(learning_rate=0.1), w0, grads)
+    w, h = w0.copy().astype(np.float64), np.zeros(5)
+    for g in grads:
+        h += g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_ftrl_signum_adadelta_adamax_nadam_run():
+    w0 = np.random.randn(6).astype(np.float32)
+    grads = [np.random.randn(6).astype(np.float32) for _ in range(3)]
+    for o in [opt.Ftrl(), opt.Signum(), opt.AdaDelta(), opt.Adamax(),
+              opt.Nadam(), opt.NAG(momentum=0.9), opt.SGLD()]:
+        got = _run_updates(o, w0, grads)
+        assert got.shape == w0.shape
+        assert np.isfinite(got).all(), type(o).__name__
+
+
+def test_create_registry():
+    assert isinstance(opt.create("sgd"), opt.SGD)
+    assert isinstance(opt.create("adam", learning_rate=0.1), opt.Adam)
+    assert isinstance(opt.create("ccSGD"), opt.SGD)
+    with pytest.raises(mx.MXNetError):
+        opt.create("definitely_not_an_optimizer")
+
+
+def test_lr_scheduler_factor():
+    sched = opt.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                             base_lr=1.0)
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    sched = opt.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                                  base_lr=1.0)
+    assert sched(3) == 1.0
+    assert abs(sched(7) - 0.1) < 1e-12
+    assert abs(sched(12) - 0.01) < 1e-12
+
+
+def test_lr_scheduler_poly_cosine_warmup():
+    poly = opt.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                          pwr=1)
+    assert abs(poly(50) - 0.5) < 1e-6
+    cos = opt.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(cos(50) - 0.5) < 1e-6
+    warm = opt.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0,
+                                          pwr=1, warmup_steps=10,
+                                          warmup_begin_lr=0.0)
+    assert warm(5) == 0.5  # halfway through warmup
+
+
+def test_optimizer_with_scheduler():
+    sched = opt.lr_scheduler.FactorScheduler(step=2, factor=0.5,
+                                             base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = nd.array(np.ones(3, np.float32))
+    for _ in range(5):
+        o.update(0, w, nd.array(np.zeros(3, np.float32)), None)
+    assert o.learning_rate < 1.0
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam()
+    u = opt.get_updater(o)
+    w = nd.array(np.random.randn(4).astype(np.float32))
+    u(0, nd.array(np.random.randn(4).astype(np.float32)), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam())
+    u2.set_states(blob)
+    assert 0 in u2.states
+    # states usable after restore
+    u2(0, nd.array(np.random.randn(4).astype(np.float32)), w)
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "w0", 1: "w1"})
+    o.set_lr_mult({"w0": 0.1})
+    assert abs(o._get_lr(0) - 0.1) < 1e-12
+    assert abs(o._get_lr(1) - 1.0) < 1e-12
+    o2 = opt.SGD(learning_rate=1.0, wd=0.1)
+    o2.set_wd_mult({0: 0.0})
+    assert o2._get_wd(0) == 0.0
