@@ -1,0 +1,55 @@
+"""Benchmark: hybridized LeNet-MNIST training throughput (north-star
+workload 1, BASELINE.md).  Runs on whatever accelerator jax exposes
+(the driver runs it on the real TPU chip) and prints ONE JSON line.
+
+The measured unit is the full compiled training step — forward,
+backward, fused optimizer — via ``mxtpu.parallel.build_train_step``,
+i.e. the samples/sec a Speedometer would report (SURVEY.md §5.5).
+``vs_baseline`` is null: the reference mount was empty both rounds, so
+no published number exists to compare against (BASELINE.md).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_lenet(batch_size=512, warmup=5, iters=30):
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.models import lenet
+
+    net = lenet()
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch_size, 1, 28, 28).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, (batch_size,)).astype(np.float32))
+    for _ in range(warmup):
+        step(x, y)
+    nd.waitall()
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(iters):
+        last = step(x, y)
+    float(last.asscalar())  # sync
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    value = bench_lenet()
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(value, 1),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,36 @@ def _is_nd(x) -> bool:
     return isinstance(x, NDArray)
 
 
+def _traced_forward(block, params, param_vals, nd_ins, training, key_data):
+    """Run ``block.forward`` with parameters substituted by traced values —
+    the trace half of the CachedOp (and of ``mxtpu.parallel``'s fused
+    train step).  Returns (raw_outs, out_treedef, aux_params, raw_aux):
+    flattened raw output arrays + treedef, and the running-stat updates
+    (Parameter, new_value) emitted through the aux channel during the
+    trace."""
+    sub = {id(p): NDArray(v, None, _placed=True)
+           for p, v in zip(params, param_vals)}
+    prev_sub, prev_sink = _TRACE.param_sub, _TRACE.aux_sink
+    sink: List[Tuple[Parameter, NDArray]] = []
+    _TRACE.param_sub, _TRACE.aux_sink = sub, sink
+    prev_rec = autograd.set_recording(False)
+    prev_train = autograd.set_training(training)
+    provider = _rnd._TraceKeyProvider(jax.random.wrap_key_data(key_data))
+    _rnd._push_trace_provider(provider)
+    try:
+        out = block.forward(*nd_ins)
+    finally:
+        _rnd._pop_trace_provider()
+        autograd.set_training(prev_train)
+        autograd.set_recording(prev_rec)
+        _TRACE.param_sub, _TRACE.aux_sink = prev_sub, prev_sink
+    outs_flat, out_treedef = jax.tree_util.tree_flatten(out, is_leaf=_is_nd)
+    raw_outs = [o.data if isinstance(o, NDArray) else o for o in outs_flat]
+    aux_params = [p for p, _ in sink]
+    raw_aux = [v.data if isinstance(v, NDArray) else v for _, v in sink]
+    return raw_outs, out_treedef, aux_params, raw_aux
+
+
 def _flatten_args(args):
     # NDArray is a registered pytree node: without is_leaf it dissolves
     # into raw jax.Array leaves, which broke the CachedOp path entirely
@@ -375,35 +405,13 @@ class HybridBlock(Block):
             key_data = flat[n_in + n_p]
             nd_ins = jax.tree_util.tree_unflatten(
                 in_treedef, [NDArray(a, None, _placed=True) for a in ins])
-            sub = {id(p): NDArray(v, None, _placed=True)
-                   for p, v in zip(params, pvals)}
-            prev_sub, prev_sink = _TRACE.param_sub, _TRACE.aux_sink
-            sink: List[Tuple[Parameter, NDArray]] = []
-            _TRACE.param_sub, _TRACE.aux_sink = sub, sink
-            prev_rec = autograd.set_recording(False)
-            prev_train = autograd.set_training(training)
-            provider = _rnd._TraceKeyProvider(
-                jax.random.wrap_key_data(key_data))
-            _rnd._push_trace_provider(provider)
-            try:
-                out = self.forward(*nd_ins)
-            finally:
-                _rnd._pop_trace_provider()
-                autograd.set_training(prev_train)
-                autograd.set_recording(prev_rec)
-                _TRACE.param_sub, _TRACE.aux_sink = prev_sub, prev_sink
-            outs_flat, out_treedef = jax.tree_util.tree_flatten(
-                out, is_leaf=_is_nd)
+            raw_outs, out_treedef, aux_params, raw_aux = _traced_forward(
+                self, params, pvals, nd_ins, training, key_data)
             out_treedef_box["treedef"] = out_treedef
-            out_treedef_box["n_out"] = len(outs_flat)
+            out_treedef_box["n_out"] = len(raw_outs)
             aux_params_order.clear()
-            aux_vals = []
-            for p, v in sink:
-                aux_params_order.append(p)
-                aux_vals.append(v.data if isinstance(v, NDArray) else v)
-            raw_outs = [o.data if isinstance(o, NDArray) else o
-                        for o in outs_flat]
-            return tuple(raw_outs) + tuple(aux_vals)
+            aux_params_order.extend(aux_params)
+            return tuple(raw_outs) + tuple(raw_aux)
 
         flat_fn = jax.jit(raw_fn)
         # force one trace now to learn output structure (compiles lazily

@@ -1,0 +1,186 @@
+"""KVStore — API-parity facade over TPU-native reduction.
+
+Reference: ``src/kvstore/``† (``KVStoreLocal``, ``CommDevice`` P2P
+reduce, ``kvstore_nccl.h``†, ``kvstore_dist.h``† parameter server) and
+``python/mxnet/kvstore.py``†.
+
+TPU-native mapping (SURVEY.md §2.4, §5.8): the reference's explicit
+push/pull reductions become IN-GRAPH collectives — ``mxtpu.parallel``
+compiles the gradient all-reduce into the training executable, where
+XLA schedules it over ICI.  This facade keeps the reference API for
+code that drives KVStore directly:
+
+* ``local``/``device``/``nccl`` → same in-process reducer (device
+  arrays summed by XLA; a single fused reduce, not P2P copies).
+* ``dist_sync``/``dist_device_sync`` → multi-host SPMD via
+  ``jax.distributed`` (process_index = worker rank).  Synchronous by
+  construction.
+* ``dist_async`` → no TPU analogue (SPMD is synchronous); created as a
+  sync store with a warning, per the documented divergence.
+
+``set_optimizer`` reproduces the reference's server-side update: when an
+optimizer is attached, ``push`` applies it to the stored weight and
+``pull`` returns weights (the ``update_on_kvstore`` path of
+``Module``/``Trainer``).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import optimizer as opt_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+class KVStore:
+    """In-process key-value store with reference semantics."""
+
+    def __init__(self, name: str = "local"):
+        self._type = name
+        self._store: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self._type.startswith("dist") else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self._type.startswith("dist") else 1
+
+    @property
+    def num_devices(self) -> int:
+        return jax.device_count()
+
+    # ------------------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            vv = _as_list(v)[0]
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """Reduce ``value`` (list = per-device grads) into the store;
+        with an attached optimizer, apply the update server-side."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            parts = _as_list(v)
+            reduced = parts[0]
+            for p in parts[1:]:
+                reduced = reduced + p
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not init()ed")
+                self._updater(self._key_int(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced.copy()
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True):
+        keys, outs = self._normalize(key, out)
+        results = []
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not init()ed")
+            val = self._store[k]
+            for dst in _as_list(o):
+                if dst is not None:
+                    dst._data = val.data
+            results.append(val)
+        return results if out is None else None
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0,
+                        row_ids=None):
+        """Sparse pull degenerates to dense pull (TPU has no sparse
+        storage; SURVEY.md §7 hard-part 3)."""
+        self.pull(key, out=out, priority=priority)
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer) -> None:
+        """Run the optimizer "server-side" on push (reference
+        ``kvstore_dist_server.h``† behavior, `update_on_kvstore`)."""
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params) -> None:
+        """The reference's 2-bit compression reduced PCIe/network bytes;
+        on a TPU slice the gradient all-reduce rides ICI inside the
+        compiled step, so this records the request and warns."""
+        self._compression = dict(compression_params or {})
+        warnings.warn(
+            "gradient compression is a no-op in-graph (ICI all-reduce); "
+            "recorded for API parity only")
+
+    # ------------------------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------------------------
+    def barrier(self) -> None:
+        nd.waitall()
+
+    def _key_int(self, k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            if value is None:
+                return list(key), [None] * len(key)
+            if len(key) != len(value):
+                raise MXNetError("key/value length mismatch")
+            return list(key), list(value)
+        return [key], [value]
+
+
+def create(name: str = "local") -> KVStore:
+    """Reference ``mx.kv.create``†."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    known = ("local", "device", "nccl", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_device_sync",
+             "dist_async")
+    if name not in known:
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    if name == "dist_async":
+        warnings.warn(
+            "dist_async has no TPU analogue (SPMD collectives are "
+            "synchronous); creating a synchronous store — see SURVEY.md "
+            "§7 hard-part 4")
+    return KVStore(name)

@@ -875,6 +875,7 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     batch_var) — the gluon layer owns the running-stat update (the
     reference mutates aux states inside the op via FMutateInputs;
     functionally we return them instead)."""
+    axis = axis % x.ndim
     axes = tuple(i for i in range(x.ndim) if i != axis)
     if use_global_stats:
         mean, var = moving_mean, moving_var

@@ -1,0 +1,300 @@
+"""``mxtpu.parallel`` — SPMD execution over a device mesh.
+
+This is the TPU-native replacement for the reference's multi-device
+machinery (``DataParallelExecutorGroup``†, KVStore ``device``/``nccl``
+reduction, ``src/kvstore/comm.h``†): instead of per-device executors
+plus explicit push/pull reductions, the WHOLE training step —
+forward, backward, gradient all-reduce, optimizer update, running-stat
+(aux) updates — is compiled into ONE XLA executable over a
+``jax.sharding.Mesh``.  The batch is sharded over the ``dp`` axis;
+parameters are replicated (or sharded per ``param_spec_fn`` for tensor
+parallelism); XLA inserts the all-reduce/all-gather collectives and
+schedules them over ICI (SURVEY.md §2.4, §5.8).
+
+``KVStore`` (``mxtpu.kvstore``) remains as the API-parity facade; this
+module is the mechanism.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..ndarray import random as _rnd
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import get_op
+
+__all__ = ["make_mesh", "shard_batch", "replicate", "TrainStep",
+           "build_train_step", "Mesh", "PartitionSpec", "P"]
+
+PartitionSpec = P
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
+    """Build a named device mesh.  ``axes`` maps axis name → size, e.g.
+    ``{'dp': 4, 'mp': 2}``; defaults to pure data parallelism over all
+    visible devices."""
+    devices = devices if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = tuple(axes)
+    sizes = tuple(axes[n] for n in names)
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise MXNetError(
+            f"mesh {axes} needs {total} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def shard_batch(mesh: Mesh, arr, axis_name: str = "dp", batch_axis: int = 0):
+    """Place an array batch-sharded over a mesh axis."""
+    raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    spec = [None] * raw.ndim
+    spec[batch_axis] = axis_name
+    out = jax.device_put(raw, NamedSharding(mesh, P(*spec)))
+    return NDArray(out, None, _placed=True) if isinstance(arr, NDArray) \
+        else out
+
+
+def replicate(mesh: Mesh, arr):
+    """Place an array fully replicated over the mesh."""
+    raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    out = jax.device_put(raw, NamedSharding(mesh, P()))
+    return NDArray(out, None, _placed=True) if isinstance(arr, NDArray) \
+        else out
+
+
+# ----------------------------------------------------------------------
+# functional optimizer rules for the compiled step
+# (reuse the fused registry ops — "optimizers are ops")
+# ----------------------------------------------------------------------
+def _opt_rule(optimizer: opt_mod.Optimizer):
+    """Return (init_state(w)->tuple, update(w,g,state,lr,wd)->(w,state))."""
+    if isinstance(optimizer, opt_mod.Adam):
+        fn = get_op("adam_update").fn
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, state, lr, wd):
+            w2, m, v = fn(w, g, state[0], state[1], lr=lr,
+                          beta1=optimizer.beta1, beta2=optimizer.beta2,
+                          epsilon=optimizer.epsilon, wd=wd,
+                          rescale_grad=optimizer.rescale_grad,
+                          clip_gradient=optimizer._clip())
+            return w2, (m, v)
+        return init, update
+    if isinstance(optimizer, opt_mod.RMSProp) and not optimizer.centered:
+        fn = get_op("rmsprop_update").fn
+
+        def init(w):
+            return (jnp.zeros_like(w),)
+
+        def update(w, g, state, lr, wd):
+            w2, n = fn(w, g, state[0], lr=lr, gamma1=optimizer.gamma1,
+                       epsilon=optimizer.epsilon, wd=wd,
+                       rescale_grad=optimizer.rescale_grad,
+                       clip_gradient=optimizer._clip())
+            return w2, (n,)
+        return init, update
+    if isinstance(optimizer, opt_mod.SGD):
+        if optimizer.momentum:
+            fn = get_op("sgd_mom_update").fn
+
+            def init(w):
+                return (jnp.zeros_like(w),)
+
+            def update(w, g, state, lr, wd):
+                w2, m = fn(w, g, state[0], lr=lr,
+                           momentum=optimizer.momentum, wd=wd,
+                           rescale_grad=optimizer.rescale_grad,
+                           clip_gradient=optimizer._clip())
+                return w2, (m,)
+            return init, update
+        fn = get_op("sgd_update").fn
+
+        def init(w):
+            return ()
+
+        def update(w, g, state, lr, wd):
+            return fn(w, g, lr=lr, wd=wd,
+                      rescale_grad=optimizer.rescale_grad,
+                      clip_gradient=optimizer._clip()), ()
+        return init, update
+    raise MXNetError(
+        f"compiled train step supports SGD/Adam/RMSProp; got "
+        f"{type(optimizer).__name__} (use gluon.Trainer eager path)")
+
+
+class TrainStep:
+    """One fused XLA executable per (shape signature): fwd + bwd +
+    collectives + optimizer + aux writeback.  Call with (x, y) batches;
+    parameters update in place (rebound buffers)."""
+
+    def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
+                 dp_axis: str = "dp", batch_axis: int = 0,
+                 param_spec_fn: Optional[Callable] = None, donate=True):
+        from ..gluon.block import _traced_forward
+        self._traced_forward = _traced_forward
+        self.net = net
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.batch_axis = batch_axis
+        self.param_spec_fn = param_spec_fn
+        self.donate = donate
+        self._compiled = {}
+        self._params: Optional[List] = None
+        self._t = 0
+
+    # -- parameter bookkeeping -----------------------------------------
+    def _collect(self, x):
+        if self._params is None:
+            import mxtpu.autograd as autograd
+            if not all(p._data is not None
+                       for p in self.net.collect_params().values()):
+                with autograd.pause():
+                    self.net(x)  # deferred shape inference
+            allp = list(self.net.collect_params().values())
+            self._params = allp
+            self._train_idx = [i for i, p in enumerate(allp)
+                               if p.grad_req != "null"]
+            self._opt_init, self._opt_update = _opt_rule(self.optimizer)
+            if self.mesh is not None:
+                for p in allp:
+                    spec = None
+                    if self.param_spec_fn is not None:
+                        spec = self.param_spec_fn(p)
+                    sh = NamedSharding(self.mesh,
+                                       spec if spec is not None else P())
+                    p._data._data = jax.device_put(p._data._data, sh)
+            self._opt_state = tuple(
+                self._opt_init(self._params[i]._data._data)
+                for i in self._train_idx)
+            if self.mesh is not None:
+                self._opt_state = jax.device_put(
+                    self._opt_state,
+                    jax.tree_util.tree_map(
+                        lambda _: NamedSharding(self.mesh, P()),
+                        self._opt_state))
+
+    def _build(self, key, x_raw, y_raw):
+        params = self._params
+        train_idx = self._train_idx
+        frozen_idx = [i for i in range(len(params)) if i not in
+                      set(train_idx)]
+        n_param = len(params)
+        loss_fn = self.loss_fn
+        net = self.net
+        traced_forward = self._traced_forward
+        aux_box: Dict[str, Any] = {}
+
+        def loss_flat(train_vals, frozen_vals, key_data, x, y):
+            pvals: List[Any] = [None] * n_param
+            for i, v in zip(train_idx, train_vals):
+                pvals[i] = v
+            for i, v in zip(frozen_idx, frozen_vals):
+                pvals[i] = v
+            raw_outs, _, aux_params, raw_aux = traced_forward(
+                net, params, pvals, [NDArray(x, None, _placed=True)],
+                True, key_data)
+            out = NDArray(raw_outs[0], None, _placed=True)
+            l = loss_fn(out, NDArray(y, None, _placed=True))
+            raw_l = l.data if isinstance(l, NDArray) else l
+            aux_box["aux_params"] = aux_params
+            return jnp.mean(raw_l), tuple(raw_aux)
+
+        def step(train_vals, frozen_vals, opt_state, key_data, lr, x, y):
+            (loss, raw_aux), grads = jax.value_and_grad(
+                loss_flat, has_aux=True)(train_vals, frozen_vals,
+                                         key_data, x, y)
+            wds = [self.optimizer._get_wd(i) for i in train_idx]
+            new_vals = []
+            new_state = []
+            for w, g, st, wd in zip(train_vals, grads, opt_state, wds):
+                w2, st2 = self._opt_update(w, g, st, lr, wd)
+                new_vals.append(w2)
+                new_state.append(st2)
+            return loss, tuple(new_vals), tuple(new_state), raw_aux
+
+        # learn the aux structure without device work
+        train_vals = tuple(params[i]._data._data for i in train_idx)
+        frozen_vals = tuple(params[i]._data._data for i in frozen_idx)
+        jax.eval_shape(step, train_vals, frozen_vals, self._opt_state,
+                       jax.random.key_data(key), jnp.float32(0.0),
+                       x_raw, y_raw)
+        donate = (0, 2) if self.donate else ()
+        fitted = jax.jit(step, donate_argnums=donate)
+        return {"fn": fitted, "aux_params": aux_box["aux_params"],
+                "frozen_idx": frozen_idx}
+
+    # -- the hot call ----------------------------------------------------
+    def __call__(self, x, y):
+        x_raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        y_raw = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        self._collect(x if isinstance(x, NDArray)
+                      else NDArray(x_raw, None, _placed=True))
+        if self.mesh is not None:
+            spec = [None] * x_raw.ndim
+            spec[self.batch_axis] = self.dp_axis
+            x_raw = jax.device_put(x_raw,
+                                   NamedSharding(self.mesh, P(*spec)))
+            yspec = [None] * max(y_raw.ndim, 1)
+            yspec[self.batch_axis] = self.dp_axis
+            y_raw = jax.device_put(
+                y_raw, NamedSharding(self.mesh, P(*yspec[:y_raw.ndim])))
+        sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
+               str(y_raw.dtype))
+        key = _rnd._next_key(None)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            entry = self._build(key, x_raw, y_raw)
+            self._compiled[sig] = entry
+        self._t += 1
+        lr = self._lr_for_step()
+        params = self._params
+        train_vals = tuple(params[i]._data._data for i in self._train_idx)
+        frozen_vals = tuple(params[i]._data._data
+                            for i in entry["frozen_idx"])
+        loss, new_vals, new_state, raw_aux = entry["fn"](
+            train_vals, frozen_vals, self._opt_state,
+            jax.random.key_data(key), jnp.float32(lr), x_raw, y_raw)
+        for i, v in zip(self._train_idx, new_vals):
+            params[i]._data._data = v
+        self._opt_state = new_state
+        for p, v in zip(entry["aux_params"], raw_aux):
+            p._data._data = v
+        return NDArray(loss, None, _placed=True)
+
+    def _lr_for_step(self):
+        opt = self.optimizer
+        opt.num_update = self._t
+        lr = opt.learning_rate
+        if isinstance(opt, opt_mod.Adam):
+            t = self._t
+            lr = lr * np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+        return lr
+
+
+def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
+                     mesh: Optional[Mesh] = None, dp_axis: str = "dp",
+                     batch_axis: int = 0, param_spec_fn=None,
+                     donate: bool = True) -> TrainStep:
+    """Compile net+loss+optimizer into a single SPMD train step.
+
+    ``mesh=None`` → single-device executable (still one fused program).
+    With a mesh, batches shard over ``dp_axis`` and XLA inserts the
+    gradient all-reduce; ``param_spec_fn(param) -> PartitionSpec`` adds
+    tensor-parallel sharding."""
+    if not isinstance(optimizer, opt_mod.Optimizer):
+        optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+    return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
+                     batch_axis=batch_axis, param_spec_fn=param_spec_fn,
+                     donate=donate)
