@@ -21,7 +21,14 @@ __all__ = [
     "string_types",
     "numeric_types",
     "integer_types",
+    "_as_list",
 ]
+
+
+def _as_list(x):
+    """Wrap a non-list value in a list (lists/tuples pass through as
+    lists) — shared by kvstore/metric/io."""
+    return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
 class MXNetError(RuntimeError):

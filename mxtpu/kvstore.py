@@ -31,16 +31,12 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, _as_list
 from . import ndarray as nd
 from . import optimizer as opt_mod
 from .ndarray.ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
-
-
-def _as_list(v):
-    return list(v) if isinstance(v, (list, tuple)) else [v]
 
 
 class KVStore:
@@ -88,6 +84,7 @@ class KVStore:
             reduced = parts[0]
             for p in parts[1:]:
                 reduced = reduced + p
+            reduced = self._cross_process_sum(reduced)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} not init()ed")
@@ -148,9 +145,23 @@ class KVStore:
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 
+    def _cross_process_sum(self, reduced: NDArray) -> NDArray:
+        """``dist_*`` stores reduce across worker processes too: an
+        all-gather over DCN (``jax.distributed`` must be initialised by
+        the launcher) followed by a sum.  Single-process runs are a
+        no-op, so the same code path works under local testing."""
+        if not self._type.startswith("dist") or jax.process_count() == 1:
+            return reduced
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(reduced.data)
+        return NDArray(gathered.sum(axis=0), None, _placed=True)
+
     # ------------------------------------------------------------------
     def barrier(self) -> None:
         nd.waitall()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxtpu.kvstore.barrier")
 
     def _key_int(self, k):
         try:

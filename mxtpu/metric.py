@@ -56,8 +56,7 @@ def _as_numpy(x):
     return _np.asarray(x)
 
 
-def _as_list(x):
-    return x if isinstance(x, (list, tuple)) else [x]
+from .base import _as_list  # noqa: E402  (shared helper)
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):

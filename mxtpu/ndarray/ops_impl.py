@@ -968,7 +968,9 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    if wd and weight is not None:
+    # wd may be a traced scalar (the compiled train step passes it as an
+    # argument so schedule changes don't recompile) — no bool() on it.
+    if weight is not None and not (isinstance(wd, (int, float)) and wd == 0):
         g = g + wd * weight
     return g
 

@@ -117,8 +117,8 @@ class Optimizer:
         self.wd_mult = {}
         for n in self.idx2name.values():
             # reference default: no decay on biases and norm params
-            if n.endswith("_weight") or n.endswith("_gamma"):
-                continue
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):

@@ -166,6 +166,15 @@ class TrainStep:
             self._params = allp
             self._train_idx = [i for i, p in enumerate(allp)
                                if p.grad_req != "null"]
+            # Honour per-parameter lr_mult/wd_mult the way gluon.Trainer
+            # does: index the optimizer's param_dict by the compiled
+            # step's own parameter ordering (don't clobber a user-set
+            # mapping on a shared optimizer instance).
+            if not self.optimizer.param_dict and not self.optimizer.idx2name:
+                self.optimizer.param_dict = {
+                    j: allp[i] for j, i in enumerate(self._train_idx)}
+                self.optimizer.idx2name = {
+                    j: allp[i].name for j, i in enumerate(self._train_idx)}
             self._opt_init, self._opt_update = _opt_rule(self.optimizer)
             if self.mesh is not None:
                 for p in allp:
@@ -205,20 +214,25 @@ class TrainStep:
             raw_outs, _, aux_params, raw_aux = traced_forward(
                 net, params, pvals, [NDArray(x, None, _placed=True)],
                 True, key_data)
-            out = NDArray(raw_outs[0], None, _placed=True)
-            l = loss_fn(out, NDArray(y, None, _placed=True))
+            outs = [NDArray(r, None, _placed=True) for r in raw_outs]
+            # Multi-output nets hand ALL outputs to the loss (a custom
+            # loss_fn must unpack them) rather than silently training
+            # only the first head.
+            pred = outs[0] if len(outs) == 1 else outs
+            l = loss_fn(pred, NDArray(y, None, _placed=True))
             raw_l = l.data if isinstance(l, NDArray) else l
             aux_box["aux_params"] = aux_params
             return jnp.mean(raw_l), tuple(raw_aux)
 
-        def step(train_vals, frozen_vals, opt_state, key_data, lr, x, y):
+        def step(train_vals, frozen_vals, opt_state, key_data, lrs, wds,
+                 x, y):
             (loss, raw_aux), grads = jax.value_and_grad(
                 loss_flat, has_aux=True)(train_vals, frozen_vals,
                                          key_data, x, y)
-            wds = [self.optimizer._get_wd(i) for i in train_idx]
             new_vals = []
             new_state = []
-            for w, g, st, wd in zip(train_vals, grads, opt_state, wds):
+            for w, g, st, lr, wd in zip(train_vals, grads, opt_state,
+                                        lrs, wds):
                 w2, st2 = self._opt_update(w, g, st, lr, wd)
                 new_vals.append(w2)
                 new_state.append(st2)
@@ -227,8 +241,9 @@ class TrainStep:
         # learn the aux structure without device work
         train_vals = tuple(params[i]._data._data for i in train_idx)
         frozen_vals = tuple(params[i]._data._data for i in frozen_idx)
+        zeros = tuple(jnp.float32(0.0) for _ in train_idx)
         jax.eval_shape(step, train_vals, frozen_vals, self._opt_state,
-                       jax.random.key_data(key), jnp.float32(0.0),
+                       jax.random.key_data(key), zeros, zeros,
                        x_raw, y_raw)
         donate = (0, 2) if self.donate else ()
         fitted = jax.jit(step, donate_argnums=donate)
@@ -258,14 +273,14 @@ class TrainStep:
             entry = self._build(key, x_raw, y_raw)
             self._compiled[sig] = entry
         self._t += 1
-        lr = self._lr_for_step()
+        lrs, wds = self._lrs_wds()
         params = self._params
         train_vals = tuple(params[i]._data._data for i in self._train_idx)
         frozen_vals = tuple(params[i]._data._data
                             for i in entry["frozen_idx"])
         loss, new_vals, new_state, raw_aux = entry["fn"](
             train_vals, frozen_vals, self._opt_state,
-            jax.random.key_data(key), jnp.float32(lr), x_raw, y_raw)
+            jax.random.key_data(key), lrs, wds, x_raw, y_raw)
         for i, v in zip(self._train_idx, new_vals):
             params[i]._data._data = v
         self._opt_state = new_state
@@ -273,14 +288,21 @@ class TrainStep:
             p._data._data = v
         return NDArray(loss, None, _placed=True)
 
-    def _lr_for_step(self):
+    def _lrs_wds(self):
+        """Per-parameter (lr, wd) scalars for this step — traced args, so
+        scheduler/mult changes never trigger a recompile.  The raw
+        ``adam_update`` op does not bias-correct, so the correction is
+        folded into the lr here (matches the eager ``Adam.update``)."""
         opt = self.optimizer
         opt.num_update = self._t
-        lr = opt.learning_rate
+        bias = 1.0
         if isinstance(opt, opt_mod.Adam):
             t = self._t
-            lr = lr * np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
-        return lr
+            bias = np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+        n = len(self._train_idx)
+        lrs = tuple(jnp.float32(opt._get_lr(j) * bias) for j in range(n))
+        wds = tuple(jnp.float32(opt._get_wd(j)) for j in range(n))
+        return lrs, wds
 
 
 def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
