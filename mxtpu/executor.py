@@ -1,0 +1,197 @@
+"""Executor — the bind/eval surface of the symbolic API.
+
+Reference: ``python/mxnet/executor.py``† over ``GraphExecutor``
+(``src/executor/graph_executor.cc``†).
+
+TPU-native: binding keeps the reference surface (named arg arrays →
+``forward``/``backward``/``outputs``) but execution is interpretation of
+the symbol through the eager op namespace, with the autograd tape
+providing the backward pass (the reference ran an explicit NNVM grad
+graph; here jax vjps recorded per op play that role).  Memory planning,
+fusion, and scheduling belong to XLA under jit — the reference's
+``PlanMemory``/``AttachOpExecs`` passes have no analogue by design.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError, _as_list
+from . import autograd
+from . import ndarray as nd_mod
+from .ndarray.ndarray import NDArray
+from .symbol import Symbol, _eval_symbol, _is_aux_name
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """A symbol bound to argument arrays (reference ``Executor``†)."""
+
+    def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict = self._name_arrays(args, arg_names, "args")
+        self.aux_dict = self._name_arrays(aux_states, aux_names,
+                                          "aux_states")
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in arg_names}
+
+        if args_grad is None:
+            args_grad = {n: nd_mod.zeros(self.arg_dict[n].shape)
+                         for n in arg_names
+                         if self._grad_req.get(n, "null") != "null"}
+        self.grad_dict = self._name_arrays(args_grad, arg_names, "args_grad",
+                                           allow_missing=True)
+
+        self._outputs: Optional[List[NDArray]] = None
+        self._monitor_callback = None
+
+    @staticmethod
+    def _name_arrays(arrays, names, what, allow_missing=False):
+        if arrays is None:
+            return {}
+        if isinstance(arrays, dict):
+            out = dict(arrays)
+        else:
+            arrays = _as_list(arrays)
+            if len(arrays) != len(names) and not allow_missing:
+                raise MXNetError(
+                    f"{what}: expected {len(names)} arrays "
+                    f"({names}), got {len(arrays)}")
+            out = dict(zip(names, arrays))
+        return {k: v if isinstance(v, NDArray) else nd_mod.array(v)
+                for k, v in out.items() if v is not None}
+
+    # -- reference surface ---------------------------------------------
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs is None:
+            raise MXNetError("run forward() first")
+        return self._outputs
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    def set_monitor_callback(self, callback, monitor_all=False) -> None:
+        self._monitor_callback = callback
+
+    def forward(self, is_train: bool = False, **kwargs):
+        for name, val in kwargs.items():
+            if name not in self.arg_dict and not _is_aux_name(name):
+                raise MXNetError(f"unknown argument {name!r}")
+            self.arg_dict[name] = val if isinstance(val, NDArray) \
+                else nd_mod.array(val)
+
+        bindings: Dict[str, NDArray] = {}
+        bindings.update(self.aux_dict)
+        bindings.update(self.arg_dict)
+
+        if is_train:
+            grads = []
+            for name, arr in self.arg_dict.items():
+                req = self._grad_req.get(name, "null")
+                if req != "null":
+                    arr.attach_grad(grad_req=req)
+                    grads.append(name)
+            self._recorded = grads
+            with autograd.record():
+                outs = _eval_symbol(self._symbol, bindings)
+        else:
+            outs = _eval_symbol(self._symbol, bindings)
+
+        self._outputs = outs
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), outs):
+                self._monitor_callback(name, out)
+        return outs
+
+    def backward(self, out_grads=None) -> None:
+        if self._outputs is None:
+            raise MXNetError("forward(is_train=True) before backward()")
+        heads = self._outputs
+        if out_grads is not None:
+            out_grads = _as_list(out_grads)
+        autograd.backward(heads, out_grads)
+        for name in self._recorded:
+            arr = self.arg_dict[name]
+            if arr.grad is None:
+                continue
+            req = self._grad_req.get(name, "write")
+            dst = self.grad_dict.get(name)
+            if dst is None:
+                self.grad_dict[name] = arr.grad
+            elif req == "add":
+                dst._data = dst._data + arr.grad._data
+            else:
+                dst._data = arr.grad._data
+
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False) -> None:
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name] = arr.copy()
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {name!r}")
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name] = arr.copy()
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new shapes — with XLA there is no memory pool to
+        re-plan; a fresh Executor (compile-cache-hit per shape) is the
+        whole story."""
+        new_args = {}
+        for n, arr in self.arg_dict.items():
+            if n in kwargs:
+                new_args[n] = nd_mod.zeros(kwargs[n])
+            else:
+                new_args[n] = arr
+        return Executor(self._symbol, self._ctx, args=new_args,
+                        grad_req=self._grad_req,
+                        aux_states=dict(self.aux_dict))
+
+    # -- construction helpers ------------------------------------------
+    @staticmethod
+    def simple_bind(symbol: Symbol, ctx=None, grad_req="write",
+                    type_dict=None, **shape_kwargs) -> "Executor":
+        """Infer all shapes from the provided input shapes and allocate
+        (reference ``simple_bind``†)."""
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = type_dict.get(name, "float32")
+            args[name] = nd_mod.zeros(shape, dtype=dtype)
+        aux = {name: nd_mod.zeros(shape)
+               for name, shape in zip(aux_names, aux_shapes)}
+        return Executor(symbol, ctx, args=args, grad_req=grad_req,
+                        aux_states=aux)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, _as_list as _as_list_names
 from ..context import Context, current_context
 from .. import autograd
 from .. import ndarray as nd_mod
@@ -63,6 +63,11 @@ def _emit_aux_update(param: Parameter, value: NDArray) -> None:
 
 def _is_nd(x) -> bool:
     return isinstance(x, NDArray)
+
+
+def _is_symbol(x) -> bool:
+    from ..symbol import Symbol
+    return isinstance(x, Symbol)
 
 
 def _traced_forward(block, params, param_vals, nd_ins, training, key_data):
@@ -286,6 +291,8 @@ class HybridBlock(Block):
     # children of a hybridized top block execute inside the parent's
     # trace; their own __call__ must stay imperative then.
     def __call__(self, *args, **kwargs):
+        if args:
+            self._num_inputs = len(args)  # recorded for export()
         if self._active and _TRACE.param_sub is None \
                 and not kwargs and args:
             leaves, treedef = _flatten_args(args)
@@ -300,6 +307,14 @@ class HybridBlock(Block):
 
     # -- imperative dispatch: hybrid_forward(F, x, **param_values) ------
     def forward(self, *args, **kwargs):
+        # Symbolic composition: net(sym.var('data')) builds a graph by
+        # running the same hybrid_forward with F = mxtpu.symbol and
+        # parameters as named variables (the reference's F-switch).
+        if args and _is_symbol(args[0]):
+            from .. import symbol as sym_mod
+            pvals = {name: sym_mod.var(p.name)
+                     for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, *args, **pvals, **kwargs)
         self._ensure_init(*args)
         pvals = {name: p.data() for name, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, *args, **pvals, **kwargs)
@@ -428,20 +443,31 @@ class HybridBlock(Block):
 
     # -- deployment -----------------------------------------------------
     def export(self, path: str, epoch: int = 0):
-        """Serialize for deployment (reference writes -symbol.json +
-        -0000.params).  Writes the params file plus a json graph stub;
-        full symbol JSON round-trip lives in mxtpu.symbol."""
-        import json as _json
-        params = self._collect_params_with_prefix()
-        arrays = {("arg:" + k): p.data() for k, p in params.items()
-                  if p._data is not None}
+        """Serialize for deployment (reference ``HybridBlock.export``†
+        writes ``-symbol.json`` + ``-%04d.params``): trace the block
+        symbolically and write the real graph, loadable by
+        ``SymbolBlock.imports`` (round-trip tested)."""
+        from .. import symbol as sym_mod
+        if not self._ensure_init_recursive():
+            raise MXNetError(
+                "export() needs initialized parameters — run a forward "
+                "pass first (reference requires hybridize + forward)")
+        n_in = getattr(self, "_num_inputs", 1)
+        ins = [sym_mod.var("data" if n_in == 1 else f"data{i}")
+               for i in range(n_in)]
+        out = self(*ins)
+        sym = out if isinstance(out, sym_mod.Symbol) \
+            else sym_mod.Group([o for o in out])
+        sym.save(f"{path}-symbol.json")
+        arrays = {}
+        for p in self.collect_params().values():
+            if p._data is None:
+                continue
+            tag = "aux:" if p.name.endswith(("running_mean", "running_var",
+                                             "moving_mean", "moving_var")) \
+                else "arg:"
+            arrays[tag + p.name] = p.data()
         nd_mod.save(f"{path}-{epoch:04d}.params", arrays)
-        meta = {
-            "nodes": [{"op": "null", "name": k} for k in params],
-            "mxtpu_export": type(self).__name__,
-        }
-        with open(f"{path}-symbol.json", "w") as f:
-            _json.dump(meta, f)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 
 
@@ -459,10 +485,10 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        from ..symbol import load as sym_load
+        from ..symbol import load as sym_load, var as sym_var
         sym = sym_load(symbol_file)
-        inputs = [sym.__class__.var(n) if isinstance(n, str) else n
-                  for n in input_names]
+        inputs = [sym_var(n) if isinstance(n, str) else n
+                  for n in _as_list_names(input_names)]
         blk = SymbolBlock(sym, inputs)
         if param_file:
             loaded = nd_mod.load(param_file)
@@ -481,4 +507,5 @@ class SymbolBlock(HybridBlock):
         for name, p in self.collect_params().items():
             if p._data is not None:
                 bindings[name] = p.data()
-        return _eval_symbol(self._outputs, bindings)
+        outs = _eval_symbol(self._outputs, bindings)
+        return outs[0] if len(outs) == 1 else outs

@@ -159,7 +159,10 @@ class KVStore:
     # ------------------------------------------------------------------
     def barrier(self) -> None:
         nd.waitall()
-        if jax.process_count() > 1:
+        # Only dist_* stores participate in the global sync point —
+        # a local store's barrier on one process of a multi-host job
+        # must not block on peers that never reach it.
+        if self._type.startswith("dist") and jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxtpu.kvstore.barrier")
 

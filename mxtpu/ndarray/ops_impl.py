@@ -126,6 +126,45 @@ for _name, (_fn, _diff, _aliases) in _BINARY.items():
     register_op(_name, num_inputs=2, differentiable=_diff,
                 aliases=_aliases)((lambda f: lambda a, b: f(a, b))(_fn))
 
+# ======================================================================
+# scalar family (src/operator/tensor/elemwise_binary_scalar_op*†) —
+# tensor∘scalar with the scalar a typed op param, so Symbol graphs can
+# serialize scalar arithmetic the way the reference does
+# ======================================================================
+_SCALAR_OPS = {
+    "_plus_scalar": (lambda x, s: x + s, True, ("_PlusScalar",)),
+    "_minus_scalar": (lambda x, s: x - s, True, ("_MinusScalar",)),
+    "_rminus_scalar": (lambda x, s: s - x, True, ("_RMinusScalar",)),
+    "_mul_scalar": (lambda x, s: x * s, True, ("_MulScalar",)),
+    "_div_scalar": (lambda x, s: x / s, True, ("_DivScalar",)),
+    "_rdiv_scalar": (lambda x, s: s / x, True, ("_RDivScalar",)),
+    "_mod_scalar": (lambda x, s: jnp.mod(x, s), True, ()),
+    "_rmod_scalar": (lambda x, s: jnp.mod(s, x), True, ()),
+    "_power_scalar": (lambda x, s: jnp.power(x, s), True,
+                      ("_PowerScalar",)),
+    "_rpower_scalar": (lambda x, s: jnp.power(s, x), True,
+                       ("_RPowerScalar",)),
+    "_maximum_scalar": (lambda x, s: jnp.maximum(x, s), True,
+                        ("_MaximumScalar",)),
+    "_minimum_scalar": (lambda x, s: jnp.minimum(x, s), True,
+                        ("_MinimumScalar",)),
+    "_hypot_scalar": (lambda x, s: jnp.hypot(x, s), True, ()),
+    "_equal_scalar": (lambda x, s: (x == s).astype(x.dtype), False, ()),
+    "_not_equal_scalar": (lambda x, s: (x != s).astype(x.dtype), False, ()),
+    "_greater_scalar": (lambda x, s: (x > s).astype(x.dtype), False, ()),
+    "_greater_equal_scalar": (lambda x, s: (x >= s).astype(x.dtype),
+                              False, ()),
+    "_lesser_scalar": (lambda x, s: (x < s).astype(x.dtype), False, ()),
+    "_lesser_equal_scalar": (lambda x, s: (x <= s).astype(x.dtype),
+                             False, ()),
+}
+
+for _name, (_fn, _diff, _aliases) in _SCALAR_OPS.items():
+    register_op(_name, params=[Param("scalar", float, 0.0)],
+                differentiable=_diff, aliases=_aliases)(
+        (lambda f: lambda x, scalar=0.0: f(x, scalar))(_fn))
+
+
 register_op("smooth_l1", params=[Param("scalar", float, 1.0)])(
     lambda x, scalar=1.0: jnp.where(
         jnp.abs(x) < 1.0 / (scalar ** 2),

@@ -166,15 +166,18 @@ class TrainStep:
             self._params = allp
             self._train_idx = [i for i, p in enumerate(allp)
                                if p.grad_req != "null"]
-            # Honour per-parameter lr_mult/wd_mult the way gluon.Trainer
-            # does: index the optimizer's param_dict by the compiled
-            # step's own parameter ordering (don't clobber a user-set
-            # mapping on a shared optimizer instance).
-            if not self.optimizer.param_dict and not self.optimizer.idx2name:
-                self.optimizer.param_dict = {
-                    j: allp[i] for j, i in enumerate(self._train_idx)}
-                self.optimizer.idx2name = {
-                    j: allp[i].name for j, i in enumerate(self._train_idx)}
+            # Honour per-parameter lr_mult/wd_mult (Parameter attrs plus
+            # any name-keyed overrides set on the optimizer) without
+            # touching the optimizer's own param_dict/idx2name — those
+            # may be indexed by a different ordering (e.g. a shared
+            # gluon.Trainer instance).
+            opt = self.optimizer
+            self._lr_mults = np.asarray(
+                [allp[i].lr_mult * opt.lr_mult.get(allp[i].name, 1.0)
+                 for i in self._train_idx], np.float32)
+            self._wd_mults = np.asarray(
+                [allp[i].wd_mult * opt.wd_mult.get(allp[i].name, 1.0)
+                 for i in self._train_idx], np.float32)
             self._opt_init, self._opt_update = _opt_rule(self.optimizer)
             if self.mesh is not None:
                 for p in allp:
@@ -231,9 +234,9 @@ class TrainStep:
                                          key_data, x, y)
             new_vals = []
             new_state = []
-            for w, g, st, lr, wd in zip(train_vals, grads, opt_state,
-                                        lrs, wds):
-                w2, st2 = self._opt_update(w, g, st, lr, wd)
+            for i, (w, g, st) in enumerate(zip(train_vals, grads,
+                                               opt_state)):
+                w2, st2 = self._opt_update(w, g, st, lrs[i], wds[i])
                 new_vals.append(w2)
                 new_state.append(st2)
             return loss, tuple(new_vals), tuple(new_state), raw_aux
@@ -241,7 +244,7 @@ class TrainStep:
         # learn the aux structure without device work
         train_vals = tuple(params[i]._data._data for i in train_idx)
         frozen_vals = tuple(params[i]._data._data for i in frozen_idx)
-        zeros = tuple(jnp.float32(0.0) for _ in train_idx)
+        zeros = jnp.zeros(len(train_idx), jnp.float32)
         jax.eval_shape(step, train_vals, frozen_vals, self._opt_state,
                        jax.random.key_data(key), zeros, zeros,
                        x_raw, y_raw)
@@ -289,19 +292,20 @@ class TrainStep:
         return NDArray(loss, None, _placed=True)
 
     def _lrs_wds(self):
-        """Per-parameter (lr, wd) scalars for this step — traced args, so
-        scheduler/mult changes never trigger a recompile.  The raw
-        ``adam_update`` op does not bias-correct, so the correction is
-        folded into the lr here (matches the eager ``Adam.update``)."""
+        """Per-parameter (lr, wd) vectors for this step — two traced
+        array args (one transfer each), so scheduler/mult changes never
+        trigger a recompile.  The raw ``adam_update`` op does not
+        bias-correct, so the correction is folded into the lr here
+        (matches the eager ``Adam.update``)."""
         opt = self.optimizer
         opt.num_update = self._t
+        base_lr = opt.learning_rate
         bias = 1.0
         if isinstance(opt, opt_mod.Adam):
             t = self._t
             bias = np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
-        n = len(self._train_idx)
-        lrs = tuple(jnp.float32(opt._get_lr(j) * bias) for j in range(n))
-        wds = tuple(jnp.float32(opt._get_wd(j)) for j in range(n))
+        lrs = jnp.asarray(base_lr * bias * self._lr_mults)
+        wds = jnp.asarray(opt.wd * self._wd_mults)
         return lrs, wds
 
 

@@ -1,0 +1,168 @@
+"""Symbol graph-lite + Executor tests.
+
+Mirrors the reference's ``tests/python/unittest/test_symbol.py``† and the
+executor pieces of ``test_executor.py``†: composition, JSON round-trip,
+infer_shape, bind/forward/backward, export→imports.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, sym
+from mxtpu.gluon import nn, SymbolBlock
+
+
+def test_arith_eval_and_scalar_ops():
+    a, b = sym.var("a"), sym.var("b")
+    c = (2.0 * a + b ** 2 - 1.0) / 2.0
+    av = nd.array(np.full((2, 3), 3.0, np.float32))
+    bv = nd.array(np.full((2, 3), 2.0, np.float32))
+    out = c.eval(a=av, b=bv)[0].asnumpy()
+    assert np.allclose(out, (2 * 3.0 + 4.0 - 1) / 2)
+    d = (1.0 - a) * (a >= 3.0)
+    assert np.allclose(d.eval(a=av)[0].asnumpy(), -2.0)
+
+
+def test_list_arguments_and_auto_vars():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2", no_bias=True)
+    assert fc2.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight"]
+    assert fc2.list_outputs() == ["fc2_output"]
+
+
+def test_json_roundtrip_file(tmp_path):
+    data = sym.var("data")
+    net = sym.Activation(
+        sym.FullyConnected(data, num_hidden=8, name="fc"),
+        act_type="tanh")
+    fname = str(tmp_path / "net-symbol.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+    x = nd.array(np.random.randn(2, 5).astype(np.float32))
+    w = nd.array(np.random.randn(8, 5).astype(np.float32))
+    b = nd.array(np.zeros(8, np.float32))
+    o1 = net.eval(data=x, fc_weight=w, fc_bias=b)[0].asnumpy()
+    o2 = net2.eval(data=x, fc_weight=w, fc_bias=b)[0].asnumpy()
+    assert np.allclose(o1, o2)
+
+
+def test_infer_shape_conv_net():
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = sym.flatten(p1)
+    fc = sym.FullyConnected(f1, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(4, 3, 28, 28))
+    args = fc.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["c1_bias"] == (8,)
+    assert d["fc_weight"] == (10, 8 * 13 * 13)
+    assert out_shapes == [(4, 10)]
+
+
+def test_infer_shape_partial_and_error():
+    a = sym.var("a")
+    w = sym.var("w")
+    out = sym.FullyConnected(a, w, no_bias=True, num_hidden=4)
+    shapes, outs, _ = out.infer_shape_partial()
+    assert outs == [None]
+    with pytest.raises(mx.MXNetError):
+        sym.broadcast_add(a, w).infer_shape(a=(2, 2))
+
+
+def test_multi_output_indexing_and_group():
+    data = sym.var("data")
+    parts = sym.split(data, num_outputs=3, axis=1)
+    assert len(parts) == 3
+    g = sym.Group([parts[0], parts[2]])
+    outs = g.eval(data=nd.array(np.arange(12, dtype=np.float32)
+                                .reshape(2, 6)))
+    assert outs[0].shape == (2, 2) and outs[1].shape == (2, 2)
+    assert np.allclose(outs[1].asnumpy(), [[4, 5], [10, 11]])
+
+
+def test_get_internals_and_lookup():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=2, name="fc2")
+    internals = fc2.get_internals()
+    assert "relu1_output" in internals.list_outputs()
+    feat = fc2["relu1_output"]
+    assert feat.name == "relu1"
+
+
+def test_composition():
+    base = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc1")
+    head = sym.Activation(sym.var("in2"), act_type="relu")
+    composed = head(in2=base)
+    assert "data" in composed.list_arguments()
+    assert "in2" not in composed.list_arguments()
+
+
+def test_executor_forward_backward_matches_autograd():
+    rng = np.random.RandomState(7)
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    loss = sym.sum(sym.square(fc))
+    ex = loss.simple_bind(grad_req="write", data=(4, 5))
+    xv = rng.randn(4, 5).astype(np.float32)
+    wv = rng.randn(3, 5).astype(np.float32)
+    bv = rng.randn(3).astype(np.float32)
+    ex.arg_dict["fc_weight"] = nd.array(wv)
+    ex.arg_dict["fc_bias"] = nd.array(bv)
+    ex.forward(is_train=True, data=nd.array(xv))
+    ex.backward()
+    # reference: d(sum((xW'+b)^2))/dW = 2 (xW'+b)' x
+    y = xv.dot(wv.T) + bv
+    expected = 2 * y.T.dot(xv)
+    assert np.allclose(ex.grad_dict["fc_weight"].asnumpy(), expected,
+                       rtol=1e-4, atol=1e-4)
+    # grad_req add accumulates
+    ex2 = loss.simple_bind(grad_req="add", data=(4, 5))
+    ex2.arg_dict["fc_weight"] = nd.array(wv)
+    ex2.arg_dict["fc_bias"] = nd.array(bv)
+    for _ in range(2):
+        ex2.forward(is_train=True, data=nd.array(xv))
+        ex2.backward()
+    assert np.allclose(ex2.grad_dict["fc_weight"].asnumpy(), 2 * expected,
+                       rtol=1e-4, atol=1e-4)
+
+
+def test_symbolic_trace_of_gluon_block():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.randn(2, 6).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    s = net(sym.var("data"))
+    assert isinstance(s, sym.Symbol)
+    bindings = {"data": x}
+    for name, p in net.collect_params().items():
+        bindings[name] = p.data()
+    y_sym = s.eval(**bindings)[0].asnumpy()
+    assert np.allclose(y_eager, y_sym, rtol=1e-5, atol=1e-5)
+
+
+def test_export_imports_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, activation="relu"),
+            nn.BatchNorm(),
+            nn.Flatten(),
+            nn.Dense(5))
+    net.initialize(init="xavier")
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    sym_file, param_file = net.export(prefix, epoch=3)
+    assert sym_file.endswith("-symbol.json")
+    assert param_file.endswith("-0003.params")
+    blk = SymbolBlock.imports(sym_file, ["data"], param_file)
+    y1 = blk(x)
+    y1 = (y1[0] if isinstance(y1, (list, tuple)) else y1).asnumpy()
+    assert np.allclose(y0, y1, rtol=1e-5, atol=1e-6)
