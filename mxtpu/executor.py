@@ -49,6 +49,11 @@ class Executor:
                               for n in arg_names}
 
         if args_grad is None:
+            missing = [n for n in arg_names if n not in self.arg_dict]
+            if missing:
+                raise MXNetError(
+                    f"bind: unbound argument(s) {missing}; pass arrays for "
+                    f"every name in list_arguments() = {arg_names}")
             args_grad = {n: nd_mod.zeros(self.arg_dict[n].shape)
                          for n in arg_names
                          if self._grad_req.get(n, "null") != "null"}
@@ -100,10 +105,13 @@ class Executor:
 
     def forward(self, is_train: bool = False, **kwargs):
         for name, val in kwargs.items():
-            if name not in self.arg_dict and not _is_aux_name(name):
+            val = val if isinstance(val, NDArray) else nd_mod.array(val)
+            if name in self.arg_dict:
+                self.arg_dict[name] = val
+            elif name in self.aux_dict or _is_aux_name(name):
+                self.aux_dict[name] = val
+            else:
                 raise MXNetError(f"unknown argument {name!r}")
-            self.arg_dict[name] = val if isinstance(val, NDArray) \
-                else nd_mod.array(val)
 
         bindings: Dict[str, NDArray] = {}
         bindings.update(self.aux_dict)

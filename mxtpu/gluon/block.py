@@ -459,13 +459,12 @@ class HybridBlock(Block):
         sym = out if isinstance(out, sym_mod.Symbol) \
             else sym_mod.Group([o for o in out])
         sym.save(f"{path}-symbol.json")
+        from ..symbol import _is_aux_name
         arrays = {}
         for p in self.collect_params().values():
             if p._data is None:
                 continue
-            tag = "aux:" if p.name.endswith(("running_mean", "running_var",
-                                             "moving_mean", "moving_var")) \
-                else "arg:"
+            tag = "aux:" if _is_aux_name(p.name) else "arg:"
             arrays[tag + p.name] = p.data()
         nd_mod.save(f"{path}-{epoch:04d}.params", arrays)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"

@@ -171,13 +171,6 @@ class TrainStep:
             # touching the optimizer's own param_dict/idx2name — those
             # may be indexed by a different ordering (e.g. a shared
             # gluon.Trainer instance).
-            opt = self.optimizer
-            self._lr_mults = np.asarray(
-                [allp[i].lr_mult * opt.lr_mult.get(allp[i].name, 1.0)
-                 for i in self._train_idx], np.float32)
-            self._wd_mults = np.asarray(
-                [allp[i].wd_mult * opt.wd_mult.get(allp[i].name, 1.0)
-                 for i in self._train_idx], np.float32)
             self._opt_init, self._opt_update = _opt_rule(self.optimizer)
             if self.mesh is not None:
                 for p in allp:
@@ -304,8 +297,18 @@ class TrainStep:
         if isinstance(opt, opt_mod.Adam):
             t = self._t
             bias = np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
-        lrs = jnp.asarray(base_lr * bias * self._lr_mults)
-        wds = jnp.asarray(opt.wd * self._wd_mults)
+        # Mults are read live (not cached at setup) so mid-training
+        # changes to Parameter.lr_mult/wd_mult or optimizer.set_lr_mult
+        # take effect on the next step — matching the eager Trainer.
+        allp = self._params
+        lr_mults = np.asarray(
+            [allp[i].lr_mult * opt.lr_mult.get(allp[i].name, 1.0)
+             for i in self._train_idx], np.float32)
+        wd_mults = np.asarray(
+            [allp[i].wd_mult * opt.wd_mult.get(allp[i].name, 1.0)
+             for i in self._train_idx], np.float32)
+        lrs = jnp.asarray(base_lr * bias * lr_mults)
+        wds = jnp.asarray(opt.wd * wd_mults)
         return lrs, wds
 
 

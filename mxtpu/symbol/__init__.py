@@ -112,18 +112,24 @@ class Symbol:
 
     # -- traversal ------------------------------------------------------
     def _topo(self) -> List[_Node]:
-        seen: Dict[int, _Node] = {}
+        # Iterative postorder DFS — graphs (unrolled RNNs, deep chains)
+        # routinely exceed Python's recursion limit.
+        seen: set = set()
         order: List[_Node] = []
-
-        def visit(node: _Node):
+        stack: List[Tuple[_Node, bool]] = [
+            (node, False) for node, _ in reversed(self._heads)]
+        while stack:
+            node, expanded = stack.pop()
+            if expanded:
+                order.append(node)
+                continue
             if id(node) in seen:
-                return
-            seen[id(node)] = node
-            for src, _ in node.inputs:
-                visit(src)
-            order.append(node)
-        for node, _ in self._heads:
-            visit(node)
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for src, _ in reversed(node.inputs):
+                if id(src) not in seen:
+                    stack.append((src, False))
         return order
 
     def list_arguments(self) -> List[str]:
@@ -348,8 +354,10 @@ class Symbol:
 
         shapes: Dict[Tuple[int, int], Optional[Tuple[int, ...]]] = {}
         unknown: set = set()
+        var_nodes: Dict[str, _Node] = {}
         for node in self._topo():
             if node.op is None:
+                var_nodes.setdefault(node.name, node)
                 shp = known.get(node.name)
                 if shp is None and node.attrs.get("__shape__") is not None:
                     shp = tuple(_coerce_attr(node.attrs["__shape__"]))
@@ -378,8 +386,12 @@ class Symbol:
             for i, o in enumerate(outs):
                 shapes[(id(node), i)] = o
 
-        arg_shapes = [shapes.get(_first_head(self, n)) for n in arg_names]
-        aux_shapes = [shapes.get(_first_head(self, n))
+        def _var_head(n):
+            node = var_nodes.get(n)
+            return (id(node), 0) if node is not None else None
+
+        arg_shapes = [shapes.get(_var_head(n)) for n in arg_names]
+        aux_shapes = [shapes.get(_var_head(n))
                       for n in self.list_auxiliary_states()]
         out_shapes = [shapes.get((id(n), i)) for n, i in self._heads]
         # re-scan unknown: hooks may have filled vars
@@ -393,9 +405,10 @@ class Symbol:
         """Everything defaults to float32 unless a var carries
         ``__dtype__`` (the eager path is the dtype oracle; symbols track
         shapes, XLA tracks dtypes)."""
+        var_nodes = {n.name: n for n in self._topo() if n.op is None}
         arg_types = []
         for n in self.list_arguments():
-            node = _find_var(self, n)
+            node = var_nodes.get(n)
             dt = node.attrs.get("__dtype__") if node is not None else None
             arg_types.append(np.dtype(dt) if dt else np.dtype("float32"))
         out_types = [np.dtype("float32")] * len(self._heads)
@@ -762,16 +775,17 @@ def _make_sym_fn(op_name: str):
                     f"sym.{op_name} takes Symbol inputs, got "
                     f"{type(a).__name__} (use nd for eager arrays)")
         if slots is not None:
-            # keyword-named inputs (data=..., weight=...) then auto-vars
-            for slot in slots[len(syms):]:
-                if slot in kwargs and isinstance(kwargs[slot], Symbol):
-                    syms.append(kwargs.pop(slot))
+            # Fill remaining slots IN ORDER: a keyword symbol binds to its
+            # named slot; any earlier unfilled slot gets an auto-var (so
+            # e.g. FullyConnected(data, bias=b) still auto-creates weight).
             node_name = name or _auto_name(op_name)
             n_expected = len(slots)
             if kwargs.get("no_bias") and "bias" in slots:
                 n_expected -= 1
             for slot in slots[len(syms):n_expected]:
-                if slot == "label":
+                if slot in kwargs and isinstance(kwargs[slot], Symbol):
+                    syms.append(kwargs.pop(slot))
+                elif slot == "label":
                     syms.append(var(f"{node_name}_label"))
                 else:
                     syms.append(var(f"{node_name}_{slot}"))

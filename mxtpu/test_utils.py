@@ -1,0 +1,443 @@
+"""Testing utilities — the backend-equivalence and gradient-check harness.
+
+Reference: ``python/mxnet/test_utils.py``† — ``assert_almost_equal``,
+``rand_ndarray``, ``check_numeric_gradient`` (finite differences vs the
+framework backward), ``check_symbolic_forward/backward`` (vs numpy
+references), and ``check_consistency`` (the cpu↔accelerator oracle,
+SURVEY.md §4.2: "the single most important harness to replicate").
+
+TPU-native notes: tolerances are keyed per dtype AND widened on the
+accelerator backend, because TPU matmuls default to bf16-accumulated
+f32 which an exact-f32 CPU reference will not match bitwise.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = [
+    "default_context", "set_default_context", "default_dtype",
+    "default_rtols", "default_atols", "get_tolerance",
+    "same", "almost_equal", "assert_almost_equal", "assert_allclose",
+    "rand_ndarray", "random_arrays", "rand_shape_2d", "rand_shape_3d",
+    "rand_shape_nd", "create_vector",
+    "simple_forward", "check_numeric_gradient", "numeric_grad",
+    "check_symbolic_forward", "check_symbolic_backward",
+    "check_consistency", "assert_exception", "retry",
+]
+
+_default_ctx: Optional[Context] = None
+
+
+def default_context() -> Context:
+    """Current default test context (reference ``default_context()``†)."""
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+# ----------------------------------------------------------------------
+# tolerances
+# ----------------------------------------------------------------------
+
+#: per-dtype rtol/atol, split by backend class.  The accelerator column is
+#: looser for f32 because the MXU accumulates bf16 products (SURVEY §7
+#: hard-part 9: "bf16-default matmuls vs fp32 CPU refs").
+default_rtols = {
+    "cpu": {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
+            np.dtype(np.float64): 1e-6, "bfloat16": 2e-2},
+    "accel": {np.dtype(np.float16): 2e-2, np.dtype(np.float32): 1e-2,
+              np.dtype(np.float64): 1e-5, "bfloat16": 4e-2},
+}
+default_atols = {
+    "cpu": {np.dtype(np.float16): 1e-3, np.dtype(np.float32): 1e-5,
+            np.dtype(np.float64): 1e-8, "bfloat16": 1e-2},
+    "accel": {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-3,
+              np.dtype(np.float64): 1e-6, "bfloat16": 2e-2},
+}
+
+
+def _backend_class() -> str:
+    return "cpu" if jax.default_backend() == "cpu" else "accel"
+
+
+def _dtype_key(dtype):
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    if "bfloat16" in str(name):
+        return "bfloat16"
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return "bfloat16"
+
+
+def get_tolerance(dtype, rtol=None, atol=None, backend=None):
+    """(rtol, atol) for a dtype on the current backend."""
+    backend = backend or _backend_class()
+    key = _dtype_key(dtype)
+    if rtol is None:
+        rtol = default_rtols[backend].get(key, 1e-5)
+    if atol is None:
+        atol = default_atols[backend].get(key, 1e-7)
+    return rtol, atol
+
+
+# ----------------------------------------------------------------------
+# comparisons
+# ----------------------------------------------------------------------
+
+def _as_numpy(x) -> np.ndarray:
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return np.asarray(x)
+
+
+def same(a, b) -> bool:
+    """Exact equality (reference ``same``†)."""
+    return np.array_equal(_as_numpy(a), _as_numpy(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
+    a, b = _as_numpy(a), _as_numpy(b)
+    rtol, atol = get_tolerance(a.dtype if a.dtype.kind == "f" else np.float32,
+                               rtol, atol)
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Reference ``assert_almost_equal``† — reports the worst-offending
+    location on failure."""
+    a_np, b_np = _as_numpy(a), _as_numpy(b)
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{a_np.shape} vs {names[1]}{b_np.shape}")
+    ref_dtype = a_np.dtype if a_np.dtype.kind == "f" else np.float32
+    rtol, atol = get_tolerance(ref_dtype, rtol, atol)
+    if np.allclose(a_np.astype(np.float64), b_np.astype(np.float64),
+                   rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    af, bf = a_np.astype(np.float64), b_np.astype(np.float64)
+    err = np.abs(af - bf) - (atol + rtol * np.abs(bf))
+    err = np.where(np.isnan(err), np.inf, err)
+    idx = np.unravel_index(int(np.argmax(err)), err.shape) if err.shape else ()
+    raise AssertionError(
+        f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): "
+        f"worst at {idx}: {af[idx]!r} vs {bf[idx]!r}; "
+        f"max |a-b| = {np.nanmax(np.abs(af - bf)):.6g}")
+
+
+assert_allclose = assert_almost_equal
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    """Assert fn(*args, **kwargs) raises exception_type (reference†)."""
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"did not raise {exception_type.__name__}")
+
+
+def retry(n):
+    """Retry a flaky (statistical) test up to n times (reference ``retry``†)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            last = None
+            for _ in range(n):
+                try:
+                    return fn(*args, **kwargs)
+                except AssertionError as e:  # pragma: no cover - flake path
+                    last = e
+            raise last
+        return wrapper
+    return deco
+
+
+# ----------------------------------------------------------------------
+# random data
+# ----------------------------------------------------------------------
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, scale=1.0):
+    """Random NDArray, dense or (API-parity) sparse
+    (reference ``rand_ndarray``†)."""
+    dtype = dtype or default_dtype()
+    data = (np.random.uniform(-1, 1, size=shape) * scale).astype(dtype)
+    if stype in ("row_sparse", "csr"):
+        density = 0.5 if density is None else density
+        mask = np.random.uniform(0, 1, size=shape) < density
+        data = data * mask
+        from .ndarray import sparse
+        dense = array(data, ctx=ctx)
+        return dense.tostype(stype) if hasattr(dense, "tostype") else dense
+    return array(data, ctx=ctx)
+
+
+def random_arrays(*shapes) -> List[np.ndarray]:
+    """Numpy arrays of the given shapes (reference ``random_arrays``†)."""
+    arrays = [np.random.randn(*s).astype(default_dtype()) if s else
+              np.array(np.random.randn(), dtype=default_dtype())
+              for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def create_vector(size, dtype=np.int64):
+    return array(np.arange(size, dtype=dtype))
+
+
+# ----------------------------------------------------------------------
+# executor plumbing shared by the check_* harnesses
+# ----------------------------------------------------------------------
+
+def _normalize_location(sym, location) -> Dict[str, np.ndarray]:
+    """location may be a list (positional over ``list_arguments``) or a
+    dict name→array, as in the reference harness."""
+    args = sym.list_arguments()
+    if isinstance(location, dict):
+        return {k: _as_numpy(v) for k, v in location.items()}
+    if len(location) != len(args):
+        raise MXNetError(
+            f"location has {len(location)} entries for {len(args)} args")
+    return {name: _as_numpy(v) for name, v in zip(args, location)}
+
+
+def _bind(sym, location, aux_states=None, grad_req="write", ctx=None):
+    from .executor import Executor
+    ctx = ctx or default_context()
+    loc = {k: array(v, ctx=ctx) for k, v in location.items()}
+    grads = None
+    if grad_req != "null":
+        grads = {k: array(np.zeros_like(v), ctx=ctx)
+                 for k, v in location.items()}
+    aux = None
+    if aux_states:
+        aux = {k: array(_as_numpy(v), ctx=ctx) for k, v in aux_states.items()}
+    return sym.bind(ctx=ctx, args=loc, args_grad=grads, grad_req=grad_req,
+                    aux_states=aux)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol on numpy inputs, return numpy outputs
+    (reference ``simple_forward``†)."""
+    loc = {k: _as_numpy(v) for k, v in inputs.items()}
+    exe = _bind(sym, loc, grad_req="null", ctx=ctx)
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outs if len(outs) > 1 else outs[0]
+
+
+# ----------------------------------------------------------------------
+# gradient checking
+# ----------------------------------------------------------------------
+
+def numeric_grad(f, location: Dict[str, np.ndarray], eps=1e-4,
+                 grad_nodes: Optional[Sequence[str]] = None,
+                 dtype=np.float64) -> Dict[str, np.ndarray]:
+    """Central-difference gradient of scalar ``f(location)`` w.r.t. each
+    entry (reference's numeric side of ``check_numeric_gradient``†)."""
+    grad_nodes = list(grad_nodes) if grad_nodes else list(location)
+    grads = {}
+    base = {k: v.astype(dtype) for k, v in location.items()}
+    for name in grad_nodes:
+        x = base[name]
+        g = np.zeros_like(x)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = f({k: v for k, v in base.items()})
+            flat[i] = orig - eps
+            fm = f({k: v for k, v in base.items()})
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2 * eps)
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           ctx=None, dtype=np.float64):
+    """Finite-difference-check the framework backward of ``sym``
+    (reference ``check_numeric_gradient``†).  The symbol's outputs are
+    contracted against a fixed random projection to produce the scalar
+    objective, exactly as the reference does."""
+    location = _normalize_location(sym, location)
+    location = {k: v.astype(dtype) for k, v in location.items()}
+    grad_nodes = list(grad_nodes) if grad_nodes else list(location)
+    ctx = ctx or default_context()
+
+    exe = _bind(sym, location, aux_states=aux_states, ctx=ctx)
+    outs = exe.forward(is_train=True)
+    proj = [np.random.normal(0, 0.01, size=o.shape).astype(dtype)
+            for o in outs]
+    exe.backward(out_grads=[array(p, ctx=ctx) for p in proj])
+    sym_grads = {name: g.asnumpy()
+                 for name, g in zip(sym.list_arguments(), exe.grad_arrays)
+                 if g is not None and name in grad_nodes}
+
+    def objective(loc_np):
+        e = _bind(sym, loc_np, aux_states=aux_states, grad_req="null",
+                  ctx=ctx)
+        os_ = e.forward(is_train=True)
+        return float(sum((o.asnumpy().astype(dtype) * p).sum()
+                         for o, p in zip(os_, proj)))
+
+    num_grads = numeric_grad(objective, location, eps=numeric_eps,
+                             grad_nodes=grad_nodes, dtype=dtype)
+    atol = atol if atol is not None else 1e-4
+    for name in grad_nodes:
+        assert_almost_equal(sym_grads[name], num_grads[name], rtol=rtol,
+                            atol=atol,
+                            names=(f"autograd[{name}]", f"numeric[{name}]"))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
+                           aux_states=None, ctx=None):
+    """Compare sym's forward against numpy-reference outputs
+    (reference ``check_symbolic_forward``†)."""
+    location = _normalize_location(sym, location)
+    exe = _bind(sym, location, aux_states=aux_states, grad_req="null",
+                ctx=ctx)
+    outs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            names=(f"forward[{i}]", f"expected[{i}]"))
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare sym's backward against numpy-reference input grads
+    (reference ``check_symbolic_backward``†)."""
+    location = _normalize_location(sym, location)
+    ctx = ctx or default_context()
+    exe = _bind(sym, location, aux_states=aux_states, grad_req=grad_req,
+                ctx=ctx)
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[array(_as_numpy(g), ctx=ctx) for g in out_grads])
+    got = {name: g.asnumpy()
+           for name, g in zip(sym.list_arguments(), exe.grad_arrays)
+           if g is not None}
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    for name, e in expected.items():
+        assert_almost_equal(got[name], e, rtol=rtol, atol=atol,
+                            names=(f"grad[{name}]", f"expected[{name}]"))
+    return got
+
+
+# ----------------------------------------------------------------------
+# cross-backend consistency — the cpu↔tpu oracle
+# ----------------------------------------------------------------------
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      rtol=None, atol=None, aux_states=None,
+                      arg_params=None):
+    """Run the same symbol on every context in ``ctx_list`` and
+    cross-compare forward outputs and input gradients within per-dtype
+    tolerance (reference ``check_consistency``†, the main backend
+    equivalence oracle per SURVEY §4.2).
+
+    ctx_list entries are either Contexts or dicts
+    ``{"ctx": Context, "type_dict": {argname: dtype}}`` as in the
+    reference.  The highest-precision run is the comparison baseline.
+    On a single-backend machine (tests on CPU) this still exercises
+    dtype consistency (e.g. f32 vs f16 variants).
+    """
+    assert len(ctx_list) > 1, "need at least two contexts/variants"
+    norm = []
+    for entry in ctx_list:
+        if isinstance(entry, Context):
+            norm.append({"ctx": entry, "type_dict": {}})
+        else:
+            norm.append({"ctx": entry.get("ctx", default_context()),
+                         "type_dict": dict(entry.get("type_dict", {}))})
+
+    args = sym.list_arguments()
+    shapes_known = arg_params is not None
+    if not shapes_known:
+        raise MXNetError("check_consistency requires arg_params "
+                         "(dict name→numpy array) to fix shapes")
+    base_loc = {k: _as_numpy(v) * scale for k, v in arg_params.items()}
+
+    runs = []
+    for entry in norm:
+        loc = {k: v.astype(entry["type_dict"].get(k, v.dtype))
+               for k, v in base_loc.items()}
+        exe = _bind(sym, loc, aux_states=aux_states, grad_req=grad_req,
+                    ctx=entry["ctx"])
+        outs = [o.asnumpy() for o in exe.forward(is_train=grad_req != "null")]
+        grads = None
+        if grad_req != "null":
+            # identical head grads across runs (seeded independently of
+            # the per-test global stream)
+            rs = np.random.RandomState(0)
+            ograds = [rs.normal(0, 1, size=o.shape).astype(o.dtype)
+                      for o in outs]
+            exe.backward(out_grads=[array(g, ctx=entry["ctx"])
+                                    for g in ograds])
+            grads = {name: g.asnumpy() for name, g in
+                     zip(args, exe.grad_arrays) if g is not None}
+        runs.append({"entry": entry, "outs": outs, "grads": grads})
+
+    # baseline = widest dtype
+    def _prec(run):
+        dts = list(run["entry"]["type_dict"].values()) or [np.float32]
+        return max(np.dtype(d).itemsize if d != "bfloat16" else 2
+                   for d in dts)
+    base = max(runs, key=_prec)
+
+    for run in runs:
+        if run is base:
+            continue
+        dts = list(run["entry"]["type_dict"].values()) or [np.float32]
+        worst = min(dts, key=lambda d: 8 if d == "bfloat16" else
+                    np.dtype(d).itemsize * 4)
+        for i, (o, bo) in enumerate(zip(run["outs"], base["outs"])):
+            assert_almost_equal(o.astype(np.float64), bo.astype(np.float64),
+                                *get_tolerance(worst, rtol, atol),
+                                names=(f"{run['entry']['ctx']}.out[{i}]",
+                                       f"{base['entry']['ctx']}.out[{i}]"))
+        if run["grads"] is not None:
+            for name in run["grads"]:
+                assert_almost_equal(
+                    run["grads"][name].astype(np.float64),
+                    base["grads"][name].astype(np.float64),
+                    *get_tolerance(worst, rtol, atol),
+                    names=(f"{run['entry']['ctx']}.grad[{name}]",
+                           f"{base['entry']['ctx']}.grad[{name}]"))
+    return runs
