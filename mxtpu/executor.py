@@ -39,6 +39,11 @@ class Executor:
         self.arg_dict = self._name_arrays(args, arg_names, "args")
         self.aux_dict = self._name_arrays(aux_states, aux_names,
                                           "aux_states")
+        missing = [n for n in arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(
+                f"bind: unbound argument(s) {missing}; pass arrays for "
+                f"every name in list_arguments() = {arg_names}")
 
         if isinstance(grad_req, str):
             self._grad_req = {n: grad_req for n in arg_names}
@@ -49,11 +54,6 @@ class Executor:
                               for n in arg_names}
 
         if args_grad is None:
-            missing = [n for n in arg_names if n not in self.arg_dict]
-            if missing:
-                raise MXNetError(
-                    f"bind: unbound argument(s) {missing}; pass arrays for "
-                    f"every name in list_arguments() = {arg_names}")
             args_grad = {n: nd_mod.zeros(self.arg_dict[n].shape)
                          for n in arg_names
                          if self._grad_req.get(n, "null") != "null"}

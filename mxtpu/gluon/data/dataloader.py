@@ -1,0 +1,104 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``†).
+
+TPU-native divergence from the reference: the reference forks
+multiprocessing workers that write batches into POSIX-shm NDArrays
+(``cpu_shared_storage_manager.h``†).  Forking a process that holds a
+live TPU/PjRt client is unsafe (and jax state is not fork-inheritable),
+so ``num_workers > 0`` here means a **thread pool** — batchify runs in
+numpy (releasing the GIL for decode/copy) and the device transfer stays
+on the consumer thread.  The C++ pipeline in ``core/`` supplies true
+parallel decode underneath when built.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference ``default_batchify_fn``†)."""
+    if isinstance(data[0], NDArray):
+        return array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        transposed = zip(*data)
+        return tuple(default_batchify_fn(list(col)) for col in transposed)
+    arr = np.asarray(data)
+    return array(arr)
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference ``DataLoader``†)."""
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, prefetch: Optional[int] = None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("need batch_size unless batch_sampler "
+                                 "is given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle and sampler are exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_sampler is exclusive with batch_size/"
+                             "shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+
+        # Thread-pool pipeline with bounded in-flight futures — the
+        # prefetcher's double buffering generalized.
+        with ThreadPoolExecutor(self._num_workers) as pool:
+            batches = iter(self._batch_sampler)
+            inflight: _queue.Queue = _queue.Queue()
+            depth = max(1, self._prefetch)
+
+            def submit_next():
+                try:
+                    indices = next(batches)
+                except StopIteration:
+                    return False
+                inflight.put(pool.submit(self._load_batch, indices))
+                return True
+
+            for _ in range(depth):
+                if not submit_next():
+                    break
+            while not inflight.empty():
+                fut = inflight.get()
+                submit_next()
+                yield fut.result()

@@ -1,0 +1,166 @@
+"""Vision transforms (reference
+``python/mxnet/gluon/data/vision/transforms.py``†).
+
+Transforms are HybridBlocks over HWC uint8/float NDArrays so a
+``Compose`` chain can hybridize into one XLA program and fuse with the
+first model layers when used on-device; on the host path they run as
+eager jax ops on CPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray import NDArray, array
+from ... import nn
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast"]
+
+
+class Compose(nn.Sequential):
+    """Sequentially compose transforms (reference ``Compose``†)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference ``ToTensor``†)."""
+
+    def hybrid_forward(self, F, x):
+        x = x.astype("float32") / 255.0
+        if len(x.shape) == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std over channels of a CHW tensor (reference†)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        return (x - array(self._mean)) / array(self._std)
+
+
+def _resize_hwc(x: NDArray, size) -> NDArray:
+    import jax
+    w, h = (size, size) if isinstance(size, int) else size
+    raw = x.data.astype("float32")
+    if raw.ndim == 2:
+        raw = raw[:, :, None]
+    out = jax.image.resize(raw, (h, w, raw.shape[2]), method="bilinear")
+    return NDArray(out, None, _placed=True)
+
+
+class Resize(Block):
+    """Resize HWC image (reference ``Resize``†; ``jax.image.resize`` is
+    the interpolator — runs on whatever backend holds the array)."""
+
+    def __init__(self, size, keep_ratio=False):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        if self._keep and isinstance(self._size, int):
+            h, w = x.shape[:2]
+            if h < w:
+                size = (int(self._size * w / h), self._size)
+            else:
+                size = (self._size, int(self._size * h / w))
+        else:
+            size = self._size
+        return _resize_hwc(x, size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        ih, iw = x.shape[:2]
+        if ih < h or iw < w:
+            return _resize_hwc(x, self._size)
+        y0 = (ih - h) // 2
+        x0 = (iw - w) // 2
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop then resize (reference†, simplified to
+    the same parameter surface)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        ih, iw = x.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * aspect)))
+            h = int(round(np.sqrt(target / aspect)))
+            if w <= iw and h <= ih:
+                x0 = np.random.randint(0, iw - w + 1)
+                y0 = np.random.randint(0, ih - h + 1)
+                return _resize_hwc(x[y0:y0 + h, x0:x0 + w], self._size)
+        return _resize_hwc(x, self._size)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x[:, ::-1]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x[::-1]
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = 1.0 + np.random.uniform(-self._b, self._b)
+        return x * f
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = 1.0 + np.random.uniform(-self._c, self._c)
+        mean = x.mean()
+        return x * f + mean * (1.0 - f)

@@ -1,0 +1,547 @@
+"""Data iterators — the ``mx.io`` surface.
+
+Reference: ``python/mxnet/io.py``† (``DataIter``, ``DataBatch``,
+``DataDesc``, ``NDArrayIter``, ``ResizeIter``, ``PrefetchingIter``) and
+the C++ iterators in ``src/io/``† (``MNISTIter``, ``CSVIter``,
+``ImageRecordIter``).
+
+TPU-native notes: iterators yield host-side batches; placement onto the
+chip is the consumer's job (gluon ``split_and_load`` / the compiled
+train step), so the pipeline overlaps host decode with device compute
+the way the reference's PrefetcherIter overlaps H2D copies
+(``src/io/iter_prefetcher.h``†).  Batches are padded, never ragged —
+static shapes keep XLA from recompiling per batch.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """Shape/dtype descriptor of one input (reference ``DataDesc``†)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), np.dtype(dtype),
+                               layout)
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch (reference ``DataBatch``†). ``pad`` = #samples at the
+    tail that are padding (replicated), to be ignored by metrics."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """Iterator base (reference ``DataIter``†)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty: bool, default_name: str):
+    """Normalize data/label argument into an ordered name→ndarray list
+    (reference ``_init_data``†)."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise MXNetError("empty data list")
+        if len(data) == 1:
+            items = [(default_name, data[0])]
+        else:
+            items = [(f"_{i}_{default_name}", d)
+                     for i, d in enumerate(data)]
+    elif isinstance(data, dict):
+        items = sorted(data.items())
+    else:
+        raise MXNetError(f"unsupported data type {type(data)}")
+    out = []
+    for name, arr in items:
+        if isinstance(arr, NDArray):
+            arr = arr.asnumpy()
+        out.append((name, np.asarray(arr)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference ``NDArrayIter``†).
+
+    last_batch_handle: 'pad' (replicate from the head; ``batch.pad``
+    reports the count), 'discard', or 'roll_over' (leftover prepends the
+    next epoch).
+    """
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        for name, arr in self.data + self.label:
+            if arr.shape[0] != self.num_data:
+                raise MXNetError(
+                    f"{name} has {arr.shape[0]} samples, expected "
+                    f"{self.num_data}")
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"bad last_batch_handle {last_batch_handle}")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._rollover_remainder: Optional[np.ndarray] = None
+        self._order = np.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:],
+                         arr.dtype)
+                for name, arr in self.data]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return [DataDesc(name, (self.batch_size,) + arr.shape[1:],
+                         arr.dtype)
+                for name, arr in self.label]
+
+    def reset(self):
+        order = np.arange(self.num_data)
+        if self.shuffle:
+            np.random.shuffle(order)
+        if self._rollover_remainder is not None and \
+                self.last_batch_handle == "roll_over":
+            order = np.concatenate([self._rollover_remainder, order])
+            self._rollover_remainder = None
+        self._order = order
+        self.cursor = 0
+
+    def iter_next(self) -> bool:
+        n = len(self._order)
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= n
+        if self.cursor >= n:
+            return False
+        if self.cursor + self.batch_size > n and \
+                self.last_batch_handle == "roll_over":
+            self._rollover_remainder = self._order[self.cursor:]
+            return False
+        return True
+
+    def next(self) -> DataBatch:
+        if not self.iter_next():
+            raise StopIteration
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        pad = self.batch_size - len(idx)
+        if pad:
+            idx = np.concatenate([idx, self._order[:pad]])
+        self.cursor += self.batch_size
+        data = [array(arr[idx]) for _, arr in self.data]
+        label = [array(arr[idx]) for _, arr in self.label]
+        return DataBatch(data=data, label=label, pad=pad,
+                         index=idx.copy(),
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to a fixed number of batches per epoch
+    (reference ``ResizeIter``†)."""
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch: Optional[DataBatch] = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self) -> bool:
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self) -> DataBatch:
+        if not self.iter_next():
+            raise StopIteration
+        return self.current_batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators
+    (reference ``PrefetchingIter``†, the python face of
+    ``iter_prefetcher.h``†'s double buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        self.iters = iters if isinstance(iters, (list, tuple)) else [iters]
+        super().__init__(self.iters[0].batch_size)
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        return sum([it.provide_data for it in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([it.provide_label for it in self.iters], [])
+
+    def reset(self):
+        self._stop.set()
+        # drain so the worker can exit a blocking put
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join()
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self) -> DataBatch:
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([b.label for b in batches], []),
+            pad=max(b.pad for b in batches))
+
+    def iter_next(self):
+        raise MXNetError("use next() on PrefetchingIter")
+
+    def __del__(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference C++ ``CSVIter``,
+    ``src/io/iter_csv.cc``†) — host-side parse, padded final batch."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **_ignored):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", ndmin=2,
+                          dtype=np.float32)
+        self._data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", ndmin=2,
+                               dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((len(self._data),) + tuple(label_shape),
+                             np.float32)
+        self._inner = NDArrayIter(
+            {"data": self._data}, {"label": label}, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def _read_idx_ubyte(path: str) -> np.ndarray:
+    """Read an IDX-format file (the MNIST container)."""
+    with open(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        dtype_code = (magic >> 8) & 0xFF
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                  0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+        data = np.frombuffer(f.read(), dtype=np.dtype(dtypes[dtype_code])
+                             .newbyteorder(">"))
+        return data.reshape(dims).astype(dtypes[dtype_code])
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-file iterator (reference ``MNISTIter``,
+    ``src/io/iter_mnist.cc``†)."""
+
+    def __init__(self, image: str, label: str, batch_size=128,
+                 shuffle=True, flat=False, seed=0, silent=True,
+                 **_ignored):
+        super().__init__(batch_size)
+        imgs = _read_idx_ubyte(image).astype(np.float32) / 255.0
+        labels = _read_idx_ubyte(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.reshape(len(imgs), 1, imgs.shape[1], imgs.shape[2])
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(imgs))
+            imgs, labels = imgs[order], labels[order]
+        self._inner = NDArrayIter({"data": imgs}, {"label": labels},
+                                  batch_size=batch_size,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with decode + augmentation
+    (reference ``ImageRecordIter``, ``src/io/iter_image_recordio_2.cc``†).
+
+    Python threads do the JPEG decode (the C++ pipeline in ``core/`` is
+    the fast path once built); augmentation params mirror the reference's
+    ``image_aug_default.cc``† subset that TPU input pipelines use.
+    """
+
+    def __init__(self, path_imgrec: str, data_shape, batch_size=1,
+                 path_imgidx: Optional[str] = None, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean_r=0.0,
+                 mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 scale=1.0, label_width=1, round_batch=True,
+                 preprocess_threads=4, seed=0, **_ignored):
+        super().__init__(batch_size)
+        from . import recordio as rio
+        self.data_shape = tuple(data_shape)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+        self.scale = scale
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        if path_imgidx and os.path.exists(path_imgidx):
+            self._rec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                              "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = rio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+            if shuffle:
+                raise MXNetError("shuffle requires path_imgidx")
+        self.last_batch_handle = "pad" if round_batch else "discard"
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        else:
+            self._rec.reset()
+        self._exhausted = False
+
+    def _read_raw(self) -> Optional[bytes]:
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            raw = self._rec.read_idx(self._order[self._pos])
+            self._pos += 1
+            return raw
+        return self._rec.read()
+
+    def _decode_one(self, raw: bytes):
+        from . import recordio as rio
+        header, img = rio.unpack_img(raw, iscolor=1)
+        c, h, w = self.data_shape
+        ih, iw = img.shape[:2]
+        if self.rand_crop and ih >= h and iw >= w:
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+            img = img[y0:y0 + h, x0:x0 + w]
+        elif (ih, iw) != (h, w):
+            import cv2
+            img = cv2.resize(img, (w, h))
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img[:, :, ::-1].astype(np.float32)  # BGR→RGB
+        img = (img * self.scale - self.mean) / self.std
+        label = header.label
+        if isinstance(label, np.ndarray) and self.label_width == 1:
+            label = float(label[0])
+        return img.transpose(2, 0, 1), label
+
+    def next(self) -> DataBatch:
+        if self._exhausted:
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        labels = np.zeros((self.batch_size, self.label_width), np.float32)
+        n = 0
+        while n < self.batch_size:
+            raw = self._read_raw()
+            if raw is None:
+                break
+            img, label = self._decode_one(raw)
+            data[n] = img
+            labels[n] = label
+            n += 1
+        if n == 0:
+            self._exhausted = True
+            raise StopIteration
+        pad = self.batch_size - n
+        if pad:
+            self._exhausted = True
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            for i in range(n, self.batch_size):
+                data[i] = data[i - n]
+                labels[i] = labels[i - n]
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch(data=[array(data)], label=[array(lab)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        try:
+            self._batch = self.next()
+            return True
+        except StopIteration:
+            return False

@@ -1,0 +1,260 @@
+"""RecordIO — binary record container, format-compatible with the
+reference so ``im2rec``-produced ``.rec``/``.idx`` datasets load as-is.
+
+Reference: ``python/mxnet/recordio.py``† (pure-python MXRecordIO /
+MXIndexedRecordIO over the dmlc-core C codec) and
+``3rdparty/dmlc-core/include/dmlc/recordio.h``† (the wire format:
+``kMagic = 0xced7230a``; per record a u32 magic, a u32 whose upper 3
+bits are the continuation flag and lower 29 bits the payload length,
+then the payload padded to a 4-byte boundary).
+
+TPU-native note: the hot path (training input) prefers the C++ codec in
+``mxtpu.core`` when built (see ``core/``); this module is the always-
+available pure-python implementation and the API surface.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_K_MAGIC = 0xCED7230A
+_FLAG_BITS = 29
+_LEN_MASK = (1 << _FLAG_BITS) - 1
+
+
+def _encode_lrec(cflag: int, length: int) -> int:
+    return (cflag << _FLAG_BITS) | length
+
+
+def _decode_lrec(lrec: int):
+    return lrec >> _FLAG_BITS, lrec & _LEN_MASK
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference ``MXRecordIO``†).
+
+    Large records are split into continuation chunks exactly as
+    dmlc-core does, so files interoperate both directions.
+    """
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r} (use 'r'/'w')")
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+            self.pid = None
+
+    def reset(self):
+        """Seek back to the beginning (read mode)."""
+        self.close()
+        self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # Reference behavior: a forked DataLoader worker must re-open its
+        # own file handle (the descriptor's offset is shared after fork).
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.close()
+                self.open()
+            else:
+                raise MXNetError("RecordIO handle used in a forked "
+                                 "process; call reset() first")
+
+    def write(self, buf: bytes):
+        # Always written as one complete chunk (cflag 0) — dmlc readers
+        # accept that unconditionally; the multi-chunk form (cflags
+        # 1/2/3, produced by dmlc writers that split payloads at
+        # embedded magic words for seek-recovery) is handled in read().
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        n = len(buf)
+        self.record.write(struct.pack("<II", _K_MAGIC,
+                                      _encode_lrec(0, n)))
+        self.record.write(buf)
+        pad = (4 - (n & 3)) & 3
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self) -> Optional[bytes]:
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        parts: List[bytes] = []
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return b"".join(parts) if parts else None
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _K_MAGIC:
+                raise MXNetError(
+                    f"invalid RecordIO magic {magic:#x} in {self.uri}")
+            cflag, length = _decode_lrec(lrec)
+            data = self.record.read(length)
+            if len(data) < length:
+                raise MXNetError(f"truncated record in {self.uri}")
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self.record.read(pad)
+            parts.append(data)
+            # cflag: 0 = complete record, 1 = first chunk, 2 = middle,
+            # 3 = last chunk (dmlc recordio.h†)
+            if cflag in (0, 3):
+                return b"".join(parts)
+
+    def tell(self) -> int:
+        return self.record.tell()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a ``.idx`` sidecar for random access
+    (reference ``MXIndexedRecordIO``†; idx format: ``key\\toffset`` lines)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys: List = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            if os.path.exists(self.idx_path):
+                with open(self.idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 2:
+                            continue
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx) -> bytes:
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+#: Image-record header (reference ``IRHeader``†): flag counts extra float
+#: labels; label is a scalar when flag == 0.
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload into the image-record wire format
+    (reference ``pack``†)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        out = struct.pack(_IR_FORMAT, header.flag, header.label,
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        out = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                          header.id2)
+        out += label.tobytes()
+    return out + s
+
+
+def unpack(s: bytes):
+    """Unpack ``pack`` output → (IRHeader, payload) (reference†)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], np.float32).copy()
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an image (HWC uint8 numpy array) and pack it
+    (reference ``pack_img``†, OpenCV-backed)."""
+    import cv2
+    ext = img_fmt.lower()
+    if ext in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif ext == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
+    else:
+        raise MXNetError(f"unsupported image format {img_fmt}")
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise MXNetError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s: bytes, iscolor=-1):
+    """Unpack and decode an image record → (IRHeader, HWC array)
+    (reference ``unpack_img``†)."""
+    import cv2
+    header, payload = unpack(s)
+    img = cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
+    return header, img

@@ -453,20 +453,6 @@ def _is_aux_name(name: str) -> bool:
                           "running_var"))
 
 
-def _first_head(sym: Symbol, var_name: str):
-    for node in sym._topo():
-        if node.op is None and node.name == var_name:
-            return (id(node), 0)
-    return None
-
-
-def _find_var(sym: Symbol, var_name: str) -> Optional[_Node]:
-    for node in sym._topo():
-        if node.op is None and node.name == var_name:
-            return node
-    return None
-
-
 def _abstract_eval(node: _Node, in_shapes) -> List[Tuple[int, ...]]:
     """Shape inference by abstract interpretation of the lowering rule —
     the role of the reference's ``InferShape`` pass

@@ -113,11 +113,19 @@ def same(a, b) -> bool:
     return np.array_equal(_as_numpy(a), _as_numpy(b))
 
 
+def _ref_dtype(a: np.ndarray):
+    """Tolerance-table key for an array — bfloat16 (ml_dtypes) has
+    dtype.kind 'V', so match it by name before the float check."""
+    if "bfloat16" in str(a.dtype):
+        return "bfloat16"
+    return a.dtype if a.dtype.kind == "f" else np.float32
+
+
 def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
     a, b = _as_numpy(a), _as_numpy(b)
-    rtol, atol = get_tolerance(a.dtype if a.dtype.kind == "f" else np.float32,
-                               rtol, atol)
-    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    rtol, atol = get_tolerance(_ref_dtype(a), rtol, atol)
+    return np.allclose(a.astype(np.float64), b.astype(np.float64),
+                       rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
 def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
@@ -128,8 +136,7 @@ def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
     if a_np.shape != b_np.shape:
         raise AssertionError(
             f"shape mismatch: {names[0]}{a_np.shape} vs {names[1]}{b_np.shape}")
-    ref_dtype = a_np.dtype if a_np.dtype.kind == "f" else np.float32
-    rtol, atol = get_tolerance(ref_dtype, rtol, atol)
+    rtol, atol = get_tolerance(_ref_dtype(a_np), rtol, atol)
     if np.allclose(a_np.astype(np.float64), b_np.astype(np.float64),
                    rtol=rtol, atol=atol, equal_nan=equal_nan):
         return
@@ -185,7 +192,6 @@ def rand_ndarray(shape, stype="default", density=None, dtype=None,
         density = 0.5 if density is None else density
         mask = np.random.uniform(0, 1, size=shape) < density
         data = data * mask
-        from .ndarray import sparse
         dense = array(data, ctx=ctx)
         return dense.tostype(stype) if hasattr(dense, "tostype") else dense
     return array(data, ctx=ctx)
@@ -306,10 +312,15 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
                  for name, g in zip(sym.list_arguments(), exe.grad_arrays)
                  if g is not None and name in grad_nodes}
 
+    # one executor reused across all finite-difference probes — only the
+    # perturbed arrays change, via forward(**kwargs)
+    probe_exe = _bind(sym, location, aux_states=aux_states,
+                      grad_req="null", ctx=ctx)
+
     def objective(loc_np):
-        e = _bind(sym, loc_np, aux_states=aux_states, grad_req="null",
-                  ctx=ctx)
-        os_ = e.forward(is_train=True)
+        os_ = probe_exe.forward(is_train=True,
+                                **{k: array(v, ctx=ctx)
+                                   for k, v in loc_np.items()})
         return float(sum((o.asnumpy().astype(dtype) * p).sum()
                          for o, p in zip(os_, proj)))
 

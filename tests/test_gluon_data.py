@@ -1,0 +1,326 @@
+"""gluon.data + io + recordio (reference ``test_gluon_data.py``†,
+``test_io.py``†, ``test_recordio.py``†)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.gluon import data as gdata
+
+
+# ----------------------------------------------------------------------
+# recordio
+# ----------------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    from mxtpu import recordio
+    path = str(tmp_path / "test.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    for expected in payloads:
+        assert rec.read() == expected
+    assert rec.read() is None
+    rec.reset()
+    assert rec.read() == payloads[0]
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    from mxtpu import recordio
+    rec_path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record-7"
+    assert r.read_idx(2) == b"record-2"
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    from mxtpu import recordio
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    packed = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(packed)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 42
+    # multi-label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 7, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert h2.flag == 3 and payload == b"x"
+
+
+def test_pack_img_roundtrip(tmp_path):
+    from mxtpu import recordio
+    img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s)
+    assert h.label == 1.0
+    np.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+# ----------------------------------------------------------------------
+# io iterators
+# ----------------------------------------------------------------------
+
+def test_ndarray_iter_pad_discard():
+    from mxtpu import io
+    X = np.arange(50, dtype=np.float32).reshape(10, 5)
+    y = np.arange(10, dtype=np.float32)
+    it = io.NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    assert batches[0].data[0].shape == (4, 5)
+    it = io.NDArrayIter(X, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2
+    # iterate twice after reset
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle_dict():
+    from mxtpu import io
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    it = io.NDArrayIter({"data": X}, {"label": np.zeros(10)},
+                        batch_size=5, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert sorted(seen.tolist()) == sorted(X[:, 0].tolist())
+    assert [d.name for d in it.provide_data] == ["data"]
+
+
+def test_resize_and_prefetch_iter():
+    from mxtpu import io
+    X = np.random.randn(8, 3).astype(np.float32)
+    base = io.NDArrayIter(X, np.zeros(8), batch_size=4)
+    r = io.ResizeIter(base, 5)
+    assert len(list(r)) == 5
+    base.reset()
+    p = io.PrefetchingIter(
+        io.NDArrayIter(X, np.zeros(8), batch_size=4))
+    batches = list(p)
+    assert len(batches) == 2
+    p.reset()
+    assert len(list(p)) == 2
+
+
+def test_csv_iter(tmp_path):
+    from mxtpu import io
+    data = np.random.randn(7, 3).astype(np.float32)
+    np.savetxt(tmp_path / "d.csv", data, delimiter=",")
+    np.savetxt(tmp_path / "l.csv", np.arange(7), delimiter=",")
+    it = io.CSVIter(data_csv=str(tmp_path / "d.csv"), data_shape=(3,),
+                    label_csv=str(tmp_path / "l.csv"), batch_size=3)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:3], rtol=1e-5)
+
+
+def test_image_record_iter(tmp_path):
+    from mxtpu import io, recordio
+    rec_path = str(tmp_path / "img.rec")
+    idx_path = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img,
+            img_fmt=".png"))
+    w.close()
+    it = io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                            data_shape=(3, 8, 8), batch_size=4,
+                            shuffle=True, seed=1)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 8, 8)
+    assert b.label[0].shape == (4,)
+    labels = b.label[0].asnumpy()
+    assert set(labels.astype(int)) <= {0, 1, 2}
+
+
+# ----------------------------------------------------------------------
+# gluon.data
+# ----------------------------------------------------------------------
+
+def test_array_dataset_and_samplers():
+    X = np.random.randn(10, 4).astype(np.float32)
+    y = np.arange(10)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    np.testing.assert_allclose(xi, X[3])
+    assert yi == 3
+
+    s = list(gdata.SequentialSampler(5))
+    assert s == [0, 1, 2, 3, 4]
+    r = list(gdata.RandomSampler(5))
+    assert sorted(r) == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+
+
+def test_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(5)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[2] == 4 and len(t) == 5
+    ds2 = gdata.ArrayDataset(np.arange(4, dtype=np.float32),
+                             np.arange(4))
+    tf = ds2.transform_first(lambda x: x + 100)
+    x, y = tf[1]
+    assert float(x) == 101.0 and y == 1
+
+
+def test_dataloader_basic():
+    X = np.random.randn(11, 3).astype(np.float32)
+    y = np.arange(11, dtype=np.float32)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, y), batch_size=4,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[-1][0].shape == (3, 3)
+    assert len(loader) == 3
+    # discard
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, y), batch_size=4,
+                              last_batch="discard", shuffle=True)
+    batches = list(loader)
+    assert len(batches) == 2
+
+
+def test_dataloader_workers():
+    X = np.random.randn(32, 3).astype(np.float32)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, np.zeros(32)),
+                              batch_size=8, num_workers=2)
+    seen = [b[0].asnumpy() for b in loader]
+    assert len(seen) == 4
+    np.testing.assert_allclose(np.concatenate(seen), X, rtol=1e-6)
+    # second epoch works
+    assert len(list(loader)) == 4
+
+
+def test_record_file_dataset(tmp_path):
+    from mxtpu import recordio
+    rec_path = str(tmp_path / "ds.rec")
+    idx_path = str(tmp_path / "ds.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        w.write_idx(i, f"item{i}".encode())
+    w.close()
+    ds = gdata.RecordFileDataset(rec_path)
+    assert len(ds) == 4
+    assert ds[2] == b"item2"
+
+
+def test_image_record_dataset_and_transforms(tmp_path):
+    from mxtpu import recordio
+    from mxtpu.gluon.data.vision import transforms
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    raws = []
+    for i in range(3):
+        img = (rng.rand(12, 12, 3) * 255).astype(np.uint8)
+        raws.append(img)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+    ds = gdata.vision.ImageRecordDataset(rec_path)
+    img, label = ds[1]
+    assert img.shape == (12, 12, 3)
+    assert label == 1.0
+    # pack_img takes BGR (cv2 convention); the dataset yields RGB
+    np.testing.assert_array_equal(img.asnumpy(), raws[1][:, :, ::-1])
+
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(mean=0.5, std=0.5)])
+    out = tf(img)
+    assert out.shape == (3, 12, 12)
+    assert float(out.asnumpy().max()) <= 1.0 + 1e-6
+
+    resized = transforms.Resize(6)(img)
+    assert resized.shape == (6, 6, 3)
+    cropped = transforms.CenterCrop(8)(img)
+    assert cropped.shape == (8, 8, 3)
+    rrc = transforms.RandomResizedCrop(5)(img)
+    assert rrc.shape == (5, 5, 3)
+    flipped = transforms.RandomFlipLeftRight()(img)
+    assert flipped.shape == (12, 12, 3)
+
+
+def test_dataloader_feeds_training():
+    """DataLoader → Trainer loop end-to-end (M3's loop shape)."""
+    from mxtpu import autograd, gluon
+    from mxtpu.gluon import nn, loss as gloss
+    X = np.random.RandomState(0).randn(64, 6).astype(np.float32)
+    yv = (X.sum(1) > 0).astype(np.float32)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, yv), batch_size=16,
+                              shuffle=True, num_workers=1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    L = gloss.SigmoidBinaryCrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        tot = 0.0
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                l = L(out, yb.reshape((-1, 1)))
+            l.backward()
+            trainer.step(xb.shape[0])
+            tot += float(l.mean().asnumpy())
+        losses.append(tot)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_mnist_iter_and_dataset(tmp_path):
+    """Synthetic MNIST idx files through both MNISTIter and
+    gluon.data.vision.MNIST."""
+    import struct
+    from mxtpu import io
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(20, 28, 28) * 255).astype(np.uint8)
+    labels = rng.randint(0, 10, 20).astype(np.uint8)
+    root = tmp_path
+
+    def write_idx(path, arr):
+        with open(path, "wb") as f:
+            code = 0x08
+            f.write(struct.pack(">I", (code << 8) | arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack(">I", d))
+            f.write(arr.tobytes())
+
+    write_idx(root / "train-images-idx3-ubyte", imgs)
+    write_idx(root / "train-labels-idx1-ubyte", labels)
+
+    it = io.MNISTIter(image=str(root / "train-images-idx3-ubyte"),
+                      label=str(root / "train-labels-idx1-ubyte"),
+                      batch_size=5, shuffle=False)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0, 0],
+                               imgs[0] / 255.0, rtol=1e-6)
+
+    from mxtpu.gluon.data import vision
+    ds = vision.MNIST(root=str(root), train=True)
+    assert len(ds) == 20
+    img, label = ds[3]
+    assert img.shape == (28, 28, 1)
+    assert label == labels[3]
