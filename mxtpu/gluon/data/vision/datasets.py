@@ -115,7 +115,7 @@ class CIFAR10(_DownloadedDataset):
             tar = os.path.join(self._root, "cifar-10-python.tar.gz")
             if os.path.exists(tar):
                 with tarfile.open(tar) as tf:
-                    tf.extractall(self._root)
+                    tf.extractall(self._root, filter="data")
             else:
                 raise MXNetError(
                     f"CIFAR-10 not found under {self._root} (no network "

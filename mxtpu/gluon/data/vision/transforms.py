@@ -49,15 +49,16 @@ class ToTensor(HybridBlock):
 
 
 class Normalize(HybridBlock):
-    """(x - mean) / std over channels of a CHW tensor (reference†)."""
+    """(x - mean) / std over channels of a CHW tensor (reference†).
+    mean/std are placed on device once at construction, not per call."""
 
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean = array(np.asarray(mean, np.float32).reshape(-1, 1, 1))
+        self._std = array(np.asarray(std, np.float32).reshape(-1, 1, 1))
 
     def hybrid_forward(self, F, x):
-        return (x - array(self._mean)) / array(self._std)
+        return (x - self._mean) / self._std
 
 
 def _resize_hwc(x: NDArray, size) -> NDArray:

@@ -1,0 +1,10 @@
+"""Gluon recurrent layers & cells
+(reference ``python/mxnet/gluon/rnn/``†)."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
+                       ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell", "RNN", "LSTM", "GRU"]

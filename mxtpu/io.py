@@ -200,7 +200,15 @@ class NDArrayIter(DataIter):
         idx = self._order[self.cursor:self.cursor + self.batch_size]
         pad = self.batch_size - len(idx)
         if pad:
-            idx = np.concatenate([idx, self._order[:pad]])
+            # wrap from the head as many times as needed (batch_size may
+            # exceed the dataset) — batches are never ragged
+            reps = [idx]
+            need = pad
+            while need > 0:
+                take = self._order[:need]
+                reps.append(take)
+                need -= len(take)
+            idx = np.concatenate(reps)
         self.cursor += self.batch_size
         data = [array(arr[idx]) for _, arr in self.data]
         label = [array(arr[idx]) for _, arr in self.label]
@@ -502,7 +510,9 @@ class ImageRecordIter(DataIter):
         if self.rand_mirror and self._rng.rand() < 0.5:
             img = img[:, ::-1]
         img = img[:, :, ::-1].astype(np.float32)  # BGR→RGB
-        img = (img * self.scale - self.mean) / self.std
+        # reference order (iter_image_recordio_2.cc†): mean subtraction
+        # happens in pixel units, THEN scale, then std division
+        img = (img - self.mean) * self.scale / self.std
         label = header.label
         if isinstance(label, np.ndarray) and self.label_width == 1:
             label = float(label[0])

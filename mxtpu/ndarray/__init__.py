@@ -18,6 +18,7 @@ import numpy as np
 from ..base import MXNetError
 from ..ops.registry import OP_REGISTRY, get_op, list_ops
 from . import ops_impl  # noqa: F401  (populates the registry)
+from . import rnn_impl  # noqa: F401  (fused RNN op)
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concat, stack, save, load, waitall, from_numpy,
                       linspace, eye, zeros_like as _zeros_like_fn)

@@ -1,0 +1,211 @@
+"""Fused RNN operator — LSTM/GRU/vanilla, multi-layer, bidirectional.
+
+Reference: ``src/operator/rnn.cc``† + ``src/operator/nn/cudnn/
+cudnn_rnn-inl.h``† — the fused cuDNN RNN op with a single flat parameter
+vector, consumed by ``gluon/rnn/rnn_layer.py``†'s ``_forward_kernel``.
+
+TPU-native design: one ``lax.scan`` per layer/direction over time.  The
+input-to-hidden projection for ALL timesteps is hoisted out of the scan
+as a single large matmul (MXU-friendly: one (T·N, in)×(in, G·H) GEMM
+per layer instead of T small ones); only the hidden-to-hidden GEMM and
+the elementwise gate math live inside the scan body.  XLA unrolls
+nothing — the scan lowers to a While with static shapes.
+
+Flat parameter layout (structurally the cuDNN/MXNet convention —
+weights first, then biases):
+  for layer in 0..L-1: for direction in 0..D-1:
+      W_i2h (G*H, in_l)   then  W_h2h (G*H, H)
+  then, in the same (layer, direction) order:
+      b_i2h (G*H,)        then  b_h2h (G*H,)
+with in_0 = input_size and in_l = D*H for l > 0.  Gate order: LSTM
+[i, f, g, o], GRU [r, z, n] (cuDNN order).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers: int, input_size: int, state_size: int,
+                   bidirectional: bool, mode: str) -> int:
+    """Total flat parameter vector length (reference
+    ``rnn_param_size``† in rnn-inl.h)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        size += gates * state_size * (in_size + state_size + 2) * dirs
+    return size
+
+
+def _slice_params(params, num_layers, input_size, state_size,
+                  dirs, gates):
+    """Static slicing of the flat vector → per-(layer, dir) arrays."""
+    H, G = state_size, gates
+    weights = []
+    off = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else H * dirs
+        per_layer = []
+        for _ in range(dirs):
+            w_i2h = params[off:off + G * H * in_size].reshape(G * H,
+                                                             in_size)
+            off += G * H * in_size
+            w_h2h = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            per_layer.append([w_i2h, w_h2h, None, None])
+        weights.append(per_layer)
+    for layer in range(num_layers):
+        for d in range(dirs):
+            weights[layer][d][2] = params[off:off + G * H]
+            off += G * H
+            weights[layer][d][3] = params[off:off + G * H]
+            off += G * H
+    return weights, off
+
+
+def _scan_dir(x, h0, c0, w_h2h, pre, mode, H, reverse):
+    """One direction of one layer. pre: (T, N, G*H) precomputed i2h
+    (+ biases as applicable); returns (outputs (T,N,H), h_T, c_T)."""
+
+    if mode == "lstm":
+        def body(carry, pre_t):
+            h, c = carry
+            gates = pre_t + h @ w_h2h.T
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+        (h_t, c_t), ys = lax.scan(body, (h0, c0), pre, reverse=reverse)
+        return ys, h_t, c_t
+
+    if mode == "gru":
+        # pre holds W x + b_i2h for all gates + b_h2h for r,z only; the
+        # n-gate recurrent bias b_Rn is applied inside the reset product.
+        def body(h, inputs):
+            pre_t, b_rn = inputs
+            hp = h @ w_h2h.T
+            pr, pz, pn = jnp.split(pre_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(pr + hr)
+            z = jax.nn.sigmoid(pz + hz)
+            n = jnp.tanh(pn + r * (hn + b_rn))
+            h2 = (1.0 - z) * n + z * h
+            return h2, h2
+        pre_t, b_rn = pre
+        T = pre_t.shape[0]
+        h_t, ys = lax.scan(body, h0,
+                           (pre_t, jnp.broadcast_to(b_rn, (T,) +
+                                                    b_rn.shape)),
+                           reverse=reverse)
+        return ys, h_t, None
+
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def body(h, pre_t):
+        h2 = act(pre_t + h @ w_h2h.T)
+        return h2, h2
+    h_t, ys = lax.scan(body, h0, pre, reverse=reverse)
+    return ys, h_t, None
+
+
+def _rnn_impl(data, parameters, state, *extra, state_size, num_layers,
+              mode="lstm", bidirectional=False, p=0.0,
+              state_outputs=False):
+    """The fused RNN lowering rule. data: (T, N, I); state: (L*D, N, H);
+    lstm also takes state_cell; an optional trailing PRNG key input
+    enables inter-layer dropout.  Returns (output, state_n
+    [, statecell_n]) — callers that set ``state_outputs=False`` get
+    just the output."""
+    if mode not in _GATES:
+        raise MXNetError(f"unknown RNN mode {mode!r}")
+    if mode == "lstm":
+        state_cell = extra[0] if extra else None
+        key = extra[1] if len(extra) > 1 else None
+    else:
+        state_cell = None
+        key = extra[0] if extra else None
+    H = int(state_size)
+    L = int(num_layers)
+    dirs = 2 if bidirectional else 1
+    G = _GATES[mode]
+    T, N, I = data.shape
+
+    weights, used = _slice_params(parameters, L, I, H, dirs, G)
+    if used != parameters.shape[0]:
+        raise MXNetError(
+            f"RNN parameter vector has {parameters.shape[0]} elements, "
+            f"layout needs {used} (use rnn_param_size)")
+
+    x = data
+    h_finals = []
+    c_finals = []
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            w_i2h, w_h2h, b_i2h, b_h2h = weights[layer][d]
+            idx = layer * dirs + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            if mode == "gru":
+                b_rn = b_h2h[2 * H:]
+                b_rz = jnp.concatenate([b_h2h[:2 * H],
+                                        jnp.zeros_like(b_rn)])
+                pre = (x @ w_i2h.T + b_i2h + b_rz, b_rn)
+            else:
+                pre = x @ w_i2h.T + b_i2h + b_h2h
+            ys, h_t, c_t = _scan_dir(x, h0, c0, w_h2h, pre, mode, H,
+                                     reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(h_t)
+            if c_t is not None:
+                c_finals.append(c_t)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and key is not None and layer < L - 1:
+            sub = jax.random.fold_in(key, layer) \
+                if jnp.issubdtype(key.dtype, jax.dtypes.prng_key) \
+                else jax.random.fold_in(jax.random.wrap_key_data(key),
+                                        layer)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+
+    state_n = jnp.stack(h_finals)
+    if mode == "lstm":
+        cell_n = jnp.stack(c_finals)
+        if state_outputs:
+            return x, state_n, cell_n
+        return x
+    if state_outputs:
+        return x, state_n
+    return x
+
+
+def _rnn_num_outputs(attrs) -> int:
+    so = attrs.get("state_outputs", False)
+    if isinstance(so, str):
+        so = so not in ("False", "false", "0")
+    if not so:
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+register_op(
+    "RNN", num_inputs=-1, num_outputs=3,
+    params=[Param("state_size", int),
+            Param("num_layers", int),
+            Param("mode", str, "lstm",
+                  enum=("rnn_relu", "rnn_tanh", "lstm", "gru")),
+            Param("bidirectional", bool, False),
+            Param("p", float, 0.0),
+            Param("state_outputs", bool, False)],
+    num_outputs_fn=_rnn_num_outputs,
+    doc=_rnn_impl.__doc__)(_rnn_impl)

@@ -45,6 +45,9 @@ class Op:
     grad_argnums: Optional[Tuple[int, ...]] = None
     doc: str = ""
     aliases: Tuple[str, ...] = ()
+    #: optional callable(attrs_dict) -> int for ops whose output count
+    #: depends on their params (e.g. RNN's state_outputs/mode)
+    num_outputs_fn: Optional[Callable[[Dict[str, Any]], int]] = None
 
     def resolve_params(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
         return self.params.resolve(kwargs)
@@ -68,13 +71,15 @@ def register_op(name: str, *, params: Sequence[Param] = (),
                 num_inputs: int = 1, num_outputs: int = 1,
                 differentiable: bool = True,
                 grad_argnums: Optional[Tuple[int, ...]] = None,
-                aliases: Sequence[str] = (), doc: str = ""):
+                aliases: Sequence[str] = (), doc: str = "",
+                num_outputs_fn: Optional[Callable] = None):
     """Decorator registering a lowering rule as a framework op."""
     def _wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
         op = Op(name=name, fn=fn, params=ParamSet(*params),
                 num_inputs=num_inputs, num_outputs=num_outputs,
                 differentiable=differentiable, grad_argnums=grad_argnums,
-                doc=doc or (fn.__doc__ or ""), aliases=tuple(aliases))
+                doc=doc or (fn.__doc__ or ""), aliases=tuple(aliases),
+                num_outputs_fn=num_outputs_fn)
         OP_REGISTRY.register(name, aliases=tuple(aliases))(op)
         return fn
     return _wrap

@@ -17,6 +17,7 @@ from __future__ import annotations
 import numbers
 import os
 import struct
+import threading
 from collections import namedtuple
 from typing import List, Optional
 
@@ -156,6 +157,9 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys: List = []
         self.key_type = key_type
         self.fidx = None
+        # seek+read must be atomic: DataLoader's thread pool shares one
+        # dataset (and thus one file handle) across workers
+        self._lock = threading.Lock()
         super().__init__(uri, flag)
 
     def open(self):
@@ -188,8 +192,9 @@ class MXIndexedRecordIO(MXRecordIO):
         self.record.seek(self.idx[idx])
 
     def read_idx(self, idx) -> bytes:
-        self.seek(idx)
-        return self.read()
+        with self._lock:
+            self.seek(idx)
+            return self.read()
 
     def write_idx(self, idx, buf: bytes):
         assert self.writable

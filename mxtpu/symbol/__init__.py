@@ -590,6 +590,9 @@ def _num_outputs_of(op_name: str, n_inputs: int, attrs) -> int:
         op = get_op(op_name)
     except Exception:
         return 1
+    if op.num_outputs_fn is not None:
+        return op.num_outputs_fn(
+            {k: _coerce_attr(v) for k, v in attrs.items()})
     if op.num_outputs == -1:
         if op_name in ("split", "SliceChannel"):
             return int(_coerce_attr(attrs.get("num_outputs", 1)))

@@ -324,3 +324,40 @@ def test_mnist_iter_and_dataset(tmp_path):
     img, label = ds[3]
     assert img.shape == (28, 28, 1)
     assert label == labels[3]
+
+
+def test_ndarray_iter_batch_larger_than_data():
+    """pad mode wraps repeatedly; batches are never ragged (review
+    regression)."""
+    from mxtpu import io
+    X = np.arange(6, dtype=np.float32).reshape(3, 2)
+    it = io.NDArrayIter(X, np.zeros(3), batch_size=8,
+                        last_batch_handle="pad")
+    b = next(it)
+    assert b.data[0].shape == (8, 2)
+    assert b.pad == 5
+    np.testing.assert_allclose(b.data[0].asnumpy()[:, 0],
+                               [0, 2, 4, 0, 2, 4, 0, 2])
+
+
+def test_record_dataset_threaded_reads(tmp_path):
+    """Concurrent read_idx through the DataLoader thread pool stays
+    consistent (review regression: seek+read must be atomic)."""
+    from mxtpu import recordio
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(64):
+        w.write_idx(i, (f"payload-{i:03d}-" + "x" * (i % 17)).encode())
+    w.close()
+    ds = gdata.RecordFileDataset(rec_path)
+
+    def check(idx):
+        raw = ds[idx]
+        assert raw.startswith(f"payload-{idx:03d}-".encode()), raw[:16]
+        return idx
+
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(8) as pool:
+        results = list(pool.map(check, list(range(64)) * 8))
+    assert len(results) == 512

@@ -1,0 +1,325 @@
+"""RNN op + gluon.rnn (reference ``tests/python/unittest/test_gluon_rnn
+.py``† and ``test_operator.py::test_rnn*``†)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+from mxtpu.gluon import rnn
+from mxtpu.ndarray.rnn_impl import rnn_param_size
+
+
+def _np_lstm_ref(x, params, h0, c0, H):
+    """Single-layer unidirectional LSTM, numpy, onto which the fused op's
+    layout contract is pinned (gate order [i,f,g,o])."""
+    T, N, I = x.shape
+    G = 4
+    off = 0
+    w_i2h = params[off:off + G * H * I].reshape(G * H, I); off += G * H * I
+    w_h2h = params[off:off + G * H * H].reshape(G * H, H); off += G * H * H
+    b_i2h = params[off:off + G * H]; off += G * H
+    b_h2h = params[off:off + G * H]; off += G * H
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h, c = h0.copy(), c0.copy()
+    outs = []
+    for t in range(T):
+        gates = x[t] @ w_i2h.T + b_i2h + h @ w_h2h.T + b_h2h
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_fused_lstm_matches_numpy():
+    T, N, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    nparam = rnn_param_size(1, I, H, False, "lstm")
+    params = rng.randn(nparam).astype(np.float32) * 0.2
+    h0 = rng.randn(1, N, H).astype(np.float32)
+    c0 = rng.randn(1, N, H).astype(np.float32)
+
+    out, hn, cn = nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                         nd.array(c0), state_size=H, num_layers=1,
+                         mode="lstm", state_outputs=True)
+    ref_out, ref_h, ref_c = _np_lstm_ref(x, params, h0[0], c0[0], H)
+    np.testing.assert_allclose(out.asnumpy(), ref_out, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(hn.asnumpy()[0], ref_h, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(cn.asnumpy()[0], ref_c, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_rnn_modes_shapes():
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(T, N, I).astype(np.float32))
+    for mode, nstates in [("rnn_relu", 1), ("rnn_tanh", 1), ("gru", 1),
+                          ("lstm", 2)]:
+        for bi in (False, True):
+            D = 2 if bi else 1
+            nparam = rnn_param_size(L, I, H, bi, mode)
+            params = nd.array(rng.randn(nparam).astype(np.float32) * 0.1)
+            states = [nd.zeros((L * D, N, H)) for _ in range(nstates)]
+            outs = nd.RNN(x, params, *states, state_size=H, num_layers=L,
+                          mode=mode, bidirectional=bi,
+                          state_outputs=True)
+            out = outs[0]
+            assert out.shape == (T, N, D * H), (mode, bi, out.shape)
+            assert outs[1].shape == (L * D, N, H)
+            if mode == "lstm":
+                assert outs[2].shape == (L * D, N, H)
+
+
+def test_lstm_layer_matches_cell_unroll():
+    """Fused LSTM layer ≡ LSTMCell unrolled, same parameters."""
+    T, N, I, H = 6, 2, 3, 4
+    rng = np.random.RandomState(2)
+    layer = rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    x = nd.array(rng.randn(T, N, I).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (T, N, H)
+
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused layer params into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, states = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), outs.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_gru_layer_matches_cell_unroll():
+    T, N, I, H = 5, 3, 4, 4
+    rng = np.random.RandomState(3)
+    layer = rnn.GRU(H, input_size=I)
+    layer.initialize()
+    x = nd.array(rng.randn(T, N, I).astype(np.float32))
+    out = layer(x)
+    cell = rnn.GRUCell(H, input_size=I)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), outs.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_rnn_layer_states_and_ntc():
+    N, T, I, H = 2, 5, 3, 4
+    layer = rnn.LSTM(H, num_layers=2, layout="NTC", input_size=I)
+    layer.initialize()
+    x = nd.array(np.random.randn(N, T, I).astype(np.float32))
+    states = layer.begin_state(batch_size=N)
+    out, new_states = layer(x, states)
+    assert out.shape == (N, T, H)
+    assert new_states[0].shape == (2, N, H)
+    assert new_states[1].shape == (2, N, H)
+
+
+def test_bidirectional_layer_reverse_semantics():
+    """Backward direction must process time reversed: compare with
+    manually reversed forward pass of a unidirectional twin."""
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(4)
+    bi = rnn.GRU(H, bidirectional=True, input_size=I)
+    bi.initialize()
+    x = rng.randn(T, N, I).astype(np.float32)
+    out = bi(nd.array(x)).asnumpy()
+    assert out.shape == (T, N, 2 * H)
+
+    uni = rnn.GRU(H, input_size=I)
+    uni.initialize()
+    uni.l0_i2h_weight.set_data(bi.r0_i2h_weight.data())
+    uni.l0_h2h_weight.set_data(bi.r0_h2h_weight.data())
+    uni.l0_i2h_bias.set_data(bi.r0_i2h_bias.data())
+    uni.l0_h2h_bias.set_data(bi.r0_h2h_bias.data())
+    rev = uni(nd.array(x[::-1].copy())).asnumpy()[::-1]
+    np.testing.assert_allclose(out[:, :, H:], rev, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_gradient_flows():
+    T, N, I, H = 4, 3, 5, 6
+    layer = rnn.LSTM(H, num_layers=2, input_size=I)
+    layer.initialize()
+    x = nd.array(np.random.randn(T, N, I).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert float((x.grad.asnumpy() ** 2).sum()) > 0
+    for name, p in layer.collect_params().items():
+        g = p.grad().asnumpy()
+        assert np.isfinite(g).all(), name
+        assert float(np.abs(g).sum()) > 0, name
+
+
+def test_rnn_hybridize_consistency():
+    T, N, I, H = 4, 2, 3, 4
+    rng = np.random.RandomState(5)
+    x = nd.array(rng.randn(T, N, I).astype(np.float32))
+    layer = rnn.GRU(H, num_layers=2, input_size=I)
+    layer.initialize()
+    eager = layer(x).asnumpy()
+    layer.hybridize()
+    hybrid = layer(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_cells_api():
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, states2 = cell(x, states)
+    assert out.shape == (2, 4) and states2[0].shape == (2, 4)
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.ResidualCell(rnn.GRUCell(4, input_size=4)))
+    stack.add(rnn.DropoutCell(0.0))
+    for c in [stack[0], stack[1].base_cell]:
+        c.initialize()
+    states = stack.begin_state(batch_size=2)
+    out, states2 = stack(x, states)
+    assert out.shape == (2, 4)
+    assert len(states2) == len(states) == 3
+
+    outs, _ = stack.unroll(5, nd.array(
+        np.random.randn(2, 5, 3).astype(np.float32)), layout="NTC",
+        merge_outputs=True)
+    assert outs.shape == (2, 5, 4)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(3, input_size=2),
+                               rnn.LSTMCell(3, input_size=2))
+    for c in (bi._l_cell, bi._r_cell):
+        c.initialize()
+    x = nd.array(np.random.randn(2, 4, 2).astype(np.float32))
+    outs, states = bi.unroll(4, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
+    assert len(states) == 4
+
+
+def test_lstm_lm_convergence():
+    """Tiny LSTM language-model-style training converges (reference
+    ``tests/python/train/test_bucketing``†-style smoke)."""
+    from mxtpu import gluon
+    from mxtpu.gluon import nn, loss as gloss
+    V, E, H, T, N = 12, 8, 16, 6, 8
+    rng = np.random.RandomState(0)
+    # learnable pattern: next token = (token + 1) % V
+    seqs = np.stack([np.arange(i, i + T + 1) % V for i in range(N * 4)])
+
+    class LM(nn.HybridSequential):
+        pass
+
+    net = LM()
+    net.add(nn.Embedding(V, E))
+    lstm = rnn.LSTM(H, layout="NTC", input_size=E)
+    net.add(lstm)
+    net.add(nn.Dense(V, flatten=False))
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for epoch in range(30):
+        tot = 0.0
+        for b in range(4):
+            batch = seqs[b * N:(b + 1) * N]
+            x = nd.array(batch[:, :-1].astype(np.float32))
+            y = nd.array(batch[:, 1:].astype(np.float32))
+            with autograd.record():
+                out = net(x)
+                l = L(out.reshape((-1, V)), y.reshape((-1,)))
+            l.backward()
+            trainer.step(N)
+            tot += float(l.mean().asnumpy())
+        losses.append(tot / 4)
+    assert losses[-1] < 0.15, losses[-10:]
+
+
+def test_rnn_symbolic_num_outputs():
+    """Symbol composition must see the right output count per
+    mode/state_outputs (review regression)."""
+    import mxtpu as mx
+    H, I, T, N = 4, 3, 5, 2
+    nparam = rnn_param_size(1, I, H, False, "gru")
+    data = mx.sym.var("data")
+    par = mx.sym.var("p")
+    st = mx.sym.var("s")
+    out = mx.sym.RNN(data, par, st, state_size=H, num_layers=1,
+                     mode="gru", state_outputs=True)
+    assert len(out) == 2
+    out1 = mx.sym.RNN(data, par, st, state_size=H, num_layers=1,
+                      mode="gru", state_outputs=False)
+    assert len(out1) == 1
+    rng = np.random.RandomState(0)
+    vals = out.eval(data=nd.array(rng.randn(T, N, I).astype(np.float32)),
+                    p=nd.array(rng.randn(nparam).astype(np.float32) * .1),
+                    s=nd.zeros((1, N, H)))
+    assert vals[0].shape == (T, N, H)
+    assert vals[1].shape == (1, N, H)
+
+
+def test_unroll_valid_length_states():
+    """States returned from unroll(valid_length=...) are taken at each
+    sample's length, not after the padding (review regression)."""
+    T, N, I, H = 6, 3, 2, 4
+    rng = np.random.RandomState(7)
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    x = rng.randn(N, T, I).astype(np.float32)
+    vl = np.array([2, 6, 4], np.float32)
+    outs, states = cell.unroll(T, nd.array(x), layout="NTC",
+                               merge_outputs=True,
+                               valid_length=nd.array(vl))
+    # reference: sample 0's state == state after unrolling only 2 steps
+    outs2, states2 = cell.unroll(2, nd.array(x[:1, :2]), layout="NTC",
+                                 merge_outputs=True)
+    np.testing.assert_allclose(states[0].asnumpy()[0],
+                               states2[0].asnumpy()[0], rtol=1e-5,
+                               atol=1e-6)
+    # masked outputs beyond valid_length are zero
+    o = outs.asnumpy()
+    assert np.abs(o[0, 2:]).sum() == 0.0
+    assert np.abs(o[2, 4:]).sum() == 0.0
+
+
+def test_bidirectional_cell_valid_length():
+    T, N, I, H = 5, 2, 2, 3
+    rng = np.random.RandomState(8)
+    bi = rnn.BidirectionalCell(rnn.GRUCell(H, input_size=I),
+                               rnn.GRUCell(H, input_size=I))
+    for c in (bi._l_cell, bi._r_cell):
+        c.initialize()
+    x = rng.randn(N, T, I).astype(np.float32)
+    vl = np.array([3, 5], np.float32)
+    outs, states = bi.unroll(T, nd.array(x), layout="NTC",
+                             merge_outputs=True,
+                             valid_length=nd.array(vl))
+    o = outs.asnumpy()
+    # outputs past each sample's valid length are masked to zero
+    assert np.abs(o[0, 3:]).sum() == 0.0
+    # sample 0's reverse outputs equal running the r_cell on just the
+    # valid prefix reversed
+    prefix = x[0:1, :3][:, ::-1].copy()
+    r_outs, _ = bi._r_cell.unroll(3, nd.array(prefix), layout="NTC",
+                                  merge_outputs=True)
+    np.testing.assert_allclose(o[0, :3, H:], r_outs.asnumpy()[0][::-1],
+                               rtol=1e-5, atol=1e-6)
