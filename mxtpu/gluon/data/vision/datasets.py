@@ -115,7 +115,10 @@ class CIFAR10(_DownloadedDataset):
             tar = os.path.join(self._root, "cifar-10-python.tar.gz")
             if os.path.exists(tar):
                 with tarfile.open(tar) as tf:
-                    tf.extractall(self._root, filter="data")
+                    if hasattr(tarfile, "data_filter"):
+                        tf.extractall(self._root, filter="data")
+                    else:  # pre-3.12 point releases
+                        tf.extractall(self._root)
             else:
                 raise MXNetError(
                     f"CIFAR-10 not found under {self._root} (no network "

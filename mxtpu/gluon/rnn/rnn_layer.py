@@ -99,11 +99,18 @@ class _RNNLayer(HybridBlock):
     def hybrid_forward(self, F, inputs, states=None, **params):
         """inputs: (T,N,C) for TNC / (N,T,C) for NTC; states optional."""
         skip_states = states is None
+        sym_mode = not hasattr(inputs, "shape")  # Symbol composition
         if self._layout == "NTC":
             inputs = F.transpose(inputs, axes=(1, 0, 2))
-        batch = inputs.shape[1]
         if skip_states:
-            states = self._make_begin_state(F, batch)
+            if sym_mode:
+                # zero initial states become named graph inputs whose
+                # shapes are inferred at bind time (the auto-var
+                # convention, like SoftmaxOutput's label)
+                states = [F.var(f"{self.prefix}begin_state_{i}")
+                          for i in range(len(self.state_info(0)))]
+            else:
+                states = self._make_begin_state(F, inputs.shape[1])
         if not isinstance(states, (list, tuple)):
             states = [states]
 
@@ -119,7 +126,7 @@ class _RNNLayer(HybridBlock):
                           for n in order], dim=0)
 
         op_inputs = [inputs, flat] + list(states)
-        if self._dropout > 0 and autograd.is_training():
+        if self._dropout > 0 and autograd.is_training() and not sym_mode:
             from ...ndarray import random as _rnd
             op_inputs.append(_rnd._next_key_nd())
         out = F.RNN(*op_inputs, state_size=self._hidden_size,

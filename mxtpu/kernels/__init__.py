@@ -1,0 +1,37 @@
+"""Pallas TPU kernels — the cuDNN-fusion tier of the reference
+(``src/operator/nn/cudnn/``†), rebuilt as hand-written TPU kernels for
+the ops XLA's automatic fusion doesn't nail (SURVEY.md §7 M6).
+
+Dispatch policy: kernels engage on the TPU backend (or when
+``MXTPU_PALLAS=interpret`` forces interpreter mode for CPU testing);
+every kernel has a pure-lax reference implementation used as fallback
+and as the parity oracle in tests.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["layer_norm", "flash_attention", "pallas_enabled",
+           "softmax_cross_entropy"]
+
+
+def pallas_enabled() -> bool:
+    """True when the Pallas path should be used."""
+    flag = os.environ.get("MXTPU_PALLAS", "auto")
+    if flag in ("0", "off", "false"):
+        return False
+    if flag == "interpret":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    return os.environ.get("MXTPU_PALLAS", "auto") == "interpret" or \
+        jax.default_backend() != "tpu"
+
+
+from .layer_norm import layer_norm, layer_norm_reference  # noqa: E402
+from .flash_attention import (flash_attention,  # noqa: E402
+                              attention_reference)

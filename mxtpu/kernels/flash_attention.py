@@ -1,0 +1,178 @@
+"""Flash attention (blockwise, online softmax) Pallas kernel.
+
+The reference has no fused attention (2018-era; attention lived in
+example code) — this is a *new-capability* kernel mandated by the north
+star (SURVEY.md §5.7): O(T) memory attention for long-context training,
+the building block for the BERT/Transformer configs.
+
+Design: grid (batch·heads, q_blocks, kv_blocks) with the kv axis
+innermost; VMEM scratch carries the running max ``m``, normalizer ``l``
+and accumulator across kv blocks (the TPU grid is sequential, so
+scratch persists).  Softmax runs in f32 regardless of input dtype; the
+q·kᵀ and p·v matmuls hit the MXU with
+``preferred_element_type=float32``.  Causal blocks strictly above the
+diagonal are skipped via ``pl.when``.
+
+Backward: recompute-based (jax AD through the lax reference) — exact
+but O(T·S) memory per head; a blockwise backward kernel is the
+follow-up.  Forward-only inference (the common serving path) stays
+O(T·D).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal=False, sm_scale=None):
+    """Pure-lax attention — fallback path and parity oracle.
+    q: (B, H, Tq, D); k, v: (B, H, Tk, D)."""
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        row = jnp.arange(Tq)[:, None] + (Tk - Tq)
+        col = jnp.arange(Tk)[None, :]
+        s = jnp.where(col <= row, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _block(n: int, prefer: int) -> int:
+    for blk in (prefer, 256, 128, 64, 32, 16, 8):
+        if blk <= prefer and n % blk == 0:
+            return blk
+    return n
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               sm_scale, causal, bq, bk, nk, delta):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks strictly above the diagonal (every column in
+    # the block is in the future of every row); delta = Tk - Tq aligns
+    # the diagonal when kv is longer than q (cached decoding)
+    run = True
+    if causal:
+        first_row = i * bq + delta
+        first_col = j * bk
+        run = first_col <= first_row + bq - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + \
+                i * bq + delta
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + \
+                j * bk
+            s = jnp.where(col <= row, s, _NEG_INF)
+        m_prev = m_scr[:]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+def _flash_forward(q3, k3, v3, causal, sm_scale, interpret):
+    BH, Tq, D = q3.shape
+    Tk = k3.shape[1]
+    bq = _block(Tq, 128)
+    bk = _block(Tk, 128)
+    nq, nk = Tq // bq, Tk // bk
+    kernel = functools.partial(_fa_kernel, sm_scale=sm_scale,
+                               causal=causal, bq=bq, bk=bk, nk=nk,
+                               delta=Tk - Tq)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_pallas(q, k, v, causal, sm_scale):
+    from . import interpret_mode
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    o = _flash_forward(q.reshape(B * H, Tq, D),
+                       k.reshape(B * H, Tk, D),
+                       v.reshape(B * H, Tk, D), causal, sm_scale,
+                       interpret_mode())
+    return o.reshape(B, H, Tq, D)
+
+
+def _fa_fwd(q, k, v, causal, sm_scale):
+    return _flash_attention_pallas(q, k, v, causal, sm_scale), (q, k, v)
+
+
+def _fa_bwd(causal, sm_scale, res, do):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal,
+                                               sm_scale), q, k, v)
+    return vjp(do)
+
+
+_flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Fused attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D).
+    Pallas on TPU, lax reference elsewhere or for awkward shapes."""
+    from . import pallas_enabled
+    D = q.shape[-1]
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / (D ** 0.5)
+    Tq, Tk = q.shape[2], k.shape[2]
+    if not pallas_enabled() or D > 512 or Tq % 8 or Tk % 8:
+        return attention_reference(q, k, v, causal, scale)
+    return _flash_attention_pallas(q, k, v, bool(causal), scale)

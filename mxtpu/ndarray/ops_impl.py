@@ -881,6 +881,10 @@ register_op("SoftmaxOutput", num_inputs=2,
 
 
 def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    if axis in (-1, x.ndim - 1):
+        # hot path: fused Pallas kernel on TPU, lax composite elsewhere
+        from ..kernels import layer_norm as _fused_ln
+        return _fused_ln(x, gamma, beta, eps=eps)
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
     inv = lax.rsqrt(var + eps)

@@ -90,23 +90,20 @@ def _scan_dir(x, h0, c0, w_h2h, pre, mode, H, reverse):
 
     if mode == "gru":
         # pre holds W x + b_i2h for all gates + b_h2h for r,z only; the
-        # n-gate recurrent bias b_Rn is applied inside the reset product.
-        def body(h, inputs):
-            pre_t, b_rn = inputs
+        # n-gate recurrent bias b_Rn is loop-invariant and closed over
+        # (applied inside the reset product).
+        pre_t, b_rn = pre
+
+        def body(h, pre_step):
             hp = h @ w_h2h.T
-            pr, pz, pn = jnp.split(pre_t, 3, axis=-1)
+            pr, pz, pn = jnp.split(pre_step, 3, axis=-1)
             hr, hz, hn = jnp.split(hp, 3, axis=-1)
             r = jax.nn.sigmoid(pr + hr)
             z = jax.nn.sigmoid(pz + hz)
             n = jnp.tanh(pn + r * (hn + b_rn))
             h2 = (1.0 - z) * n + z * h
             return h2, h2
-        pre_t, b_rn = pre
-        T = pre_t.shape[0]
-        h_t, ys = lax.scan(body, h0,
-                           (pre_t, jnp.broadcast_to(b_rn, (T,) +
-                                                    b_rn.shape)),
-                           reverse=reverse)
+        h_t, ys = lax.scan(body, h0, pre_t, reverse=reverse)
         return ys, h_t, None
 
     act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
@@ -209,3 +206,19 @@ register_op(
             Param("state_outputs", bool, False)],
     num_outputs_fn=_rnn_num_outputs,
     doc=_rnn_impl.__doc__)(_rnn_impl)
+
+
+def _flash_attention_op(q, k, v, causal=False, sm_scale=-1.0):
+    """Fused attention op (new capability; no reference counterpart —
+    SURVEY.md §5.7 mandates it for long-context).  q: (B,H,Tq,D),
+    k/v: (B,H,Tk,D); sm_scale < 0 means 1/sqrt(D)."""
+    from ..kernels import flash_attention
+    scale = None if sm_scale is None or sm_scale < 0 else sm_scale
+    return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+
+
+register_op("flash_attention", num_inputs=3,
+            params=[Param("causal", bool, False),
+                    Param("sm_scale", float, -1.0)],
+            aliases=("contrib_flash_attention",),
+            doc=_flash_attention_op.__doc__)(_flash_attention_op)

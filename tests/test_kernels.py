@@ -1,0 +1,162 @@
+"""Pallas kernels vs lax references (SURVEY §7 M6; the
+check_consistency discipline applied to the kernel tier).  On CPU the
+kernels run in interpreter mode via MXTPU_PALLAS=interpret."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxtpu.kernels import (layer_norm, flash_attention)
+from mxtpu.kernels.layer_norm import (layer_norm_reference,
+                                      _layer_norm_pallas)
+from mxtpu.kernels.flash_attention import (attention_reference,
+                                           _flash_attention_pallas)
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setenv("MXTPU_PALLAS", "interpret")
+
+
+def test_layer_norm_forward_parity():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 64).astype(np.float32))
+    g = jnp.asarray(rng.uniform(0.5, 1.5, 64).astype(np.float32))
+    b = jnp.asarray(rng.randn(64).astype(np.float32))
+    got = _layer_norm_pallas(x, g, b, 1e-5)
+    ref = layer_norm_reference(x, g.reshape(1, -1), b.reshape(1, -1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_3d_and_odd_rows():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 7, 48).astype(np.float32))
+    g = jnp.asarray(rng.uniform(0.5, 1.5, (1, 1, 48)).astype(np.float32))
+    b = jnp.asarray(rng.randn(1, 1, 48).astype(np.float32))
+    got = layer_norm(x, g, b)
+    ref = layer_norm_reference(x, g, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_backward_parity():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    g = jnp.asarray(rng.uniform(0.5, 1.5, 32).astype(np.float32))
+    b = jnp.asarray(rng.randn(32).astype(np.float32))
+    dy = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+
+    def f_pallas(x, g, b):
+        return jnp.sum(_layer_norm_pallas(x, g, b, 1e-5) * dy)
+
+    def f_ref(x, g, b):
+        return jnp.sum(layer_norm_reference(
+            x, g.reshape(1, -1), b.reshape(1, -1)) * dy)
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, g, b)
+    for a, e, name in zip(gp, gr, ["dx", "dgamma", "dbeta"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_layer_norm_op_integration():
+    """nd.LayerNorm routes through the fused kernel and still matches
+    the composite."""
+    from mxtpu import nd
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 24).astype(np.float32)
+    g = rng.uniform(0.5, 1.5, 24).astype(np.float32)
+    b = rng.randn(24).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    ref = layer_norm_reference(jnp.asarray(x),
+                               jnp.asarray(g).reshape(1, -1),
+                               jnp.asarray(b).reshape(1, -1))
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# flash attention
+# ----------------------------------------------------------------------
+
+def test_flash_attention_parity():
+    rng = np.random.RandomState(4)
+    B, H, T, D = 2, 3, 32, 16
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    got = _flash_attention_pallas(q, k, v, False, 1.0 / np.sqrt(D))
+    ref = attention_reference(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_causal():
+    rng = np.random.RandomState(5)
+    B, H, T, D = 1, 2, 24, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    got = _flash_attention_pallas(q, k, v, True, 1.0 / np.sqrt(D))
+    ref = attention_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # causality: output at t must not depend on future v
+    v2 = v.at[:, :, -1].set(v[:, :, -1] + 100.0)
+    got2 = _flash_attention_pallas(q, k, v2, True, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(got[:, :, :-1]),
+                               np.asarray(got2[:, :, :-1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_cross_lengths():
+    """Tk > Tq (decoding with cache) incl. causal diagonal alignment."""
+    rng = np.random.RandomState(6)
+    B, H, Tq, Tk, D = 1, 2, 8, 32, 16
+    q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+    for causal in (False, True):
+        got = _flash_attention_pallas(q, k, v, causal, 1.0 / np.sqrt(D))
+        ref = attention_reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_flash_attention_grad():
+    rng = np.random.RandomState(7)
+    B, H, T, D = 1, 1, 16, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    do = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) * do)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) * do)
+
+    gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_flash_attention_op():
+    from mxtpu import nd
+    rng = np.random.RandomState(8)
+    q = rng.randn(1, 2, 16, 8).astype(np.float32)
+    k = rng.randn(1, 2, 16, 8).astype(np.float32)
+    v = rng.randn(1, 2, 16, 8).astype(np.float32)
+    out = nd.flash_attention(nd.array(q), nd.array(k), nd.array(v),
+                             causal=True)
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), True)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -323,3 +323,29 @@ def test_bidirectional_cell_valid_length():
                                   merge_outputs=True)
     np.testing.assert_allclose(o[0, :3, H:], r_outs.asnumpy()[0][::-1],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_layer_symbolic_compose():
+    """Symbol composition + export of a fused RNN layer (review
+    regression: used to crash on Symbol.shape)."""
+    import mxtpu as mx
+    lstm = rnn.LSTM(4, input_size=3)
+    lstm.initialize()
+    out = lstm(mx.sym.var("data"))
+    args = out.list_arguments()
+    assert "data" in args
+    assert any("begin_state" in a for a in args)
+    # bind and compare with the eager path
+    rng = np.random.RandomState(9)
+    x = rng.randn(5, 2, 3).astype(np.float32)
+    bindings = {"data": nd.array(x)}
+    for a in args:
+        if "begin_state" in a:
+            bindings[a] = nd.zeros((1, 2, 4))
+        elif a != "data":
+            pname = a
+            bindings[a] = dict(lstm.collect_params())[pname].data()
+    got = out.eval(**bindings)
+    ref = lstm(nd.array(x))
+    np.testing.assert_allclose(got[0].asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
