@@ -1,18 +1,35 @@
-"""Benchmark: hybridized LeNet-MNIST training throughput (north-star
-workload 1, BASELINE.md).  Runs on whatever accelerator jax exposes
-(the driver runs it on the real TPU chip) and prints ONE JSON line.
+"""Benchmark: compiled training-step throughput on the real chip.
 
-The measured unit is the full compiled training step — forward,
-backward, fused optimizer — via ``mxtpu.parallel.build_train_step``,
-i.e. the samples/sec a Speedometer would report (SURVEY.md §5.5).
-``vs_baseline`` is null: the reference mount was empty both rounds, so
-no published number exists to compare against (BASELINE.md).
+Prints ONE JSON line.  Default workload: hybridized LeNet-MNIST
+(north-star workload 1, BASELINE.md); set MXTPU_BENCH_MODEL=resnet50
+for the ImageNet-shaped north-star config.  The measured unit is the
+full compiled training
+step — forward, backward, fused optimizer (+BN aux writeback) — via
+``mxtpu.parallel.build_train_step``, i.e. the samples/sec a
+Speedometer would report (SURVEY.md §5.5).  ``vs_baseline`` is null:
+the reference mount was empty in every round so far, so no published
+number exists to compare against (BASELINE.md).
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _measure(step, x, y, warmup, iters, batch_size):
+    from mxtpu import nd
+    for _ in range(warmup):
+        step(x, y)
+    nd.waitall()
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(iters):
+        last = step(x, y)
+    float(last.asscalar())  # sync
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
 
 
 def bench_lenet(batch_size=512, warmup=5, iters=30):
@@ -29,22 +46,35 @@ def bench_lenet(batch_size=512, warmup=5, iters=30):
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(batch_size, 1, 28, 28).astype(np.float32))
     y = nd.array(rng.randint(0, 10, (batch_size,)).astype(np.float32))
-    for _ in range(warmup):
-        step(x, y)
-    nd.waitall()
-    t0 = time.perf_counter()
-    last = None
-    for _ in range(iters):
-        last = step(x, y)
-    float(last.asscalar())  # sync
-    dt = time.perf_counter() - t0
-    return batch_size * iters / dt
+    return _measure(step, x, y, warmup, iters, batch_size), \
+        "lenet_mnist_train_throughput"
+
+
+def bench_resnet50(batch_size=64, warmup=3, iters=20):
+    """ResNet-50 ImageNet-shaped training step (north-star #1)."""
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.models import resnet50
+
+    net = resnet50(classes=1000)
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
+    return _measure(step, x, y, warmup, iters, batch_size), \
+        "resnet50_imagenet_train_throughput"
 
 
 def main():
-    value = bench_lenet()
+    model = os.environ.get("MXTPU_BENCH_MODEL", "lenet")
+    fn = {"lenet": bench_lenet, "resnet50": bench_resnet50}[model]
+    value, metric = fn()
     print(json.dumps({
-        "metric": "lenet_mnist_train_throughput",
+        "metric": metric,
         "value": round(value, 1),
         "unit": "samples/sec",
         "vs_baseline": None,

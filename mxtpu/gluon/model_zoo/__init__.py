@@ -1,0 +1,10 @@
+"""Model zoo (reference ``python/mxnet/gluon/model_zoo/``†).
+
+``pretrained=True`` requires pre-placed weight files (no network in
+this environment); architectures themselves are fully constructible and
+trainable.
+"""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

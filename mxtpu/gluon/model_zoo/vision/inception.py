@@ -1,0 +1,164 @@
+"""Inception V3 (reference
+``python/mxnet/gluon/model_zoo/vision/inception.py``†)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(**kwargs):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Branched(HybridBlock):
+    """Concats parallel branches along channels."""
+
+    def __init__(self, *branches, **kwargs):
+        super().__init__(**kwargs)
+        self._branches = []
+        for i, b in enumerate(branches):
+            setattr(self, f"branch{i}", b)
+            self._branches.append(b)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._branches], dim=1)
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for setting in conv_settings:
+        kernel_size, strides, padding, channels = setting
+        kw = {"channels": channels, "kernel_size": kernel_size}
+        if strides is not None:
+            kw["strides"] = strides
+        if padding is not None:
+            kw["padding"] = padding
+        out.add(_make_basic_conv(**kw))
+    return out
+
+
+def _make_A(pool_features):
+    return _Branched(
+        _make_branch(None, (1, None, None, 64)),
+        _make_branch(None, (1, None, None, 48), (5, None, 2, 64)),
+        _make_branch(None, (1, None, None, 64), (3, None, 1, 96),
+                     (3, None, 1, 96)),
+        _make_branch("avg", (1, None, None, pool_features)))
+
+
+def _make_B():
+    return _Branched(
+        _make_branch(None, (3, 2, None, 384)),
+        _make_branch(None, (1, None, None, 64), (3, None, 1, 96),
+                     (3, 2, None, 96)),
+        _make_branch("max"))
+
+
+def _make_C(channels_7x7):
+    return _Branched(
+        _make_branch(None, (1, None, None, 192)),
+        _make_branch(None, (1, None, None, channels_7x7),
+                     ((1, 7), None, (0, 3), channels_7x7),
+                     ((7, 1), None, (3, 0), 192)),
+        _make_branch(None, (1, None, None, channels_7x7),
+                     ((7, 1), None, (3, 0), channels_7x7),
+                     ((1, 7), None, (0, 3), channels_7x7),
+                     ((7, 1), None, (3, 0), channels_7x7),
+                     ((1, 7), None, (0, 3), 192)),
+        _make_branch("avg", (1, None, None, 192)))
+
+
+def _make_D():
+    return _Branched(
+        _make_branch(None, (1, None, None, 192), (3, 2, None, 320)),
+        _make_branch(None, (1, None, None, 192),
+                     ((1, 7), None, (0, 3), 192),
+                     ((7, 1), None, (3, 0), 192), (3, 2, None, 192)),
+        _make_branch("max"))
+
+
+class _SplitConcat(HybridBlock):
+    """branch → two sub-convs concatenated (the E-block fan-out)."""
+
+    def __init__(self, stem, sub1, sub2, **kwargs):
+        super().__init__(**kwargs)
+        self.stem = stem
+        self.sub1 = sub1
+        self.sub2 = sub2
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        return F.concat(self.sub1(x), self.sub2(x), dim=1)
+
+
+def _make_E():
+    return _Branched(
+        _make_branch(None, (1, None, None, 320)),
+        _SplitConcat(
+            _make_basic_conv(channels=384, kernel_size=1),
+            _make_basic_conv(channels=384, kernel_size=(1, 3),
+                             padding=(0, 1)),
+            _make_basic_conv(channels=384, kernel_size=(3, 1),
+                             padding=(1, 0))),
+        _SplitConcat(
+            nn.HybridSequential(prefix=""),
+            _make_basic_conv(channels=384, kernel_size=(1, 3),
+                             padding=(0, 1)),
+            _make_basic_conv(channels=384, kernel_size=(3, 1),
+                             padding=(1, 0))),
+        _make_branch("avg", (1, None, None, 192)))
+
+
+class Inception3(HybridBlock):
+    """Inception V3 (reference ``Inception3``†)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                           strides=2))
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+        self.features.add(_make_basic_conv(channels=64, kernel_size=3,
+                                           padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+        self.features.add(_make_basic_conv(channels=192, kernel_size=3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_make_E())
+        self.features.add(_make_E())
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled")
+    return Inception3(**kwargs)
+
+
+# _SplitConcat with an empty stem means "apply subs to the raw input";
+# nn.HybridSequential() with no children is the identity.

@@ -129,6 +129,12 @@ class _RNNLayer(HybridBlock):
         if self._dropout > 0 and autograd.is_training() and not sym_mode:
             from ...ndarray import random as _rnd
             op_inputs.append(_rnd._next_key_nd())
+        elif self._dropout > 0 and sym_mode:
+            import warnings
+            warnings.warn(
+                "inter-layer RNN dropout is inactive in symbolic "
+                "graphs (no PRNG key input); train through the eager/"
+                "hybridize path for dropout", stacklevel=2)
         out = F.RNN(*op_inputs, state_size=self._hidden_size,
                     num_layers=self._num_layers, mode=self._mode,
                     bidirectional=self._dir == 2, p=self._dropout,

@@ -13,8 +13,7 @@ import os
 
 import jax
 
-__all__ = ["layer_norm", "flash_attention", "pallas_enabled",
-           "softmax_cross_entropy"]
+__all__ = ["layer_norm", "flash_attention", "pallas_enabled"]
 
 
 def pallas_enabled() -> bool:

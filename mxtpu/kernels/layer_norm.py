@@ -67,16 +67,18 @@ def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref,
                         ((0, 7), (0, 0)))
 
 
-def _row_block(n_rows: int) -> int:
+def _row_block(n_rows: int, n_cols: int):
+    """Largest row block that divides n_rows and keeps the x-block
+    within a VMEM-friendly budget; None → use the lax fallback."""
     for blk in (256, 128, 64, 32, 16, 8):
-        if n_rows % blk == 0:
+        if n_rows % blk == 0 and blk * n_cols * 4 <= (4 << 20):
             return blk
-    return n_rows
+    return None
 
 
 def _pallas_ln_fwd(x2, gamma, beta, eps, interpret):
     R, C = x2.shape
-    BR = _row_block(R)
+    BR = _row_block(R, C)
     grid = (R // BR,)
     y, mean, rstd = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
@@ -109,7 +111,7 @@ def _pallas_ln_fwd(x2, gamma, beta, eps, interpret):
 
 def _pallas_ln_bwd(x2, gamma, mean, rstd, dy2, interpret):
     R, C = x2.shape
-    BR = _row_block(R)
+    BR = _row_block(R, C)
     grid = (R // BR,)
     dx, dg_part, db_part = pl.pallas_call(
         _ln_bwd_kernel,
@@ -174,7 +176,10 @@ def layer_norm(x, gamma, beta, eps=1e-5):
     mode), lax composite elsewhere."""
     from . import pallas_enabled
     C = x.shape[-1]
-    if not pallas_enabled() or C > 16384:
+    n_rows = 1
+    for d in x.shape[:-1]:
+        n_rows *= d
+    if not pallas_enabled() or _row_block(n_rows, C) is None:
         return layer_norm_reference(x, gamma, beta, eps)
     lead = x.shape[:-1]
     x2 = x.reshape(-1, C)

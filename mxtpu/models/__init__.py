@@ -9,7 +9,15 @@ from __future__ import annotations
 
 from ..gluon import nn
 
-__all__ = ["lenet", "mlp"]
+__all__ = ["lenet", "mlp", "resnet50"]
+
+
+def resnet50(classes: int = 1000, thumbnail: bool = False):
+    """ResNet-50 v1 — north-star workload 2 (BASELINE.md; reference
+    ``example/image-classification/symbols/resnet.py``†)."""
+    from ..gluon.model_zoo import vision
+    return vision.get_resnet(1, 50, thumbnail=thumbnail,
+                             classes=classes)
 
 
 def lenet(classes: int = 10):

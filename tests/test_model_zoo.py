@@ -1,0 +1,118 @@
+"""Model zoo construction + forward shapes (reference
+``tests/python/unittest/test_gluon_model_zoo.py``†).  Small spatial
+inputs keep CPU runtime sane; resnet50 also checks hybridize and a
+training step."""
+import numpy as np
+import pytest
+
+import os
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+from mxtpu.gluon.model_zoo import get_model, vision
+
+# The full zoo sweep is minutes of CPU conv time; the quick suite keeps
+# one model per family and the nightly-style sweep runs with
+# MXTPU_TEST_SLOW=1 (the reference splits unittest vs nightly the same
+# way, SURVEY §4.3).
+slow = pytest.mark.skipif(not os.environ.get("MXTPU_TEST_SLOW"),
+                          reason="set MXTPU_TEST_SLOW=1 for full sweep")
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v2", "squeezenet1.1",
+])
+def test_zoo_forward_shapes(name):
+    net = get_model(name, classes=10)
+    net.initialize(init="xavier")
+    x = nd.array(np.random.randn(2, 3, 64, 64).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 10), (name, out.shape)
+
+
+@slow
+def test_zoo_forward_shapes_full():
+    for name in ["resnet18_v1", "resnet50_v1", "resnet50_v2",
+                 "mobilenet0.25", "mobilenetv2_0.25"]:
+        net = get_model(name, classes=10)
+        net.initialize(init="xavier")
+        x = nd.array(np.random.randn(2, 3, 64, 64).astype(np.float32))
+        assert net(x).shape == (2, 10), name
+
+
+def test_vgg_small():
+    net = vision.vgg11(classes=7)
+    net.initialize(init="xavier")
+    out = net(nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32)))
+    assert out.shape == (1, 7)
+
+
+@slow
+def test_vgg_and_alexnet_shapes():
+    # these need bigger spatial extents for the dense layers
+    net = vision.vgg11(classes=7)
+    net.initialize(init="xavier")
+    out = net(nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32)))
+    assert out.shape == (1, 7)
+
+    net = vision.alexnet(classes=5)
+    net.initialize(init="xavier")
+    out = net(nd.array(
+        np.random.randn(1, 3, 224, 224).astype(np.float32)))
+    assert out.shape == (1, 5)
+
+
+@slow
+def test_densenet_shape():
+    net = vision.densenet121(classes=4)
+    net.initialize(init="xavier")
+    out = net(nd.array(np.random.randn(1, 3, 64, 64).astype(np.float32)))
+    assert out.shape == (1, 4)
+
+
+def test_resnet_thumbnail_cifar():
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(init="xavier")
+    out = net(nd.array(np.random.randn(2, 3, 32, 32).astype(np.float32)))
+    assert out.shape == (2, 10)
+
+
+def test_get_model_errors():
+    with pytest.raises(mx.MXNetError):
+        get_model("resnet9000")
+    with pytest.raises(mx.MXNetError):
+        vision.resnet18_v1(pretrained=True)
+
+
+def test_resnet18_hybridize_and_train_step():
+    from mxtpu import gluon
+    from mxtpu.gluon import loss as gloss
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=3)
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.randn(4, 3, 32, 32).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 0], np.float32))
+    losses = []
+    for _ in range(4):
+        with autograd.record():
+            l = L(net(x), y)
+        l.backward()
+        trainer.step(4)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0], losses
+    # eval mode uses running stats (different from batch stats)
+    out_train_off = net(x)
+    assert np.isfinite(out_train_off.asnumpy()).all()
+
+
+@slow
+def test_inception_shape():
+    net = vision.inception_v3(classes=6)
+    net.initialize(init="xavier")
+    # inception v3 needs >= 299x299 nominally; 299 keeps the 8x8 pool
+    x = nd.array(np.random.randn(1, 3, 299, 299).astype(np.float32))
+    out = net(x)
+    assert out.shape == (1, 6)
