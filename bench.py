@@ -61,7 +61,8 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
     net.initialize(init="xavier")
     step = parallel.build_train_step(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype=os.environ.get("MXTPU_BENCH_DTYPE") or None)
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
@@ -71,7 +72,11 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
 
 def main():
     model = os.environ.get("MXTPU_BENCH_MODEL", "lenet")
-    fn = {"lenet": bench_lenet, "resnet50": bench_resnet50}[model]
+    table = {"lenet": bench_lenet, "resnet50": bench_resnet50}
+    fn = table.get(model)
+    if fn is None:
+        sys.exit(f"unknown MXTPU_BENCH_MODEL={model!r}; "
+                 f"choices: {sorted(table)}")
     value, metric = fn()
     print(json.dumps({
         "metric": metric,

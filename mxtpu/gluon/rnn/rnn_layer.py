@@ -129,7 +129,9 @@ class _RNNLayer(HybridBlock):
         if self._dropout > 0 and autograd.is_training() and not sym_mode:
             from ...ndarray import random as _rnd
             op_inputs.append(_rnd._next_key_nd())
-        elif self._dropout > 0 and sym_mode:
+        elif self._dropout > 0 and sym_mode and autograd.is_training():
+            # only worth flagging when a training graph is being built;
+            # inference exports correctly run with dropout off
             import warnings
             warnings.warn(
                 "inter-layer RNN dropout is inactive in symbolic "

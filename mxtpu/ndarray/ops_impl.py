@@ -679,15 +679,15 @@ def _convolution(x, w, b=None, kernel=(), stride=None, dilate=None,
     stride = _tuple(stride, nd)
     dilate = _tuple(dilate, nd)
     pad = _tuple(pad, nd) if pad is not None else (0,) * nd
+    # no preferred_element_type upcast: the MXU accumulates bf16
+    # products in f32 natively, and an explicit f32 output dtype breaks
+    # the conv VJP (f32 cotangent against bf16 operands)
     out = lax.conv_general_dilated(
         x, w, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32
-        if x.dtype == jnp.bfloat16 else None)
-    out = out.astype(x.dtype)
+        feature_group_count=num_group)
     if b is not None and not no_bias:
         if layout.endswith("C"):
             out = out + b
@@ -920,18 +920,23 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     functionally we return them instead)."""
     axis = axis % x.ndim
     axes = tuple(i for i in range(x.ndim) if i != axis)
+    # statistics in f32 regardless of compute dtype (AMP discipline:
+    # bf16 mantissas lose small EMA/variance contributions)
+    x32 = x.astype(jnp.float32)
     if use_global_stats:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x - mean.reshape(
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.mean(jnp.square(x32 - mean.reshape(
             tuple(-1 if i == axis else 1 for i in range(x.ndim)))),
             axis=axes)
     shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = (x - mean.reshape(shape)) * lax.rsqrt(
-        var.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
-    return out, mean, var
+    out = (x32 - mean.reshape(shape)) * lax.rsqrt(
+        var.reshape(shape) + eps) * g.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype), mean, var
 
 
 register_op("BatchNorm", num_inputs=5, num_outputs=3,

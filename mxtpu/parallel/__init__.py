@@ -139,7 +139,8 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", batch_axis: int = 0,
-                 param_spec_fn: Optional[Callable] = None, donate=True):
+                 param_spec_fn: Optional[Callable] = None, donate=True,
+                 compute_dtype=None):
         from ..gluon.block import _traced_forward
         self._traced_forward = _traced_forward
         self.net = net
@@ -150,6 +151,12 @@ class TrainStep:
         self.batch_axis = batch_axis
         self.param_spec_fn = param_spec_fn
         self.donate = donate
+        # mixed precision: forward/backward in compute_dtype (bf16 puts
+        # the matmuls/convs on the MXU's fast path), master weights,
+        # loss, and optimizer state stay f32 — the reference's
+        # multi_precision=True AMP recipe, compiled into the one program
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
         self._compiled = {}
         self._params: Optional[List] = None
         self._t = 0
@@ -201,12 +208,26 @@ class TrainStep:
         traced_forward = self._traced_forward
         aux_box: Dict[str, Any] = {}
 
+        compute_dtype = self.compute_dtype
+
         def loss_flat(train_vals, frozen_vals, key_data, x, y):
             pvals: List[Any] = [None] * n_param
             for i, v in zip(train_idx, train_vals):
                 pvals[i] = v
             for i, v in zip(frozen_idx, frozen_vals):
                 pvals[i] = v
+            if compute_dtype is not None:
+                # BN running stats (aux-named params) stay f32: their
+                # EMA updates are too small for a bf16 mantissa
+                from ..symbol import _is_aux_name
+                pvals = [v.astype(compute_dtype)
+                         if v is not None
+                         and not _is_aux_name(params[i].name)
+                         and jnp.issubdtype(v.dtype, jnp.floating)
+                         else v
+                         for i, v in enumerate(pvals)]
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(compute_dtype)
             raw_outs, _, aux_params, raw_aux = traced_forward(
                 net, params, pvals, [NDArray(x, None, _placed=True)],
                 True, key_data)
@@ -218,7 +239,12 @@ class TrainStep:
             l = loss_fn(pred, NDArray(y, None, _placed=True))
             raw_l = l.data if isinstance(l, NDArray) else l
             aux_box["aux_params"] = aux_params
-            return jnp.mean(raw_l), tuple(raw_aux)
+            # loss and aux (running stats) leave the bf16 region in f32
+            if compute_dtype is not None:
+                raw_aux = [a.astype(jnp.float32)
+                           if jnp.issubdtype(a.dtype, jnp.floating)
+                           else a for a in raw_aux]
+            return jnp.mean(raw_l.astype(jnp.float32)), tuple(raw_aux)
 
         def step(train_vals, frozen_vals, opt_state, key_data, lrs, wds,
                  x, y):
@@ -315,7 +341,8 @@ class TrainStep:
 def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      mesh: Optional[Mesh] = None, dp_axis: str = "dp",
                      batch_axis: int = 0, param_spec_fn=None,
-                     donate: bool = True) -> TrainStep:
+                     donate: bool = True,
+                     compute_dtype=None) -> TrainStep:
     """Compile net+loss+optimizer into a single SPMD train step.
 
     ``mesh=None`` → single-device executable (still one fused program).
@@ -326,4 +353,4 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
         optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
     return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
-                     donate=donate)
+                     donate=donate, compute_dtype=compute_dtype)

@@ -95,3 +95,26 @@ def test_save_load_parameters_roundtrip(tmp_path):
     net2 = _Dense(4, 8)
     net2.load_parameters(f)
     np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_train_step_mixed_precision():
+    """compute_dtype=bfloat16: bf16 forward/backward, f32 master
+    weights and optimizer state; training still converges."""
+    import numpy as np
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import nn, loss as gloss
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.Flatten(),
+            nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(16, 3, 8, 8).astype(np.float32))
+    y = nd.array((rng.rand(16) > 0.5).astype(np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    for p in net.collect_params().values():
+        assert p.data().dtype == np.float32, p.name  # master weights

@@ -48,13 +48,8 @@ def test_vgg_small():
 
 
 @slow
-def test_vgg_and_alexnet_shapes():
-    # these need bigger spatial extents for the dense layers
-    net = vision.vgg11(classes=7)
-    net.initialize(init="xavier")
-    out = net(nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32)))
-    assert out.shape == (1, 7)
-
+def test_alexnet_shape():
+    # alexnet's dense head needs the full 224x224 spatial extent
     net = vision.alexnet(classes=5)
     net.initialize(init="xavier")
     out = net(nd.array(
