@@ -47,7 +47,7 @@ def bench_lenet(batch_size=512, warmup=5, iters=30):
     x = nd.array(rng.randn(batch_size, 1, 28, 28).astype(np.float32))
     y = nd.array(rng.randint(0, 10, (batch_size,)).astype(np.float32))
     return _measure(step, x, y, warmup, iters, batch_size), \
-        "lenet_mnist_train_throughput"
+        "lenet_mnist_train_throughput", "samples/sec"
 
 
 def bench_resnet50(batch_size=64, warmup=3, iters=20):
@@ -67,21 +67,51 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
     x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
     return _measure(step, x, y, warmup, iters, batch_size), \
-        "resnet50_imagenet_train_throughput"
+        "resnet50_imagenet_train_throughput", "samples/sec"
+
+
+def bench_bert(batch_size=8, seq_len=128, warmup=3, iters=20):
+    """BERT-Large MLM-style training step, tokens/sec (north-star #2).
+    bf16 compute by default (set MXTPU_BENCH_DTYPE= to override)."""
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.models.transformer import bert_large
+
+    net = bert_large(vocab_size=30522, max_length=seq_len, dropout=0.1)
+    net.initialize(init="xavier")
+    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+
+    def mlm_loss(pred, y):
+        V = 30522
+        return gloss.SoftmaxCrossEntropyLoss()(
+            pred.reshape((-1, V)), y.reshape((-1,)))
+
+    # cast_batch=False: token ids must not be rounded through bf16
+    step = parallel.build_train_step(
+        net, mlm_loss, "adam", {"learning_rate": 1e-4},
+        compute_dtype=dtype, cast_batch=False)
+    rng = np.random.RandomState(0)
+    toks = nd.array(rng.randint(0, 30522, (batch_size, seq_len))
+                    .astype(np.float32))
+    tokens_per_batch = batch_size * seq_len
+    value = _measure(step, toks, toks, warmup, iters, tokens_per_batch)
+    return value, "bert_large_pretrain_throughput", "tokens/sec"
 
 
 def main():
     model = os.environ.get("MXTPU_BENCH_MODEL", "lenet")
-    table = {"lenet": bench_lenet, "resnet50": bench_resnet50}
+    table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
+             "bert": bench_bert}
     fn = table.get(model)
     if fn is None:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={model!r}; "
                  f"choices: {sorted(table)}")
-    value, metric = fn()
+    value, metric, unit = fn()
     print(json.dumps({
         "metric": metric,
         "value": round(value, 1),
-        "unit": "samples/sec",
+        "unit": unit,
         "vs_baseline": None,
     }))
 

@@ -1,0 +1,164 @@
+"""Transformer / BERT model family — north-star workloads 3 & 4
+(BASELINE.md: BERT-Large pretrain, Transformer-big WMT).
+
+The reference repo itself carries no transformer (2018-era; BERT lived
+in GluonNLP downstream) — this supplies the same capability class,
+built on the framework's fused kernels: ``flash_attention`` for the
+attention core and the Pallas fused ``LayerNorm``.  Everything is
+HybridBlocks, so a full encoder stack hybridizes into one XLA program;
+``mxtpu.parallel.build_train_step`` adds dp/tp sharding and bf16.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder", "BERTModel",
+           "bert_base", "bert_large", "transformer_encoder"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention over (N, T, C) via the fused attention op."""
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by "
+                             f"num_heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True)
+        self.proj = nn.Dense(units, flatten=False, use_bias=True)
+        self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        u, h = self._units, self._heads
+        qkv = self.qkv(x)
+
+        def split_heads(t):
+            # (N, T, u) -> (N, h, T, u/h)
+            t = F.reshape(t, shape=(0, -1, h, u // h))
+            return F.transpose(t, axes=(0, 2, 1, 3))
+
+        q = split_heads(F.slice_axis(qkv, axis=-1, begin=0, end=u))
+        k = split_heads(F.slice_axis(qkv, axis=-1, begin=u, end=2 * u))
+        v = split_heads(F.slice_axis(qkv, axis=-1, begin=2 * u,
+                                     end=3 * u))
+        out = F.flash_attention(q, k, v, causal=self._causal)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(0, -1, u))
+        out = self.proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """Dense → gelu → Dense (the transformer MLP)."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.ffn1 = nn.Dense(hidden_size, flatten=False)
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn2(F.LeakyReLU(self.ffn1(x), act_type="gelu"))
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN encoder layer (BERT convention): LN(x + attn),
+    LN(x + ffn)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 causal=False, **kwargs):
+        super().__init__(**kwargs)
+        self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                       causal)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln1 = nn.LayerNorm()
+        self.ln2 = nn.LayerNorm()
+
+    def hybrid_forward(self, F, x):
+        x = self.ln1(x + self.attn(x))
+        x = self.ln2(x + self.ffn(x))
+        return x
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder cells."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, causal=False, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for i in range(num_layers):
+            self.layers.add(TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout, causal))
+
+    def hybrid_forward(self, F, x):
+        return self.layers(x)
+
+
+class BERTModel(HybridBlock):
+    """BERT-style encoder LM: token + position (+ type) embeddings,
+    encoder stack, MLM head over tied-or-separate projection."""
+
+    def __init__(self, vocab_size, units, hidden_size, num_layers,
+                 num_heads, max_length=512, dropout=0.1,
+                 use_token_type=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = self.params.get(
+            "pos_embed", shape=(max_length, units), init="normal")
+        self.type_embed = nn.Embedding(2, units) \
+            if use_token_type else None
+        self.embed_ln = nn.LayerNorm()
+        self.embed_drop = nn.Dropout(dropout) if dropout else None
+        self.encoder = TransformerEncoder(num_layers, units,
+                                          hidden_size, num_heads,
+                                          dropout)
+        self.mlm = nn.Dense(vocab_size, flatten=False)
+
+    def hybrid_forward(self, F, tokens, token_types=None,
+                       pos_embed=None):
+        T = tokens.shape[1] if hasattr(tokens, "shape") else None
+        x = self.word_embed(tokens)
+        pe = F.slice_axis(pos_embed, axis=0, begin=0, end=T)
+        x = x + F.expand_dims(pe, axis=0)
+        if self.type_embed is not None and token_types is not None:
+            x = x + self.type_embed(token_types)
+        x = self.embed_ln(x)
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        x = self.encoder(x)
+        return self.mlm(x)
+
+
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1):
+    """BERT-Base: 12 layers, 768 units, 12 heads."""
+    return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout)
+
+
+def bert_large(vocab_size=30522, max_length=512, dropout=0.1):
+    """BERT-Large: 24 layers, 1024 units, 16 heads — north-star
+    workload 3."""
+    return BERTModel(vocab_size, 1024, 4096, 24, 16, max_length,
+                     dropout)
+
+
+def transformer_encoder(num_layers=6, units=512, hidden_size=2048,
+                        num_heads=8, dropout=0.1, causal=False):
+    """Transformer-base encoder stack (WMT-style config 4)."""
+    return TransformerEncoder(num_layers, units, hidden_size, num_heads,
+                              dropout, causal)

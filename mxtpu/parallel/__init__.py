@@ -140,7 +140,7 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", batch_axis: int = 0,
                  param_spec_fn: Optional[Callable] = None, donate=True,
-                 compute_dtype=None):
+                 compute_dtype=None, cast_batch=True):
         from ..gluon.block import _traced_forward
         self._traced_forward = _traced_forward
         self.net = net
@@ -154,9 +154,15 @@ class TrainStep:
         # mixed precision: forward/backward in compute_dtype (bf16 puts
         # the matmuls/convs on the MXU's fast path), master weights,
         # loss, and optimizer state stay f32 — the reference's
-        # multi_precision=True AMP recipe, compiled into the one program
+        # multi_precision=True AMP recipe, compiled into the one program.
+        # cast_batch=False keeps the raw batch dtype — REQUIRED when x
+        # carries integer ids in a float array (Embedding inputs):
+        # bf16 can't represent ids > 256 exactly, so casting would
+        # silently fetch wrong rows; the bf16 embedding table already
+        # makes everything downstream compute in bf16.
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
+        self.cast_batch = cast_batch
         self._compiled = {}
         self._params: Optional[List] = None
         self._t = 0
@@ -209,6 +215,7 @@ class TrainStep:
         aux_box: Dict[str, Any] = {}
 
         compute_dtype = self.compute_dtype
+        cast_batch = self.cast_batch
 
         def loss_flat(train_vals, frozen_vals, key_data, x, y):
             pvals: List[Any] = [None] * n_param
@@ -226,7 +233,7 @@ class TrainStep:
                          and jnp.issubdtype(v.dtype, jnp.floating)
                          else v
                          for i, v in enumerate(pvals)]
-                if jnp.issubdtype(x.dtype, jnp.floating):
+                if cast_batch and jnp.issubdtype(x.dtype, jnp.floating):
                     x = x.astype(compute_dtype)
             raw_outs, _, aux_params, raw_aux = traced_forward(
                 net, params, pvals, [NDArray(x, None, _placed=True)],
@@ -341,8 +348,8 @@ class TrainStep:
 def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      mesh: Optional[Mesh] = None, dp_axis: str = "dp",
                      batch_axis: int = 0, param_spec_fn=None,
-                     donate: bool = True,
-                     compute_dtype=None) -> TrainStep:
+                     donate: bool = True, compute_dtype=None,
+                     cast_batch: bool = True) -> TrainStep:
     """Compile net+loss+optimizer into a single SPMD train step.
 
     ``mesh=None`` → single-device executable (still one fused program).
@@ -353,4 +360,5 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
         optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
     return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
-                     donate=donate, compute_dtype=compute_dtype)
+                     donate=donate, compute_dtype=compute_dtype,
+                     cast_batch=cast_batch)
