@@ -1,0 +1,101 @@
+"""Legacy model API (reference ``python/mxnet/model.py``†):
+checkpoint save/load in the ``prefix-symbol.json`` +
+``prefix-%04d.params`` convention, plus the pre-Module ``FeedForward``
+facade delegating to ``mxtpu.module.Module``."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .base import MXNetError
+from . import ndarray as nd_mod
+from .ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "FeedForward"]
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict) -> None:
+    """Write ``prefix-symbol.json`` + ``prefix-{epoch:04d}.params``
+    (reference ``save_checkpoint``†)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    arrays = {f"arg:{k}": v for k, v in arg_params.items()}
+    arrays.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd_mod.save(f"{prefix}-{epoch:04d}.params", arrays)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Load (symbol, arg_params, aux_params) (reference
+    ``load_checkpoint``†)."""
+    from . import symbol as sym_mod
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    loaded = nd_mod.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tag, name = k.split(":", 1)
+        if tag == "arg":
+            arg_params[name] = v
+        elif tag == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Deprecated pre-Module trainer (reference ``FeedForward``†) —
+    a thin facade over ``mxtpu.module.Module`` kept for API parity."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 optimizer="sgd", initializer="uniform",
+                 arg_params=None, aux_params=None, begin_epoch=0,
+                 **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs
+        self._mod = None
+
+    def _module(self, data_names=("data",),
+                label_names=("softmax_label",)):
+        from .module import Module
+        if self._mod is None:
+            self._mod = Module(self.symbol, data_names=data_names,
+                               label_names=label_names, context=self.ctx)
+        return self._mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            batch_end_callback=None, epoch_end_callback=None,
+            logger=None, **kwargs):
+        mod = self._module()
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                optimizer=self.optimizer,
+                optimizer_params=self.kwargs.get("optimizer_params",
+                                                 {}),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch or 1,
+                batch_end_callback=batch_end_callback,
+                epoch_end_callback=epoch_end_callback)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None):
+        mod = self._module()
+        return mod.predict(X, num_batch=num_batch)
+
+    def save(self, prefix: str, epoch: Optional[int] = None) -> None:
+        epoch = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix: str, epoch: int, **kwargs) -> "FeedForward":
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)

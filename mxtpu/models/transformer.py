@@ -132,7 +132,16 @@ class BERTModel(HybridBlock):
 
     def hybrid_forward(self, F, tokens, token_types=None,
                        pos_embed=None):
-        T = tokens.shape[1] if hasattr(tokens, "shape") else None
+        if hasattr(tokens, "shape"):
+            T = tokens.shape[1]
+        else:
+            # symbolic composition: Symbol carries no static shape —
+            # honour a __shape__ attr if the var declares one, else the
+            # graph is built for T == max_length
+            shp = tokens.attr("__shape__") if hasattr(tokens, "attr") \
+                else None
+            T = int(str(shp).strip("()[] ").split(",")[1]) \
+                if shp else None
         x = self.word_embed(tokens)
         pe = F.slice_axis(pos_embed, axis=0, begin=0, end=T)
         x = x + F.expand_dims(pe, axis=0)

@@ -1,0 +1,7 @@
+"""Module system — the legacy symbolic trainer
+(reference ``python/mxnet/module/``†)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -1,0 +1,164 @@
+"""BucketingModule — per-bucket executors sharing parameters
+(reference ``python/mxnet/module/bucketing_module.py``†; the
+reference's answer to variable-length sequences, SURVEY §5.7).
+
+TPU-native note: each bucket is a distinct static shape → a distinct
+XLA executable; the module keeps one Module per bucket with shared
+parameter arrays, exactly mirroring the per-bucket executors sharing
+memory upstream.  Keep the bucket count small (compile cost per
+bucket).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    """sym_gen(bucket_key) -> (symbol, data_names, label_names)
+    (reference ``BucketingModule``†)."""
+
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=None, context=None, fixed_param_names=None):
+        import logging
+        super().__init__(logger or logging)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key required")
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._context = context
+        self._fixed = fixed_param_names
+        self._buckets: Dict = {}
+        self._curr_mod: Optional[Module] = None
+        self._curr_key = None
+        self._init_args = None
+        self._opt_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_mod.symbol if self._curr_mod else \
+            self._sym_gen(self._default_key)[0]
+
+    def _get_module(self, bucket_key, data_shapes, label_shapes,
+                    for_training=True):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(sym, data_names=data_names,
+                         label_names=label_names, logger=self.logger,
+                         context=self._context,
+                         fixed_param_names=self._fixed)
+            mod.bind(data_shapes, label_shapes,
+                     for_training=for_training)
+            if self._curr_mod is not None and \
+                    self._curr_mod.params_initialized:
+                # share parameters with the default bucket: same
+                # NDArray objects → one set of weights
+                default = self._buckets[self._default_key]
+                for name in mod._param_names:
+                    if name in default._exec.arg_dict:
+                        mod._exec.arg_dict[name] = \
+                            default._exec.arg_dict[name]
+                        if name in default._exec.grad_dict:
+                            mod._exec.grad_dict[name] = \
+                                default._exec.grad_dict[name]
+                for name in mod._aux_names:
+                    if name in default._exec.aux_dict:
+                        mod._exec.aux_dict[name] = \
+                            default._exec.aux_dict[name]
+                mod.params_initialized = True
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
+        self._curr_mod = self._get_module(self._default_key,
+                                          data_shapes, label_shapes,
+                                          for_training)
+        self._curr_key = self._default_key
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes,
+                      label_shapes=None):
+        """Activate the module for a bucket (reference†)."""
+        assert self.binded
+        mod = self._get_module(bucket_key, data_shapes, label_shapes,
+                               self.for_training)
+        if not mod.params_initialized and self.params_initialized:
+            default = self._buckets[self._default_key]
+            for name in mod._param_names:
+                mod._exec.arg_dict[name] = default._exec.arg_dict[name]
+                if name in default._exec.grad_dict:
+                    mod._exec.grad_dict[name] = \
+                        default._exec.grad_dict[name]
+            for name in mod._aux_names:
+                mod._exec.aux_dict[name] = default._exec.aux_dict[name]
+            mod.params_initialized = True
+        self._curr_mod = mod
+        self._curr_key = bucket_key
+
+    def init_params(self, **kwargs):
+        assert self.binded
+        self._buckets[self._default_key].init_params(**kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_key].get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        self._opt_args = (kvstore, optimizer, optimizer_params)
+        default = self._buckets[self._default_key]
+        default.init_optimizer(kvstore, optimizer, optimizer_params,
+                               force_init)
+        # ONE updater (and thus one momentum/state set) shared across
+        # buckets — weights are shared, so states must be too
+        for mod in self._buckets.values():
+            if mod is not default:
+                self._share_optimizer(mod)
+        self.optimizer_initialized = True
+
+    def _share_optimizer(self, mod):
+        default = self._buckets[self._default_key]
+        mod._optimizer = default._optimizer
+        mod._updater = default._updater
+        mod.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        if key != self._curr_key or key not in self._buckets:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+            if self.optimizer_initialized and \
+                    not self._curr_mod.optimizer_initialized:
+                self._share_optimizer(self._curr_mod)
+        self._curr_mod.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_mod.backward(out_grads)
+
+    def update(self):
+        self._curr_mod.update()
+        # weights live in shared arrays; nothing else to sync
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_mod.get_outputs()
+
+    def get_input_grads(self):
+        return self._curr_mod.get_input_grads()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_mod.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        for mod in self._buckets.values():
+            mod.install_monitor(monitor)

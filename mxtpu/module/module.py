@@ -1,0 +1,249 @@
+"""Module — symbol + executor trainer
+(reference ``python/mxnet/module/module.py``†).
+
+TPU-native note: the reference's ``DataParallelExecutorGroup`` slices
+each batch over per-device executors and all-reduces through KVStore;
+here one executor evaluates the graph and multi-device execution is the
+compiled SPMD path (``mxtpu.parallel``) — Module keeps the legacy API
+surface on top of the same engine.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import initializer as init_mod
+from .. import ndarray as nd_mod
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """Single-symbol trainer (reference ``Module``†)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None,
+                 context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None):
+        import logging
+        super().__init__(logger or logging)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        outs = [o.shape for o in self._exec.outputs] if self._exec and \
+            self._exec._outputs else None
+        return outs
+
+    # -- bind -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        norm = []
+        for d in data_shapes:
+            if isinstance(d, tuple) and not hasattr(d, "name"):
+                from ..io import DataDesc
+                d = DataDesc(d[0], d[1])
+            norm.append(d)
+        self._data_shapes = norm
+        norm_l = []
+        for d in (label_shapes or []):
+            if isinstance(d, tuple) and not hasattr(d, "name"):
+                from ..io import DataDesc
+                d = DataDesc(d[0], d[1])
+            norm_l.append(d)
+        self._label_shapes = norm_l
+
+        shapes = {d.name: d.shape for d in norm}
+        shapes.update({d.name: d.shape for d in norm_l})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        arg_names = self._symbol.list_arguments()
+        self._arg_shape = dict(zip(arg_names, arg_shapes))
+        self._aux_shape = dict(zip(self._aux_names, aux_shapes))
+        for n, s in self._arg_shape.items():
+            if s is None:
+                raise MXNetError(f"cannot infer shape of {n}")
+
+        args = {n: nd_mod.zeros(s) for n, s in self._arg_shape.items()}
+        aux = {n: nd_mod.zeros(s) for n, s in self._aux_shape.items()}
+        req = {}
+        for n in arg_names:
+            if n in self._data_names:
+                req[n] = "write" if inputs_need_grad else "null"
+            elif n in self._label_names or \
+                    n in self._fixed_param_names:
+                req[n] = "null"
+            else:
+                req[n] = grad_req if for_training else "null"
+        self._exec = self._symbol.bind(ctx=self._context, args=args,
+                                       grad_req=req, aux_states=aux)
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+    # -- params ---------------------------------------------------------
+    def init_params(self, initializer="uniform", arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        assert self.binded, "bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        init = init_mod.create(initializer) \
+            if not isinstance(initializer, init_mod.Initializer) \
+            else initializer
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data \
+                    if isinstance(arg_params[name], NDArray) \
+                    else nd_mod.array(arg_params[name])._data
+            elif allow_missing and arg_params is not None:
+                pass
+            else:
+                init(init_mod.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name]._data
+            else:
+                init(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy()
+               for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy()
+               for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # -- optimizer ------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if not isinstance(optimizer, opt_mod.Optimizer):
+            optimizer = opt_mod.create(optimizer,
+                                       **(optimizer_params or {}))
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        optimizer.idx2name = idx2name
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- execution ------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        is_train = self.for_training if is_train is None else is_train
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step from accumulated grads
+        (reference ``update``† via kvstore+updater)."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            self._updater(i, grad, weight)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, monitor):
+        monitor.install(self._exec)
+
+    # -- persistence ----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch,
+                        save_optimizer_states=False):
+        from .. import model
+        arg, aux = self.get_params()
+        model.save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from .. import model
+        sym, arg, aux = model.load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        mod._preload_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        # params applied at bind+init time
+        orig_init = mod.init_params
+
+        def init_with_loaded(initializer="uniform", arg_params=None,
+                             aux_params=None, **kw):
+            orig_init(initializer=initializer,
+                      arg_params=arg_params or arg,
+                      aux_params=aux_params or aux, **kw)
+        mod.init_params = init_with_loaded
+        return mod

@@ -28,10 +28,26 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "random", "sparse", "linalg", "contrib"]
 
 
+_prof = None  # lazily bound profiler module (circular import at load)
+
+
 def _invoke_op(name: str, *inputs, **kwargs):
     """Eager dispatch — the role of ``MXImperativeInvokeEx``
     (``src/c_api/c_api_ndarray.cc``† → ``Imperative::Invoke``†).
     jax's dispatch cache plays the part of the engine's async push."""
+    global _prof
+    if _prof is None:
+        from .. import profiler as _prof_mod
+        _prof = _prof_mod
+    if _prof._ACTIVE:
+        t0 = _prof._now_us()
+        out = _invoke_op_inner(name, *inputs, **kwargs)
+        _prof.record_op(name, t0, _prof._now_us() - t0)
+        return out
+    return _invoke_op_inner(name, *inputs, **kwargs)
+
+
+def _invoke_op_inner(name: str, *inputs, **kwargs):
     op = get_op(name)
     arrays = []
     ctx = None

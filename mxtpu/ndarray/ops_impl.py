@@ -869,6 +869,45 @@ register_op("softmax_cross_entropy", num_inputs=2)(
         jax.nn.log_softmax(data, axis=-1) *
         jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1])))
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_core(data, label, grad_scale, ignore_label,
+                         use_ignore, normalization):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore,
+            normalization):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label)
+
+
+def _so_bwd(grad_scale, ignore_label, use_ignore, normalization, res,
+            g):
+    # Reference semantics (src/operator/softmax_output-inl.h†): the op
+    # IS the cross-entropy loss head — backward emits
+    # grad_scale * (softmax - onehot(label)) and ignores incoming
+    # cotangents (the reference's Backward does the same).
+    out, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                            dtype=out.dtype)
+    grad = (out - onehot) * grad_scale
+    valid = None
+    if use_ignore:
+        keep = (label != ignore_label)
+        grad = grad * keep[..., None].astype(grad.dtype)
+        valid = jnp.maximum(jnp.sum(keep), 1)
+    if normalization == "valid":
+        n = valid if valid is not None else \
+            jnp.asarray(label.size, grad.dtype)
+        grad = grad / n
+    elif normalization == "batch":
+        grad = grad / label.shape[0]
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output_core.defvjp(_so_fwd, _so_bwd)
+
+
 register_op("SoftmaxOutput", num_inputs=2,
             params=[Param("grad_scale", float, 1.0),
                     Param("ignore_label", float, -1.0),
@@ -877,7 +916,18 @@ register_op("SoftmaxOutput", num_inputs=2,
                     Param("preserve_shape", bool, False),
                     Param("normalization", str, "null")],
             aliases=("Softmax",))(
-    lambda data, label, **kw: jax.nn.softmax(data, axis=-1))
+    lambda data, label, grad_scale=1.0, ignore_label=-1.0,
+    use_ignore=False, multi_output=False, preserve_shape=False,
+    normalization="null": _softmax_output_core(
+        data, label, grad_scale, ignore_label, use_ignore,
+        normalization) if not multi_output else _raise(
+        MXNetError("SoftmaxOutput multi_output=True (softmax over axis "
+                   "1) is not implemented yet — reshape to (N*d, C) "
+                   "and use the default mode")))
+
+
+def _raise(err):
+    raise err
 
 
 def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5):

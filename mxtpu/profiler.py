@@ -1,0 +1,237 @@
+"""Profiler (reference ``python/mxnet/profiler.py`` +
+``src/profiler/profiler.cc``†): op/scope-level tracing with
+chrome://tracing JSON output and per-op aggregate tables.
+
+TPU-native notes: host-side dispatch timing comes from hooking the
+eager ``_invoke_op`` path (the analogue of the engine instrumenting
+every pushed operation); device-side detail can additionally be
+captured with ``jax.profiler`` (xplane/tensorboard) via
+``start_jax_trace``/``stop_jax_trace`` — the host trace stays in the
+reference's chrome-trace format so existing tooling works.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Task", "Frame", "Event", "Counter", "Marker",
+           "start_jax_trace", "stop_jax_trace"]
+
+_ACTIVE = False          # fast-path flag read by the op dispatcher
+_PAUSED = False
+_LOCK = threading.Lock()
+_EVENTS: List[dict] = []
+_CONFIG = {"filename": "profile.json", "aggregate_stats": False,
+           "profile_imperative": True, "profile_api": True,
+           "profile_memory": False, "profile_all": False}
+_START_TS: Optional[float] = None
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def set_config(**kwargs):
+    """Configure (reference ``set_config``†).  Recognized keys:
+    filename, aggregate_stats, profile_all, profile_symbolic,
+    profile_imperative, profile_memory, profile_api."""
+    for k, v in kwargs.items():
+        _CONFIG[k] = v
+
+
+def set_state(state_: str = "stop"):
+    """'run' or 'stop' (reference ``set_state``†)."""
+    global _ACTIVE, _START_TS
+    if state_ not in ("run", "stop"):
+        raise MXNetError("state must be 'run' or 'stop'")
+    if state_ == "run":
+        if _START_TS is None:
+            _START_TS = _now_us()
+        _ACTIVE = True
+    else:
+        _ACTIVE = False
+
+
+def state() -> str:
+    return "run" if _ACTIVE else "stop"
+
+
+def pause():
+    """Temporarily stop collection (reference ``pause``†)."""
+    global _ACTIVE, _PAUSED
+    if _ACTIVE:
+        _ACTIVE, _PAUSED = False, True
+
+
+def resume():
+    global _ACTIVE, _PAUSED
+    if _PAUSED:
+        _ACTIVE, _PAUSED = True, False
+
+
+def _record(name: str, cat: str, ts_us: float, dur_us: float,
+            args: Optional[dict] = None):
+    if not _ACTIVE:
+        return
+    ev = {"name": name, "cat": cat, "ph": "X",
+          "ts": ts_us - (_START_TS or 0.0), "dur": dur_us,
+          "pid": os.getpid(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    with _LOCK:
+        _EVENTS.append(ev)
+
+
+def record_op(name: str, ts_us: float, dur_us: float,
+              shapes=None) -> None:
+    """Called by the eager dispatcher per op when profiling."""
+    _record(name, "operator", ts_us, dur_us,
+            {"shapes": shapes} if shapes else None)
+
+
+def dumps(reset: bool = False) -> str:
+    """Chrome-trace JSON string (reference ``dumps``† returns the
+    aggregate table; here the trace itself, plus the aggregate table
+    via ``aggregate_stats()``)."""
+    with _LOCK:
+        out = json.dumps({"traceEvents": list(_EVENTS),
+                          "displayTimeUnit": "ms"})
+        if reset:
+            _EVENTS.clear()
+    return out
+
+
+def dump(finished: bool = True, profile_process: str = "worker"):
+    """Write the chrome trace to ``filename`` (reference ``dump``†)."""
+    path = _CONFIG["filename"]
+    with open(path, "w") as f:
+        f.write(dumps())
+    return path
+
+
+def aggregate_stats() -> str:
+    """Per-op-name summary table (reference ``aggregate_stats.cc``†)."""
+    with _LOCK:
+        agg: Dict[str, List[float]] = defaultdict(list)
+        for ev in _EVENTS:
+            if "dur" in ev:  # complete events only (not markers/counters)
+                agg[ev["name"]].append(ev["dur"])
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
+             f"{'Min(us)':>12}{'Max(us)':>12}{'Mean(us)':>12}"]
+    for name, durs in sorted(agg.items(),
+                             key=lambda kv: -sum(kv[1])):
+        lines.append(f"{name:<40}{len(durs):>8}{sum(durs):>14.1f}"
+                     f"{min(durs):>12.1f}{max(durs):>12.1f}"
+                     f"{sum(durs) / len(durs):>12.1f}")
+    return "\n".join(lines)
+
+
+class _Scope:
+    """Base for profiling scopes (Task/Frame/Event; reference
+    ``ProfileTask``† etc.)."""
+
+    _cat = "scope"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def start(self):
+        self._t0 = _now_us()
+
+    def stop(self):
+        if self._t0 is not None:
+            _record(self.name, self._cat, self._t0,
+                    _now_us() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scope):
+    _cat = "task"
+
+
+class Frame(_Scope):
+    _cat = "frame"
+
+
+class Event(_Scope):
+    _cat = "event"
+
+
+class Marker:
+    """Instant marker (reference ``ProfileMarker``†)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def mark(self, scope: str = "process"):
+        if _ACTIVE:
+            with _LOCK:
+                _EVENTS.append({
+                    "name": self.name, "cat": "marker", "ph": "i",
+                    "ts": _now_us() - (_START_TS or 0.0),
+                    "pid": os.getpid(), "tid": threading.get_ident(),
+                    "s": "p" if scope == "process" else "t"})
+
+
+class Counter:
+    """Named counter series (reference ``ProfileCounter``†)."""
+
+    def __init__(self, name: str, value: int = 0):
+        self.name = name
+        self.value = value
+        self._emit()
+
+    def _emit(self):
+        if _ACTIVE:
+            with _LOCK:
+                _EVENTS.append({
+                    "name": self.name, "cat": "counter", "ph": "C",
+                    "ts": _now_us() - (_START_TS or 0.0),
+                    "pid": os.getpid(),
+                    "args": {"value": self.value}})
+
+    def set_value(self, value: int):
+        self.value = value
+        self._emit()
+
+    def increment(self, delta: int = 1):
+        self.value += delta
+        self._emit()
+
+    def decrement(self, delta: int = 1):
+        self.value -= delta
+        self._emit()
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+def start_jax_trace(logdir: str):
+    """Device-side xplane capture (tensorboard format)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_jax_trace():
+    import jax
+    jax.profiler.stop_trace()

@@ -1,0 +1,111 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``†):
+``print_summary`` parameter/shape table and a graphviz ``plot_network``
+(dot source; rendering needs the optional graphviz binary)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape: Optional[Dict] = None,
+                  line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-by-layer summary table with parameter counts
+    (reference ``print_summary``†)."""
+    if shape is None:
+        raise MXNetError("print_summary requires input shapes")
+    # partial: label vars etc. may be unbound in a summary context
+    arg_shapes, out_shapes, aux_shapes = \
+        symbol.infer_shape_partial(**shape)
+    arg_names = symbol.list_arguments()
+    shape_of = dict(zip(arg_names, arg_shapes))
+    aux_of = dict(zip(symbol.list_auxiliary_states(), aux_shapes))
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    positions = [int(line_length * p) for p in positions]
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #",
+               "Previous Layer"], positions)
+    print("=" * line_length)
+
+    total_params = 0
+    nodes = symbol._topo()
+    heads = {id(n) for n, _ in symbol._heads}
+    for node in nodes:
+        if node.op is None:
+            continue
+        inputs = [src.name for src, _ in node.inputs]
+        params = 0
+        for src, _ in node.inputs:
+            if src.op is None and src.name in shape_of \
+                    and src.name not in shape:
+                shp = shape_of[src.name]
+                if shp:
+                    n = 1
+                    for d in shp:
+                        n *= d
+                    params += n
+            if src.op is None and src.name in aux_of:
+                shp = aux_of[src.name]
+                if shp:
+                    n = 1
+                    for d in shp:
+                        n *= d
+                    params += n
+        total_params += params
+        out_shape = ""
+        first = inputs[0] if inputs else ""
+        print_row([f"{node.name} ({node.op})", out_shape, params, first],
+                  positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol (reference
+    ``plot_network``†).  Returns the Digraph; rendering to disk needs
+    the graphviz system binary."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the python graphviz package") from e
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title)
+    attrs = {"shape": "box", "fixedsize": "false"}
+    attrs.update(node_attrs)
+    hidden = set()
+    if hide_weights:
+        for node in symbol._topo():
+            if node.op is not None:
+                for src, _ in node.inputs:
+                    if src.op is None and (
+                            src.name.endswith(("weight", "bias", "gamma",
+                                               "beta", "mean", "var"))):
+                        hidden.add(id(src))
+    for node in symbol._topo():
+        if id(node) in hidden:
+            continue
+        label = node.name if node.op is None else \
+            f"{node.op}\n{node.name}"
+        dot.node(str(id(node)), label=label, **attrs)
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        for src, _ in node.inputs:
+            if id(src) in hidden:
+                continue
+            dot.edge(str(id(src)), str(id(node)))
+    return dot

@@ -1,0 +1,219 @@
+"""Module system + legacy model + callbacks/monitor/viz/profiler
+(reference ``test_module.py``†, ``test_profiler.py``†)."""
+import json
+import logging
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.io import DataBatch, NDArrayIter
+
+
+def _mlp_symbol(hidden=16, classes=3):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_iter(n=120, dim=6, classes=3, batch_size=20, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    y = (X[:, :classes].argmax(axis=1)).astype(np.float32)
+    return NDArrayIter(X, y, batch_size=batch_size, shuffle=True,
+                       last_batch_handle="discard",
+                       label_name="softmax_label")
+
+
+def test_softmax_output_grad_semantics():
+    """SoftmaxOutput backward = softmax - onehot (the implicit CE head
+    every legacy symbol relies on)."""
+    data = np.random.randn(4, 3).astype(np.float64)
+    label = np.array([0, 2, 1, 1], np.float64)
+    sym = _mlp_symbol()
+    # direct op-level check
+    x = nd.array(data)
+    x.attach_grad()
+    from mxtpu import autograd
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, nd.array(label))
+    out.backward()
+    p = np.exp(data) / np.exp(data).sum(1, keepdims=True)
+    onehot = np.eye(3)[label.astype(int)]
+    np.testing.assert_allclose(x.grad.asnumpy(), p - onehot, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_module_fit_converges():
+    """Module.fit on a separable toy problem reaches high accuracy
+    (reference tests/python/train/test_mlp†)."""
+    logging.disable(logging.CRITICAL)
+    try:
+        mod = mx.mod.Module(_mlp_symbol(), data_names=("data",),
+                            label_names=("softmax_label",))
+        train = _toy_iter()
+        mod.fit(train, num_epoch=12, optimizer="adam",
+                optimizer_params={"learning_rate": 0.05},
+                initializer="xavier", eval_metric="acc")
+        score = mod.score(_toy_iter(seed=1), "acc")
+        assert dict(score)["accuracy"] > 0.9, score
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+def test_module_predict_and_io():
+    mod = mx.mod.Module(_mlp_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    it = _toy_iter(n=40, batch_size=10)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer="xavier")
+    out = mod.predict(it)
+    assert out.shape == (40, 3)
+    probs = out.asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(40),
+                               rtol=1e-5)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "mlp")
+    mod = mx.mod.Module(_mlp_symbol())
+    it = _toy_iter(n=20, batch_size=10)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer="xavier")
+    mod.save_checkpoint(prefix, 3)
+
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in arg
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(data_shapes=it.provide_data,
+              label_shapes=it.provide_label)
+    mod2.init_params()
+    batch = next(it)
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_bucketing_module():
+    """Variable-length 'sequences' via bucketed symbols sharing
+    params."""
+    def sym_gen(seq_len):
+        # params are seq-length independent (pooled over time), the
+        # classic bucketing contract
+        data = mx.sym.var("data")  # (N, seq_len, dim)
+        pooled = mx.sym.mean(data, axis=1)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=4,
+                                   name="shared_fc")
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    from mxtpu.io import DataDesc
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[DataDesc("data", (4, 8, 5))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params(initializer="xavier")
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    def batch_for(seq_len):
+        b = DataBatch(
+            data=[nd.array(np.random.randn(4, seq_len, 5)
+                           .astype(np.float32))],
+            label=[nd.array(np.zeros(4, np.float32))])
+        b.bucket_key = seq_len
+        b.provide_data = [DataDesc("data", (4, seq_len, 5))]
+        b.provide_label = [DataDesc("softmax_label", (4,))]
+        return b
+
+    for seq_len in (8, 4, 8, 4):
+        mod.forward(batch_for(seq_len), is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {8, 4}
+    w8 = mod._buckets[8]._exec.arg_dict["shared_fc_weight"]
+    w4 = mod._buckets[4]._exec.arg_dict["shared_fc_weight"]
+    assert w8 is w4  # same array object → shared
+
+
+def test_callbacks_and_monitor():
+    from mxtpu import callback
+    from mxtpu.module.base_module import BatchEndParam
+    from mxtpu import metric as metric_mod
+    sp = callback.Speedometer(batch_size=32, frequent=2)
+    m = metric_mod.create("acc")
+    m.update([nd.array(np.array([0.0, 1.0]))],
+             [nd.array(np.array([[0.9, 0.1], [0.1, 0.9]]))])
+    for i in range(5):
+        sp(BatchEndParam(epoch=0, nbatch=i, eval_metric=m,
+                         locals=None))
+
+    from mxtpu.monitor import Monitor
+    sym = _mlp_symbol()
+    it = _toy_iter(n=20, batch_size=10)
+    mod = mx.mod.Module(sym)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer="xavier")
+    mon = Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(it), is_train=False)
+    stats = mon.toc()
+    assert len(stats) > 0
+
+
+def test_profiler_chrome_trace(tmp_path):
+    from mxtpu import profiler
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    profiler.set_state("run")
+    with profiler.Task("toy_task"):
+        a = nd.array(np.random.randn(8, 8).astype(np.float32))
+        b = nd.relu(a)
+        (b * 2).asnumpy()
+    c = profiler.Counter("my_counter", 0)
+    c.increment(5)
+    profiler.Marker("here").mark()
+    profiler.set_state("stop")
+    path = profiler.dump()
+    trace = json.load(open(path))
+    names = {ev["name"] for ev in trace["traceEvents"]}
+    assert "toy_task" in names
+    assert "relu" in names  # op-level event from the dispatcher
+    assert "my_counter" in names
+    table = profiler.aggregate_stats()
+    assert "relu" in table
+
+
+def test_print_summary(capsys):
+    from mxtpu import visualization
+    total = visualization.print_summary(_mlp_symbol(),
+                                        shape={"data": (1, 6)})
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    # fc1: 6*16+16, fc2: 16*3+3
+    assert total == 6 * 16 + 16 + 16 * 3 + 3
+
+
+def test_feedforward_facade(tmp_path):
+    logging.disable(logging.CRITICAL)
+    try:
+        ff = mx.model.FeedForward(_mlp_symbol(), num_epoch=3,
+                                  optimizer="adam",
+                                  optimizer_params={
+                                      "learning_rate": 0.05},
+                                  initializer="xavier")
+        ff.fit(_toy_iter())
+        pred = ff.predict(_toy_iter(seed=2, n=20, batch_size=10))
+        assert pred.shape == (20, 3)
+        ff.save(str(tmp_path / "ff"), 3)
+        ff2 = mx.model.FeedForward.load(str(tmp_path / "ff"), 3)
+        assert "fc1_weight" in ff2.arg_params
+    finally:
+        logging.disable(logging.NOTSET)
