@@ -1,0 +1,237 @@
+"""Image utilities (reference ``python/mxnet/image/image.py``†):
+decode/resize/crop/normalize helpers over HWC NDArrays + the
+python-side ``ImageIter``.
+
+Host-side decode uses cv2 (as upstream); resizes on device go through
+``jax.image.resize``.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "CenterCropAug", "CreateAugmenter", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode a jpeg/png byte buffer → HWC NDArray (reference
+    ``imdecode``† via OpenCV)."""
+    import cv2
+    img = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                       cv2.IMREAD_COLOR if flag else
+                       cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode failed")
+    if flag and to_rgb:
+        img = img[:, :, ::-1]
+    return array(np.ascontiguousarray(img))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file (reference ``imread``†)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src: NDArray, w: int, h: int, interp=1):
+    """Resize HWC (reference ``imresize``†)."""
+    import jax
+    raw = src.data.astype("float32")
+    squeeze = False
+    if raw.ndim == 2:
+        raw = raw[:, :, None]
+        squeeze = True
+    out = jax.image.resize(raw, (h, w, raw.shape[2]),
+                           method="bilinear" if interp else "nearest")
+    if src.dtype == np.uint8:
+        out = out.round().clip(0, 255).astype("uint8")
+    if squeeze:
+        out = out[:, :, 0]
+    return NDArray(out, None, _placed=True)
+
+
+def resize_short(src: NDArray, size: int, interp=1):
+    """Resize so the shorter edge is ``size`` (reference†)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src: NDArray, x0, y0, w, h, size=None, interp=1):
+    """Crop [y0:y0+h, x0:x0+w] then optionally resize (reference†)."""
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src: NDArray, size: Tuple[int, int], interp=1):
+    """Random crop to (w, h); returns (img, (x0, y0, w, h))
+    (reference†)."""
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    if w < new_w or h < new_h:
+        src = resize_short(src, max(new_w, new_h), interp)
+        h, w = src.shape[:2]
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def center_crop(src: NDArray, size: Tuple[int, int], interp=1):
+    """Center crop to (w, h) (reference†)."""
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    if w < new_w or h < new_h:
+        src = resize_short(src, max(new_w, new_h), interp)
+        h, w = src.shape[:2]
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src: NDArray, mean, std=None):
+    """(src - mean) / std (reference†)."""
+    out = src.astype("float32") - array(np.asarray(mean, np.float32))
+    if std is not None:
+        out = out / array(np.asarray(std, np.float32))
+    return out
+
+
+# -- augmenters (reference ``Augmenter`` family†) -----------------------
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False,
+                    rand_mirror=False, mean=None, std=None,
+                    inter_method=1, **_ignored):
+    """Standard augmenter pipeline builder (reference†)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Python-side image iterator over .rec or .lst inputs
+    (reference ``ImageIter``†) — thin veneer over io.ImageRecordIter
+    for the rec path."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imgidx=None, shuffle=False, aug_list=None,
+                 **kwargs):
+        if path_imgrec is None:
+            raise MXNetError("ImageIter needs path_imgrec (list-file "
+                             "mode: use gluon.data.ImageFolderDataset)")
+        from .io import ImageRecordIter
+        self._inner = ImageRecordIter(
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            data_shape=data_shape, batch_size=batch_size,
+            shuffle=shuffle, **kwargs)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    __next__ = next

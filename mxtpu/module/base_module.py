@@ -175,7 +175,7 @@ class BaseModule:
                     cb(epoch, self.symbol, arg_params, aux_params)
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
-                                 epoch=epoch + 1)
+                                 epoch=epoch)
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)

@@ -38,6 +38,7 @@ class BucketingModule(BaseModule):
         self._curr_key = None
         self._init_args = None
         self._opt_args = None
+        self._monitor = None
 
     @property
     def symbol(self):
@@ -56,23 +57,26 @@ class BucketingModule(BaseModule):
                      for_training=for_training)
             if self._curr_mod is not None and \
                     self._curr_mod.params_initialized:
-                # share parameters with the default bucket: same
-                # NDArray objects → one set of weights
-                default = self._buckets[self._default_key]
-                for name in mod._param_names:
-                    if name in default._exec.arg_dict:
-                        mod._exec.arg_dict[name] = \
-                            default._exec.arg_dict[name]
-                        if name in default._exec.grad_dict:
-                            mod._exec.grad_dict[name] = \
-                                default._exec.grad_dict[name]
-                for name in mod._aux_names:
-                    if name in default._exec.aux_dict:
-                        mod._exec.aux_dict[name] = \
-                            default._exec.aux_dict[name]
-                mod.params_initialized = True
+                self._share_params(mod)
+            if self._monitor is not None:
+                mod.install_monitor(self._monitor)
             self._buckets[bucket_key] = mod
         return self._buckets[bucket_key]
+
+    def _share_params(self, mod):
+        """Alias the default bucket's arrays into ``mod`` — one set of
+        weights/grads/aux across buckets."""
+        default = self._buckets[self._default_key]
+        for name in mod._param_names:
+            if name in default._exec.arg_dict:
+                mod._exec.arg_dict[name] = default._exec.arg_dict[name]
+                if name in default._exec.grad_dict:
+                    mod._exec.grad_dict[name] = \
+                        default._exec.grad_dict[name]
+        for name in mod._aux_names:
+            if name in default._exec.aux_dict:
+                mod._exec.aux_dict[name] = default._exec.aux_dict[name]
+        mod.params_initialized = True
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              force_rebind=False, **kwargs):
@@ -92,15 +96,7 @@ class BucketingModule(BaseModule):
         mod = self._get_module(bucket_key, data_shapes, label_shapes,
                                self.for_training)
         if not mod.params_initialized and self.params_initialized:
-            default = self._buckets[self._default_key]
-            for name in mod._param_names:
-                mod._exec.arg_dict[name] = default._exec.arg_dict[name]
-                if name in default._exec.grad_dict:
-                    mod._exec.grad_dict[name] = \
-                        default._exec.grad_dict[name]
-            for name in mod._aux_names:
-                mod._exec.aux_dict[name] = default._exec.aux_dict[name]
-            mod.params_initialized = True
+            self._share_params(mod)
         self._curr_mod = mod
         self._curr_key = bucket_key
 
@@ -160,5 +156,6 @@ class BucketingModule(BaseModule):
         self._curr_mod.update_metric(eval_metric, labels)
 
     def install_monitor(self, monitor):
+        self._monitor = monitor  # later buckets pick it up on creation
         for mod in self._buckets.values():
             mod.install_monitor(monitor)

@@ -138,9 +138,9 @@ class Module(BaseModule):
                 arr._data = arg_params[name]._data \
                     if isinstance(arg_params[name], NDArray) \
                     else nd_mod.array(arg_params[name])._data
-            elif allow_missing and arg_params is not None:
-                pass
             else:
+                # missing params run the initializer (reference
+                # semantics — allow_missing only waives the error)
                 init(init_mod.InitDesc(name), arr)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
@@ -177,6 +177,12 @@ class Module(BaseModule):
         optimizer.idx2name = idx2name
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        states_path = getattr(self, "_preload_states", None)
+        if states_path is not None:
+            with open(states_path, "rb") as f:
+                self._updater.set_states(f.read())
+            self._optimizer = self._updater.optimizer
+            self._preload_states = None
         self.optimizer_initialized = True
 
     # -- execution ------------------------------------------------------

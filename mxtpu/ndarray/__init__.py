@@ -19,6 +19,7 @@ from ..base import MXNetError
 from ..ops.registry import OP_REGISTRY, get_op, list_ops
 from . import ops_impl  # noqa: F401  (populates the registry)
 from . import rnn_impl  # noqa: F401  (fused RNN op)
+from . import detection_impl  # noqa: F401  (SSD/ROI/CTC/quantize ops)
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concat, stack, save, load, waitall, from_numpy,
                       linspace, eye, zeros_like as _zeros_like_fn)

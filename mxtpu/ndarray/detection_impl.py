@@ -1,0 +1,397 @@
+"""Detection, CTC, and quantization operators.
+
+Reference: ``src/operator/contrib/multibox_*.cc``† (SSD ops),
+``src/operator/roi_pooling.cc``†, ``src/operator/contrib/ctc_loss.cc``†,
+``src/operator/quantization/``†.
+
+TPU-native notes: everything keeps STATIC shapes (SURVEY §7 hard-part
+2) — NMS-style ops mark suppressed entries -1 instead of shrinking;
+ROIPooling evaluates each output bin as a masked max over the feature
+map (vectorized, no dynamic slices); CTC is a ``lax.scan`` over time in
+log space, differentiable by jax AD (no hand-written backward).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+
+_NEG = -1e30
+
+
+# ----------------------------------------------------------------------
+# ROIPooling
+# ----------------------------------------------------------------------
+
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """data (N,C,H,W); rois (R,5) = [batch_idx, x1, y1, x2, y2] in image
+    coords; output (R, C, ph, pw) (reference ``ROIPooling``†)."""
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]  # (C,H,W)
+
+        def one_bin(i, j):
+            hstart = jnp.floor(y1 + i * bin_h)
+            hend = jnp.ceil(y1 + (i + 1) * bin_h)
+            wstart = jnp.floor(x1 + j * bin_w)
+            wend = jnp.ceil(x1 + (j + 1) * bin_w)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, _NEG)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(mask), val, 0.0)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                              indexing="ij")
+        bins = jax.vmap(jax.vmap(one_bin))(ii, jj)  # (ph, pw, C)
+        return jnp.transpose(bins, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+register_op("ROIPooling", num_inputs=2,
+            params=[Param("pooled_size", tuple, (7, 7)),
+                    Param("spatial_scale", float, 1.0)])(_roi_pooling)
+
+
+# ----------------------------------------------------------------------
+# MultiBox (SSD) family
+# ----------------------------------------------------------------------
+
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5), clip=False):
+    """Anchor generation (reference ``MultiBoxPrior``†): (1, H*W*(S+R-1),
+    4) corner boxes, normalized coords."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchor (w,h) list: all sizes at ratio[0], then size[0] at other
+    # ratios — the reference's S+R-1 convention
+    whs = [(s, s) for s in sizes]
+    whs += [(sizes[0] * float(np.sqrt(r)), sizes[0] / float(np.sqrt(r)))
+            for r in ratios[1:]]
+    wh = jnp.asarray(whs, jnp.float32)  # (K, 2): (w, h)
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                    axis=-1).reshape(-1, 2)  # (H*W, 2) = (cy, cx)
+    cyx = jnp.repeat(cyx, wh.shape[0], axis=0)
+    whr = jnp.tile(wh, (H * W, 1))
+    boxes = jnp.stack([cyx[:, 1] - whr[:, 0] / 2,
+                       cyx[:, 0] - whr[:, 1] / 2,
+                       cyx[:, 1] + whr[:, 0] / 2,
+                       cyx[:, 0] + whr[:, 1] / 2], axis=1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes[None]
+
+
+register_op("MultiBoxPrior", num_inputs=1,
+            params=[Param("sizes", tuple, (1.0,)),
+                    Param("ratios", tuple, (1.0,)),
+                    Param("steps", tuple, (-1.0, -1.0)),
+                    Param("offsets", tuple, (0.5, 0.5)),
+                    Param("clip", bool, False)],
+            differentiable=False)(_multibox_prior)
+
+
+def _iou_corner(a, b):
+    """a (A,4), b (B,4) corner boxes → (A,B) IoU."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter,
+                               1e-12)
+
+
+def _encode(anchors, gt, variances):
+    """Corner anchors + matched gt corners → regression targets."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / variances[1]
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / variances[2]
+    th = jnp.log(gh / jnp.maximum(ah, 1e-12)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=1)
+
+
+def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor↔gt matching + target encoding (reference
+    ``MultiBoxTarget``†).  labels (N, O, 5) rows [cls, x1, y1, x2, y2],
+    cls = -1 padding.  Returns (box_target (N, A*4), box_mask (N, A*4),
+    cls_target (N, A)); cls_target 0 = background, gt class + 1
+    otherwise."""
+    anc = anchors[0]
+    A = anc.shape[0]
+    variances = jnp.asarray(variances, jnp.float32)
+
+    def one(lab):
+        valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anc, gt_boxes)  # (A, O)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)           # per-anchor
+        best_iou = jnp.max(iou, axis=1)
+        pos = best_iou > overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)       # (O,)
+        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros(A, jnp.int32).at[best_anchor].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        pos = pos | forced
+        matched = gt_boxes[gt_idx]
+        target = _encode(anc, matched, variances)
+        target = jnp.where(pos[:, None], target, 0.0)
+        mask = jnp.where(pos[:, None],
+                         jnp.ones_like(target), 0.0)
+        cls = jnp.where(pos, lab[gt_idx, 0] + 1.0, 0.0)
+        return target.reshape(-1), mask.reshape(-1), cls
+
+    bt, bm, ct = jax.vmap(one)(labels)
+    return bt, bm, ct
+
+
+register_op("MultiBoxTarget", num_inputs=3, num_outputs=3,
+            params=[Param("overlap_threshold", float, 0.5),
+                    Param("ignore_label", float, -1.0),
+                    Param("negative_mining_ratio", float, -1.0),
+                    Param("variances", tuple, (0.1, 0.1, 0.2, 0.2))],
+            differentiable=False)(_multibox_target)
+
+
+def _decode(anchors, loc, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[:, 0] * variances[0] * aw + acx
+    cy = loc[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[:, 2] * variances[2]) * aw
+    h = jnp.exp(loc[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=1)
+
+
+def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                        threshold=0.01, nms_threshold=0.5,
+                        force_suppress=False, nms_topk=-1,
+                        variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode + class-select + NMS (reference ``MultiBoxDetection``†).
+    cls_prob (N, C, A) incl. background class 0; output (N, A, 6) rows
+    [cls_id, score, x1, y1, x2, y2], suppressed rows -1."""
+    anc = anchors[0]
+    variances = jnp.asarray(variances, jnp.float32)
+
+    def one(probs, loc):
+        boxes = _decode(anc, loc.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        fg = probs[1:]                      # (C-1, A)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep_score = score > threshold
+        # NMS over kept boxes (class-aware unless force_suppress)
+        order = jnp.argsort(-score)
+        bs = boxes[order]
+        ss = jnp.where(keep_score[order], score[order], 0.0)
+        cs = cls_id[order]
+        iou = _iou_corner(bs, bs)
+        A = bs.shape[0]
+
+        def body(i, keep):
+            same_cls = (cs == cs[i]) | force_suppress
+            sup = (iou[i] > nms_threshold) & (jnp.arange(A) > i) & \
+                keep[i] & same_cls
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, A if nms_topk < 0 else min(nms_topk, A),
+                             body, ss > 0.0)
+        out = jnp.concatenate([cs[:, None], ss[:, None], bs], axis=1)
+        return jnp.where(keep[:, None], out, -jnp.ones_like(out))
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+register_op("MultiBoxDetection", num_inputs=3,
+            params=[Param("clip", bool, True),
+                    Param("threshold", float, 0.01),
+                    Param("nms_threshold", float, 0.5),
+                    Param("force_suppress", bool, False),
+                    Param("nms_topk", int, -1),
+                    Param("variances", tuple, (0.1, 0.1, 0.2, 0.2))],
+            differentiable=False)(_multibox_detection)
+
+
+# ----------------------------------------------------------------------
+# CTC loss
+# ----------------------------------------------------------------------
+
+def _ctc_loss(data, label, use_data_lengths=False,
+              use_label_lengths=False, blank_label="first"):
+    """CTC negative log likelihood (reference ``ctc_loss``†).
+    data (T, N, C) pre-softmax activations; label (N, L) with -1 (or 0
+    for blank_label='last' semantics) padding.  Blank index 0 for
+    'first' (labels are 1-based), C-1 for 'last' (labels 0-based).
+    Returns (N,) losses.  Differentiable through the scan.
+    """
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        # labels come 1-based; padding <= 0
+        valid = lab > 0
+        lab_idx = jnp.where(valid, lab, 1)
+    else:
+        valid = lab >= 0
+        lab_idx = jnp.where(valid, lab, 0)
+    label_len = jnp.sum(valid.astype(jnp.int32), axis=1)  # (N,)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank (2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab_idx)
+    ext_valid_len = 2 * label_len + 1
+
+    # alpha recursion in log space
+    idx_s = jnp.arange(S)
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    allow_skip = (idx_s[None, :] % 2 == 1) & ~same_as_prev2
+
+    def emit(t):
+        # (N, S) log prob of emitting ext symbol at time t
+        return jnp.take_along_axis(logp[t], ext, axis=1)
+
+    alpha0 = jnp.full((N, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0, emit(0)[:, 1], _NEG))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(allow_skip, prev2, _NEG)
+        stacked = jnp.stack([alpha, prev1, prev2])
+        m = jnp.max(stacked, axis=0)
+        tot = m + jnp.log(jnp.sum(jnp.exp(stacked - m), axis=0) + 1e-30)
+        alpha_new = tot + emit(t)
+        return alpha_new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # final: last blank or last label
+    last = ext_valid_len - 1
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-30)
+    return -ll
+
+
+register_op("ctc_loss", num_inputs=2,
+            params=[Param("use_data_lengths", bool, False),
+                    Param("use_label_lengths", bool, False),
+                    Param("blank_label", str, "first",
+                          enum=("first", "last"))],
+            aliases=("CTCLoss",))(_ctc_loss)
+
+
+# ----------------------------------------------------------------------
+# quantization family
+# ----------------------------------------------------------------------
+
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine quantization (reference ``quantize``†).  Returns
+    (quantized, min_range, max_range)."""
+    if out_type == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    elif out_type == "int8":
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    else:
+        raise MXNetError(f"unsupported out_type {out_type}")
+    lo = min_range.reshape(())
+    hi = max_range.reshape(())
+    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
+    q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
+    return q.astype(dt), lo, hi
+
+
+register_op("quantize", num_inputs=3, num_outputs=3,
+            params=[Param("out_type", str, "uint8",
+                          enum=("uint8", "int8"))],
+            differentiable=False)(_quantize)
+
+
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    lo = min_range.reshape(())
+    hi = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = jnp.maximum(hi - lo, 1e-12) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + lo
+
+
+register_op("dequantize", num_inputs=3,
+            params=[Param("out_type", str, "float32")],
+            differentiable=False)(_dequantize)
+
+
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """Calibrated quantization (reference ``_contrib_quantize_v2``†):
+    ranges from calibration params or data min/max."""
+    lo = jnp.asarray(min_calib_range if min_calib_range is not None
+                     else jnp.min(data), jnp.float32)
+    hi = jnp.asarray(max_calib_range if max_calib_range is not None
+                     else jnp.max(data), jnp.float32)
+    return _quantize(data, lo, hi, out_type=out_type)
+
+
+register_op("quantize_v2", num_inputs=1, num_outputs=3,
+            params=[Param("min_calib_range", float, None),
+                    Param("max_calib_range", float, None),
+                    Param("out_type", str, "int8",
+                          enum=("uint8", "int8"))],
+            aliases=("_contrib_quantize_v2",),
+            differentiable=False)(_quantize_v2)

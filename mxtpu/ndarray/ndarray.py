@@ -313,11 +313,16 @@ class NDArray:
             shape = tuple(shape[0])
         return _invoke_op("reshape", self, shape=shape)
 
-    def transpose(self, *axes):
+    def transpose(self, *axes_pos, axes=None):
         from . import _invoke_op
+        if axes_pos and axes is not None:
+            raise MXNetError("pass axes positionally or by keyword")
+        if axes is None:
+            axes = axes_pos
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
-        return _invoke_op("transpose", self, axes=axes if axes else None)
+        return _invoke_op("transpose", self,
+                          axes=tuple(axes) if axes else None)
 
     @property
     def T(self):

@@ -902,7 +902,12 @@ def _so_bwd(grad_scale, ignore_label, use_ignore, normalization, res,
         grad = grad / n
     elif normalization == "batch":
         grad = grad / label.shape[0]
-    return grad, jnp.zeros_like(label)
+    # integer labels need a float0 tangent per jax's custom_vjp contract
+    if jnp.issubdtype(label.dtype, jnp.floating):
+        label_ct = jnp.zeros_like(label)
+    else:
+        label_ct = np.zeros(label.shape, dtype=jax.dtypes.float0)
+    return grad, label_ct
 
 
 _softmax_output_core.defvjp(_so_fwd, _so_bwd)

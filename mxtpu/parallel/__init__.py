@@ -317,6 +317,44 @@ class TrainStep:
             p._data._data = v
         return NDArray(loss, None, _placed=True)
 
+    # -- checkpoint/resume (SURVEY §5.4: preemption-safe from day one) --
+    def save_states(self, fname: str) -> None:
+        """Serialize optimizer state + step counter.  Pair with
+        ``net.save_parameters`` for a full resumable checkpoint."""
+        import pickle
+        if self._params is None:
+            raise MXNetError("nothing to save: step never ran")
+        state_np = jax.tree_util.tree_map(np.asarray, self._opt_state)
+        with open(fname, "wb") as f:
+            pickle.dump({"t": self._t, "opt_state": state_np}, f)
+
+    def load_states(self, fname: str, x_example=None) -> None:
+        """Restore optimizer state; the step counter resumes bias
+        correction / schedules where they left off."""
+        import pickle
+        with open(fname, "rb") as f:
+            data = pickle.load(f)
+        if self._params is None:
+            if x_example is None:
+                raise MXNetError(
+                    "load_states before any step: pass x_example so "
+                    "parameter collection can run")
+            self._collect(x_example if isinstance(x_example, NDArray)
+                          else NDArray(jnp.asarray(x_example), None,
+                                       _placed=True))
+        self._t = data["t"]
+        loaded = jax.tree_util.tree_map(jnp.asarray, data["opt_state"])
+        cur = jax.tree_util.tree_structure(self._opt_state)
+        got = jax.tree_util.tree_structure(loaded)
+        if cur != got:
+            raise MXNetError(
+                f"optimizer state structure mismatch: {got} vs {cur}")
+        if self.mesh is not None:
+            loaded = jax.device_put(
+                loaded, jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, P()), loaded))
+        self._opt_state = loaded
+
     def _lrs_wds(self):
         """Per-parameter (lr, wd) vectors for this step — two traced
         array args (one transfer each), so scheduler/mult changes never

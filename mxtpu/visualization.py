@@ -39,28 +39,26 @@ def print_summary(symbol, shape: Optional[Dict] = None,
 
     total_params = 0
     nodes = symbol._topo()
-    heads = {id(n) for n, _ in symbol._heads}
+    counted = set()  # a shared (tied) weight counts once
     for node in nodes:
         if node.op is None:
             continue
         inputs = [src.name for src, _ in node.inputs]
         params = 0
         for src, _ in node.inputs:
-            if src.op is None and src.name in shape_of \
-                    and src.name not in shape:
+            if src.op is not None or id(src) in counted:
+                continue
+            shp = None
+            if src.name in shape_of and src.name not in shape:
                 shp = shape_of[src.name]
-                if shp:
-                    n = 1
-                    for d in shp:
-                        n *= d
-                    params += n
-            if src.op is None and src.name in aux_of:
+            elif src.name in aux_of:
                 shp = aux_of[src.name]
-                if shp:
-                    n = 1
-                    for d in shp:
-                        n *= d
-                    params += n
+            if shp:
+                counted.add(id(src))
+                n = 1
+                for d in shp:
+                    n *= d
+                params += n
         total_params += params
         out_shape = ""
         first = inputs[0] if inputs else ""

@@ -1,0 +1,120 @@
+"""Checkpoint/resume completeness (VERDICT item 10; reference
+``Trainer.save_states``†, ``Updater.get_states``†, SURVEY §5.4
+preemption-safe training)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn, loss as gloss
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    return net
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    return nd.array(X), nd.array(y)
+
+
+def _train_eager(net, trainer, steps, seed0=0):
+    L = gloss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for s in range(steps):
+        x, y = _data(seed0 + s)
+        with autograd.record():
+            l = L(net(x), y)
+        l.backward()
+        trainer.step(32)
+        losses.append(float(l.mean().asnumpy()))
+    return losses
+
+
+def test_trainer_save_load_states_resume(tmp_path):
+    """train A 10 steps, checkpoint, train A 5 more; B restores the
+    checkpoint and must reproduce A's last 5 steps exactly (adam state
+    incl. step counter must round-trip)."""
+    mx.random.seed(7)
+    np.random.seed(7)
+    net_a = _net()
+    tr_a = gluon.Trainer(net_a.collect_params(), "adam",
+                         {"learning_rate": 0.01})
+    _train_eager(net_a, tr_a, 10)
+    net_a.save_parameters(str(tmp_path / "net.params"))
+    tr_a.save_states(str(tmp_path / "trainer.states"))
+    cont_a = _train_eager(net_a, tr_a, 5, seed0=100)
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    net_b = _net()
+    # shapes must materialize before load_parameters
+    net_b(nd.array(np.zeros((1, 6), np.float32)))
+    net_b.load_parameters(str(tmp_path / "net.params"))
+    tr_b = gluon.Trainer(net_b.collect_params(), "adam",
+                         {"learning_rate": 0.01})
+    # prime the updater indices with one dummy zero-lr step?  No — the
+    # reference restores states cold; ours must too
+    tr_b.load_states(str(tmp_path / "trainer.states"))
+    cont_b = _train_eager(net_b, tr_b, 5, seed0=100)
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-6, atol=1e-7)
+    for (ka, pa), (kb, pb) in zip(
+            sorted(net_a.collect_params().items()),
+            sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6,
+                                   atol=1e-7, err_msg=ka)
+
+
+def test_trainstep_save_load_states_resume(tmp_path):
+    """Same resume contract for the compiled train step."""
+    from mxtpu import parallel
+    mx.random.seed(3)
+    net_a = _net()
+    step_a = parallel.build_train_step(
+        net_a, gloss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01})
+    for s in range(10):
+        x, y = _data(s)
+        step_a(x, y)
+    net_a.save_parameters(str(tmp_path / "net.params"))
+    step_a.save_states(str(tmp_path / "step.states"))
+    cont_a = [float(step_a(*_data(100 + s)).asscalar())
+              for s in range(5)]
+
+    mx.random.seed(3)
+    net_b = _net()
+    net_b(nd.array(np.zeros((1, 6), np.float32)))
+    net_b.load_parameters(str(tmp_path / "net.params"))
+    step_b = parallel.build_train_step(
+        net_b, gloss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01})
+    step_b.load_states(str(tmp_path / "step.states"),
+                       x_example=_data(0)[0])
+    cont_b = [float(step_b(*_data(100 + s)).asscalar())
+              for s in range(5)]
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-5, atol=1e-6)
+
+
+def test_updater_states_roundtrip():
+    from mxtpu import optimizer as opt_mod
+    opt = opt_mod.create("adam", learning_rate=0.1)
+    upd = opt_mod.get_updater(opt)
+    w = nd.array(np.ones((4,), np.float32))
+    g = nd.array(np.full((4,), 0.5, np.float32))
+    upd(0, g, w)
+    blob = upd.get_states(dump_optimizer=True)
+    upd2 = opt_mod.get_updater(opt_mod.create("adam",
+                                              learning_rate=0.9))
+    upd2.set_states(blob)
+    assert upd2.optimizer.learning_rate == 0.1  # optimizer restored
+    # state arrays equal
+    s1 = upd.states[0]
+    s2 = upd2.states[0]
+    for a, b in zip(s1, s2):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy())

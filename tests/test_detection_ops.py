@@ -1,0 +1,200 @@
+"""Detection / CTC / quantization ops (reference
+``test_operator.py::test_roipooling/test_ctc_loss``†,
+``tests/python/unittest/test_contrib_*``†)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_roi_pooling_values():
+    # 1x1x4x4 ramp; roi covering the whole image, 2x2 pool
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[5, 7], [13, 15]])
+
+
+def test_roi_pooling_batch_and_scale():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7], [1, 2, 2, 5, 5]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(3, 3))
+    assert out.shape == (2, 3, 3, 3)
+    # roi 0 covers image 0 entirely: global-ish max per bin >= mean
+    assert np.isfinite(out.asnumpy()).all()
+    # spatial_scale halves coordinates
+    out2 = nd.ROIPooling(nd.array(data),
+                         nd.array(np.array([[0, 0, 0, 14, 14]],
+                                           np.float32)),
+                         pooled_size=(2, 2), spatial_scale=0.5)
+    assert out2.shape == (1, 3, 2, 2)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 6))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # K = S + R - 1 = 3 anchors per position
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor of first cell: centered at (offset/W, offset/H)
+    cx, cy = (0.5 / 6), (0.5 / 4)
+    np.testing.assert_allclose(a[0], [cx - 0.25, cy - 0.25,
+                                      cx + 0.25, cy + 0.25], atol=1e-6)
+    # width/height of ratio-2 anchor: w = s*sqrt(2), h = s/sqrt(2)
+    w = a[2, 2] - a[2, 0]
+    h = a[2, 3] - a[2, 1]
+    np.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+
+
+def test_multibox_target_and_detection():
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.4, 0.4],
+          [0.5, 0.5, 1.0, 1.0],
+          [0.0, 0.6, 0.3, 1.0]]], np.float32))
+    # one gt box (class 1) overlapping anchor 1
+    labels = nd.array(np.array(
+        [[[1.0, 0.55, 0.55, 0.95, 0.95],
+          [-1.0, 0, 0, 0, 0]]], np.float32))
+    cls_preds = nd.zeros((1, 3, 3))  # (N, C, A)
+    bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+    assert bt.shape == (1, 12) and bm.shape == (1, 12)
+    ct_np = ct.asnumpy()[0]
+    assert ct_np[1] == 2.0  # gt class 1 → target 2 (bg=0 shift)
+    assert ct_np[0] == 0.0 and ct_np[2] == 0.0
+    mask = bm.asnumpy()[0].reshape(3, 4)
+    assert mask[1].sum() == 4 and mask[0].sum() == 0
+
+    # detection: probabilities put class 1 on anchor 1
+    cls_prob = np.zeros((1, 3, 3), np.float32)
+    cls_prob[0, 0] = [0.9, 0.1, 0.9]   # background
+    cls_prob[0, 1] = [0.05, 0.8, 0.05]
+    cls_prob[0, 2] = [0.05, 0.1, 0.05]
+    loc = np.zeros((1, 12), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc),
+                               anchors)
+    o = out.asnumpy()[0]
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) >= 1
+    best = kept[np.argmax(kept[:, 1])]
+    assert best[0] == 0.0  # class id 0 (= original class 1 - bg)
+    np.testing.assert_allclose(best[2:], [0.5, 0.5, 1.0, 1.0],
+                               atol=1e-5)
+
+
+def _np_ctc_ref(logits, labels, blank=0):
+    """Brute-force CTC by enumerating alignments (tiny T only)."""
+    from itertools import product
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    target = tuple(labels)
+    total = 0.0
+    for path in product(range(C), repeat=T):
+        if collapse(path) == target:
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(total)
+
+
+def test_ctc_loss_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, N, C = 4, 2, 4
+    logits = rng.randn(T, N, C).astype(np.float64)
+    # blank_label='first': labels are 1-based, 0 = padding
+    labels = np.array([[1, 2], [3, 0]], np.float64)
+    loss = nd.ctc_loss(nd.array(logits.astype(np.float32)),
+                       nd.array(labels.astype(np.float32)))
+    ref0 = _np_ctc_ref(logits[:, 0], [1, 2], blank=0)
+    ref1 = _np_ctc_ref(logits[:, 1], [3], blank=0)
+    np.testing.assert_allclose(loss.asnumpy(), [ref0, ref1], rtol=1e-4)
+
+
+def test_ctc_loss_differentiable():
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(5, 2, 4).astype(np.float32))
+    x.attach_grad()
+    labels = nd.array(np.array([[1, 2], [2, 0]], np.float32))
+    with autograd.record():
+        loss = nd.ctc_loss(x, labels)
+        total = loss.sum()
+    total.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-3, 5, (4, 5)).astype(np.float32)
+    lo = nd.array(np.array([-3.0], np.float32))
+    hi = nd.array(np.array([5.0], np.float32))
+    q, qlo, qhi = nd.quantize(nd.array(x), lo, hi, out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = nd.dequantize(q, qlo, qhi)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=(8.0 / 255) + 1e-6)
+
+    q2, l2, h2 = nd.quantize_v2(nd.array(x), out_type="int8")
+    assert q2.asnumpy().dtype == np.int8
+    back2 = nd.dequantize(q2, l2, h2)
+    np.testing.assert_allclose(back2.asnumpy(), x, atol=(8.0 / 254) + 1e-6)
+
+
+def test_detection_ops_symbolic():
+    """The new ops compose symbolically too."""
+    data = mx.sym.var("data")
+    rois = mx.sym.var("rois")
+    out = mx.sym.ROIPooling(data, rois, pooled_size=(2, 2))
+    res = out.eval(data=nd.array(np.arange(16, dtype=np.float32)
+                                 .reshape(1, 1, 4, 4)),
+                   rois=nd.array(np.array([[0, 0, 0, 3, 3]],
+                                          np.float32)))
+    assert res[0].shape == (1, 1, 2, 2)
+
+
+def test_image_module(tmp_path):
+    """mx.image helpers (reference test_image.py†)."""
+    import cv2
+    from mxtpu import image as img_mod
+    rng = np.random.RandomState(0)
+    raw = (rng.rand(20, 30, 3) * 255).astype(np.uint8)
+    path = str(tmp_path / "x.png")
+    cv2.imwrite(path, raw[:, :, ::-1])  # imwrite takes BGR
+    img = img_mod.imread(path)
+    np.testing.assert_array_equal(img.asnumpy(), raw)
+
+    small = img_mod.imresize(img, 15, 10)
+    assert small.shape == (10, 15, 3)
+    rs = img_mod.resize_short(img, 10)
+    assert min(rs.shape[:2]) == 10
+    crop, rect = img_mod.center_crop(img, (12, 8))
+    assert crop.shape == (8, 12, 3)
+    crop2, _ = img_mod.random_crop(img, (12, 8))
+    assert crop2.shape == (8, 12, 3)
+    norm = img_mod.color_normalize(img, mean=[100, 100, 100],
+                                   std=[50, 50, 50])
+    assert norm.asnumpy().dtype == np.float32
+
+    augs = img_mod.CreateAugmenter((3, 8, 8), rand_mirror=True,
+                                   mean=True, std=True)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (8, 8, 3)
+    assert out.asnumpy().dtype == np.float32

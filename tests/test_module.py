@@ -217,3 +217,70 @@ def test_feedforward_facade(tmp_path):
         assert "fc1_weight" in ff2.arg_params
     finally:
         logging.disable(logging.NOTSET)
+
+
+def test_softmax_output_int_labels():
+    """Integer label arrays flow through the custom VJP (float0
+    tangent; review regression)."""
+    from mxtpu import autograd
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    x.attach_grad()
+    label = nd.array(np.array([0, 2, 1, 1], np.int32))
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+
+
+def test_module_load_restores_optimizer_states(tmp_path):
+    """save_checkpoint(save_optimizer_states=True) → Module.load(...,
+    load_optimizer_states=True) restores momentum (review
+    regression)."""
+    prefix = str(tmp_path / "m")
+    mod = mx.mod.Module(_mlp_symbol())
+    it = _toy_iter(n=40, batch_size=20)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(initializer="xavier")
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    mod2.bind(data_shapes=it.provide_data,
+              label_shapes=it.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    s1 = mod._updater.states
+    s2 = mod2._updater.states
+    assert set(s1) == set(s2) and len(s1) > 0
+    for k in s1:
+        a = s1[k][0] if isinstance(s1[k], (tuple, list)) else s1[k]
+        b = s2[k][0] if isinstance(s2[k], (tuple, list)) else s2[k]
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                       rtol=1e-6)
+
+
+def test_module_init_params_allow_missing_initializes():
+    """allow_missing params run the initializer, not zeros (review
+    regression)."""
+    mod = mx.mod.Module(_mlp_symbol())
+    it = _toy_iter(n=20, batch_size=10)
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    partial = {"fc1_weight": nd.array(
+        np.ones((16, 6), np.float32))}
+    mod.init_params(initializer="xavier", arg_params=partial,
+                    allow_missing=True)
+    arg, _ = mod.get_params()
+    np.testing.assert_allclose(arg["fc1_weight"].asnumpy(), 1.0)
+    assert np.abs(arg["fc2_weight"].asnumpy()).sum() > 0  # initialized
