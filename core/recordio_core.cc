@@ -1,0 +1,239 @@
+/*
+ * mxtpu_core — native RecordIO codec + parallel reader.
+ *
+ * The TPU-native counterpart of the reference's dmlc-core C++ RecordIO
+ * (3rdparty/dmlc-core/src/recordio.cc†) and the threaded reader under
+ * src/io/†: the input pipeline must feed TPU-host CPUs at full
+ * bandwidth (SURVEY §2.1-N12), which a Python byte-scanner cannot.
+ *
+ * Exposed to Python through the CPython C API (no pybind11 in this
+ * environment):
+ *   scan(path)                      -> (offsets, lengths) numpy-free
+ *                                      Python lists of ints; walks the
+ *                                      record chain at C speed and
+ *                                      validates magics (recovery scan)
+ *   read_batch(path, offsets, lengths, n_threads=4)
+ *                                   -> list of bytes; parallel pread()
+ *   pack_header(flag,label,id,id2)  -> bytes (IRHeader wire format)
+ *
+ * Wire format (must match mxtpu/recordio.py): u32 magic 0xced7230a,
+ * u32 lrec (upper 3 bits continuation flag, lower 29 length), payload,
+ * pad to 4 bytes.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <thread>
+#include <vector>
+
+static const uint32_t kMagic = 0xced7230a;
+
+struct Rec {
+  int64_t payload_off;  /* offset of (possibly multi-chunk) record start */
+  int64_t length;       /* total payload length across chunks */
+};
+
+/* Walk the file once, collecting logical records (handling dmlc
+ * continuation chunks).  Returns 0 on success. */
+static int scan_file(const char *path, std::vector<Rec> *out,
+                     std::string *err) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    *err = "cannot open file";
+    return -1;
+  }
+  fseeko(f, 0, SEEK_END);
+  int64_t size = ftello(f);
+  fseeko(f, 0, SEEK_SET);
+  int64_t pos = 0;
+  bool in_record = false;
+  Rec cur{0, 0};
+  unsigned char header[8];
+  while (pos + 8 <= size) {
+    if (fread(header, 1, 8, f) != 8) break;
+    uint32_t magic, lrec;
+    memcpy(&magic, header, 4);
+    memcpy(&lrec, header + 4, 4);
+    if (magic != kMagic) {
+      *err = "bad magic (corrupt record stream)";
+      fclose(f);
+      return -1;
+    }
+    uint32_t cflag = lrec >> 29;
+    int64_t len = lrec & ((1u << 29) - 1);
+    if (!in_record) {
+      cur.payload_off = pos;
+      cur.length = 0;
+    }
+    cur.length += len;
+    int64_t padded = (len + 3) & ~3ll;
+    pos += 8 + padded;
+    fseeko(f, pos, SEEK_SET);
+    /* cflag: 0 complete, 1 first, 2 middle, 3 last */
+    if (cflag == 0 || cflag == 3) {
+      out->push_back(cur);
+      in_record = false;
+    } else {
+      in_record = true;
+    }
+  }
+  fclose(f);
+  return 0;
+}
+
+static PyObject *py_scan(PyObject *, PyObject *args) {
+  const char *path;
+  if (!PyArg_ParseTuple(args, "s", &path)) return nullptr;
+  std::vector<Rec> recs;
+  std::string err;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = scan_file(path, &recs, &err);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    PyErr_SetString(PyExc_IOError, err.c_str());
+    return nullptr;
+  }
+  PyObject *offs = PyList_New((Py_ssize_t)recs.size());
+  PyObject *lens = PyList_New((Py_ssize_t)recs.size());
+  for (size_t i = 0; i < recs.size(); ++i) {
+    PyList_SET_ITEM(offs, (Py_ssize_t)i,
+                    PyLong_FromLongLong(recs[i].payload_off));
+    PyList_SET_ITEM(lens, (Py_ssize_t)i,
+                    PyLong_FromLongLong(recs[i].length));
+  }
+  PyObject *tup = PyTuple_Pack(2, offs, lens);
+  Py_DECREF(offs);
+  Py_DECREF(lens);
+  return tup;
+}
+
+/* Read one logical record starting at `off` (header offset) from an
+ * open fd, reassembling continuation chunks into buf. */
+static int read_record(int fd, int64_t off, int64_t total,
+                       char *buf) {
+  int64_t written = 0;
+  int64_t pos = off;
+  while (written < total) {
+    unsigned char header[8];
+    if (pread(fd, header, 8, pos) != 8) return -1;
+    uint32_t magic, lrec;
+    memcpy(&magic, header, 4);
+    memcpy(&lrec, header + 4, 4);
+    if (magic != kMagic) return -1;
+    int64_t len = lrec & ((1u << 29) - 1);
+    if (written + len > total) return -1;
+    if (pread(fd, buf + written, (size_t)len, pos + 8) != (ssize_t)len)
+      return -1;
+    written += len;
+    pos += 8 + ((len + 3) & ~3ll);
+  }
+  return 0;
+}
+
+static PyObject *py_read_batch(PyObject *, PyObject *args) {
+  const char *path;
+  PyObject *offs_obj, *lens_obj;
+  int n_threads = 4;
+  if (!PyArg_ParseTuple(args, "sOO|i", &path, &offs_obj, &lens_obj,
+                        &n_threads))
+    return nullptr;
+  Py_ssize_t n = PySequence_Size(offs_obj);
+  if (n < 0 || PySequence_Size(lens_obj) != n) {
+    PyErr_SetString(PyExc_ValueError, "offsets/lengths mismatch");
+    return nullptr;
+  }
+  std::vector<int64_t> offs(n), lens(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PySequence_GetItem(offs_obj, i);
+    PyObject *l = PySequence_GetItem(lens_obj, i);
+    offs[i] = PyLong_AsLongLong(o);
+    lens[i] = PyLong_AsLongLong(l);
+    Py_XDECREF(o);
+    Py_XDECREF(l);
+    if (PyErr_Occurred()) return nullptr;
+  }
+  /* allocate result bytes objects up front (GIL held) */
+  PyObject *result = PyList_New(n);
+  std::vector<char *> bufs(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *b = PyBytes_FromStringAndSize(nullptr, lens[i]);
+    if (!b) {
+      Py_DECREF(result);
+      return nullptr;
+    }
+    bufs[i] = PyBytes_AS_STRING(b);
+    PyList_SET_ITEM(result, i, b);
+  }
+  int failed = 0;
+  Py_BEGIN_ALLOW_THREADS {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    std::vector<std::thread> workers;
+    std::vector<int> fails((size_t)n_threads, 0);
+    for (int t = 0; t < n_threads; ++t) {
+      workers.emplace_back([&, t]() {
+        int fd = open(path, O_RDONLY);
+        if (fd < 0) {
+          fails[t] = 1;
+          return;
+        }
+        for (Py_ssize_t i = t; i < n; i += n_threads) {
+          if (read_record(fd, offs[i], lens[i], bufs[i]) != 0) {
+            fails[t] = 1;
+            break;
+          }
+        }
+        close(fd);
+      });
+    }
+    for (auto &w : workers) w.join();
+    for (int t = 0; t < n_threads; ++t) failed |= fails[t];
+  }
+  Py_END_ALLOW_THREADS
+  if (failed) {
+    Py_DECREF(result);
+    PyErr_SetString(PyExc_IOError, "read_batch failed (corrupt record "
+                                   "or unreadable file)");
+    return nullptr;
+  }
+  return result;
+}
+
+static PyObject *py_pack_header(PyObject *, PyObject *args) {
+  unsigned int flag;
+  float label;
+  unsigned long long id, id2;
+  if (!PyArg_ParseTuple(args, "IfKK", &flag, &label, &id, &id2))
+    return nullptr;
+  char buf[4 + 4 + 8 + 8];
+  memcpy(buf, &flag, 4);
+  memcpy(buf + 4, &label, 4);
+  memcpy(buf + 8, &id, 8);
+  memcpy(buf + 16, &id2, 8);
+  return PyBytes_FromStringAndSize(buf, sizeof(buf));
+}
+
+static PyMethodDef Methods[] = {
+    {"scan", py_scan, METH_VARARGS,
+     "scan(path) -> (offsets, lengths): index all records at C speed"},
+    {"read_batch", py_read_batch, METH_VARARGS,
+     "read_batch(path, offsets, lengths, n_threads=4) -> list[bytes]"},
+    {"pack_header", py_pack_header, METH_VARARGS,
+     "pack_header(flag, label, id, id2) -> IRHeader bytes"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "mxtpu_core",
+    "native RecordIO codec + parallel reader", -1, Methods};
+
+PyMODINIT_FUNC PyInit_mxtpu_core(void) {
+  return PyModule_Create(&moduledef);
+}

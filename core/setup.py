@@ -1,0 +1,17 @@
+"""Build the native core:  cd core && python setup.py build_ext --inplace
+(installs mxtpu_core*.so next to this file; mxtpu.recordio picks it up
+automatically — see mxtpu/recordio.py)."""
+from setuptools import Extension, setup
+
+setup(
+    name="mxtpu_core",
+    version="0.1.0",
+    ext_modules=[
+        Extension(
+            "mxtpu_core",
+            sources=["recordio_core.cc"],
+            extra_compile_args=["-O3", "-std=c++17", "-pthread"],
+            extra_link_args=["-pthread"],
+        )
+    ],
+)

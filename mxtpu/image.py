@@ -224,6 +224,7 @@ class ImageIter:
         self.provide_data = self._inner.provide_data
         self.provide_label = self._inner.provide_label
         self.batch_size = batch_size
+        self.auglist = aug_list if aug_list is not None else []
 
     def __iter__(self):
         return self
@@ -232,6 +233,19 @@ class ImageIter:
         self._inner.reset()
 
     def next(self):
-        return self._inner.next()
+        batch = self._inner.next()
+        if self.auglist:
+            # augmenters operate per-sample on HWC; convert from the
+            # inner CHW batch and back
+            data = batch.data[0]
+            samples = []
+            for i in range(data.shape[0]):
+                img = data[i].transpose(1, 2, 0)
+                for aug in self.auglist:
+                    img = aug(img)
+                samples.append(img.transpose(2, 0, 1).asnumpy())
+            from .ndarray import array as _arr
+            batch.data = [_arr(np.stack(samples))]
+        return batch
 
     __next__ = next

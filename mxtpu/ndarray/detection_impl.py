@@ -90,9 +90,10 @@ def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
     step_x = steps[1] if steps[1] > 0 else 1.0 / W
     cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
     cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
-    # anchor (w,h) list: all sizes at ratio[0], then size[0] at other
-    # ratios — the reference's S+R-1 convention
-    whs = [(s, s) for s in sizes]
+    # anchor (w,h) list: all sizes at ratios[0], then sizes[0] at the
+    # other ratios — the reference's S+R-1 convention
+    r0 = float(np.sqrt(ratios[0]))
+    whs = [(s * r0, s / r0) for s in sizes]
     whs += [(sizes[0] * float(np.sqrt(r)), sizes[0] / float(np.sqrt(r)))
             for r in ratios[1:]]
     wh = jnp.asarray(whs, jnp.float32)  # (K, 2): (w, h)
@@ -159,7 +160,7 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
     A = anc.shape[0]
     variances = jnp.asarray(variances, jnp.float32)
 
-    def one(lab):
+    def one(lab, cls_pred):
         valid = lab[:, 0] >= 0
         gt_boxes = lab[:, 1:5]
         iou = _iou_corner(anc, gt_boxes)  # (A, O)
@@ -167,11 +168,15 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
         best_gt = jnp.argmax(iou, axis=1)           # per-anchor
         best_iou = jnp.max(iou, axis=1)
         pos = best_iou > overlap_threshold
-        # force-match: each valid gt claims its best anchor
+        # force-match: each VALID gt claims its best anchor; padding
+        # rows scatter to the out-of-range index A (mode='drop') so
+        # they can never clobber a real match at a duplicate index
         best_anchor = jnp.argmax(iou, axis=0)       # (O,)
-        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
-        forced_gt = jnp.zeros(A, jnp.int32).at[best_anchor].set(
-            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        scatter_idx = jnp.where(valid, best_anchor, A)
+        forced = jnp.zeros(A, bool).at[scatter_idx].set(
+            True, mode="drop")
+        forced_gt = jnp.zeros(A, jnp.int32).at[scatter_idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
         gt_idx = jnp.where(forced, forced_gt, best_gt)
         pos = pos | forced
         matched = gt_boxes[gt_idx]
@@ -180,9 +185,25 @@ def _multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
         mask = jnp.where(pos[:, None],
                          jnp.ones_like(target), 0.0)
         cls = jnp.where(pos, lab[gt_idx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference semantics): rank negative
+            # anchors by their max foreground confidence, keep the
+            # hardest ratio*num_pos as background targets, mark the
+            # rest ignore_label so the loss skips them
+            fg_conf = jnp.max(cls_pred[1:], axis=0)  # (A,)
+            neg = ~pos
+            num_pos = jnp.sum(pos)
+            max_neg = (negative_mining_ratio *
+                       num_pos.astype(jnp.float32)).astype(jnp.int32)
+            score = jnp.where(neg, fg_conf, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.argsort(order)
+            keep_neg = neg & (rank < max_neg)
+            cls = jnp.where(pos, cls,
+                            jnp.where(keep_neg, 0.0, ignore_label))
         return target.reshape(-1), mask.reshape(-1), cls
 
-    bt, bm, ct = jax.vmap(one)(labels)
+    bt, bm, ct = jax.vmap(one)(labels, cls_preds)
     return bt, bm, ct
 
 
@@ -239,8 +260,12 @@ def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
                 keep[i] & same_cls
             return keep & ~sup
 
+        keep0 = ss > 0.0
+        if nms_topk > 0:
+            # reference: only the top-k scored boxes enter NMS at all
+            keep0 = keep0 & (jnp.arange(A) < nms_topk)
         keep = lax.fori_loop(0, A if nms_topk < 0 else min(nms_topk, A),
-                             body, ss > 0.0)
+                             body, keep0)
         out = jnp.concatenate([cs[:, None], ss[:, None], bs], axis=1)
         return jnp.where(keep[:, None], out, -jnp.ones_like(out))
 
@@ -261,16 +286,31 @@ register_op("MultiBoxDetection", num_inputs=3,
 # CTC loss
 # ----------------------------------------------------------------------
 
-def _ctc_loss(data, label, use_data_lengths=False,
+def _ctc_loss(data, label, *lengths, use_data_lengths=False,
               use_label_lengths=False, blank_label="first"):
     """CTC negative log likelihood (reference ``ctc_loss``†).
     data (T, N, C) pre-softmax activations; label (N, L) with -1 (or 0
-    for blank_label='last' semantics) padding.  Blank index 0 for
-    'first' (labels are 1-based), C-1 for 'last' (labels 0-based).
-    Returns (N,) losses.  Differentiable through the scan.
+    for blank_label='last' semantics) padding.  Optional trailing
+    inputs: data_lengths (N,) then label_lengths (N,) gated by the
+    use_* flags.  Blank index 0 for 'first' (labels are 1-based),
+    C-1 for 'last' (labels 0-based).  Returns (N,) losses.
+    Differentiable through the scan.
     """
     T, N, C = data.shape
     L = label.shape[1]
+    data_lengths = None
+    label_lengths = None
+    rest = list(lengths)
+    if use_data_lengths:
+        if not rest:
+            raise MXNetError("use_data_lengths=True needs a "
+                             "data_lengths input")
+        data_lengths = rest.pop(0).astype(jnp.int32)
+    if use_label_lengths:
+        if not rest:
+            raise MXNetError("use_label_lengths=True needs a "
+                             "label_lengths input")
+        label_lengths = rest.pop(0).astype(jnp.int32)
     logp = jax.nn.log_softmax(data, axis=-1)
     blank = 0 if blank_label == "first" else C - 1
     lab = label.astype(jnp.int32)
@@ -281,6 +321,10 @@ def _ctc_loss(data, label, use_data_lengths=False,
     else:
         valid = lab >= 0
         lab_idx = jnp.where(valid, lab, 0)
+    if label_lengths is not None:
+        valid = jnp.arange(L)[None, :] < label_lengths[:, None]
+        lab_idx = jnp.where(valid, lab_idx,
+                            1 if blank_label == "first" else 0)
     label_len = jnp.sum(valid.astype(jnp.int32), axis=1)  # (N,)
 
     # extended sequence: blank, l1, blank, l2, ..., blank (2L+1)
@@ -314,20 +358,28 @@ def _ctc_loss(data, label, use_data_lengths=False,
         m = jnp.max(stacked, axis=0)
         tot = m + jnp.log(jnp.sum(jnp.exp(stacked - m), axis=0) + 1e-30)
         alpha_new = tot + emit(t)
+        if data_lengths is not None:
+            # past a sequence's length the alphas freeze, so the final
+            # read sees the values at t = len-1
+            active = (t < data_lengths)[:, None]
+            alpha_new = jnp.where(active, alpha_new, alpha)
         return alpha_new, None
 
     alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
-    # final: last blank or last label
+    # final: last blank or last label (identical cells when the label
+    # is empty — count once, not twice)
     last = ext_valid_len - 1
     a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
     a_prev = jnp.take_along_axis(
         alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
     m = jnp.maximum(a_last, a_prev)
-    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-30)
+    both = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) +
+                       1e-30)
+    ll = jnp.where(last == 0, a_last, both)
     return -ll
 
 
-register_op("ctc_loss", num_inputs=2,
+register_op("ctc_loss", num_inputs=-1,
             params=[Param("use_data_lengths", bool, False),
                     Param("use_label_lengths", bool, False),
                     Param("blank_label", str, "first",

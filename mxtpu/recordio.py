@@ -26,7 +26,83 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img", "scan",
+           "read_batch", "native_available"]
+
+
+def _native():
+    """The C++ codec (core/recordio_core.cc), if built."""
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE if _NATIVE is not False else None
+    try:
+        import mxtpu_core
+        _NATIVE = mxtpu_core
+    except ImportError:
+        import sys
+        core_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "core")
+        if os.path.isdir(core_dir) and core_dir not in sys.path:
+            sys.path.append(core_dir)
+            try:
+                import mxtpu_core
+                _NATIVE = mxtpu_core
+            except ImportError:
+                _NATIVE = False
+        else:
+            _NATIVE = False
+    return _NATIVE if _NATIVE is not False else None
+
+
+_NATIVE = None
+
+
+def native_available() -> bool:
+    return _native() is not None
+
+
+def scan(uri: str):
+    """Index every record of a .rec file → (offsets, lengths) — no
+    .idx needed.  Native C scanner when built, python fallback."""
+    nat = _native()
+    if nat is not None:
+        return nat.scan(uri)
+    offsets, lengths = [], []
+    with MXRecordIO(uri, "r") as rec:
+        while True:
+            pos = rec.tell()
+            payload = rec.read()
+            if payload is None:
+                break
+            offsets.append(pos)
+            lengths.append(len(payload))
+    return offsets, lengths
+
+
+def read_batch(uri: str, offsets, lengths, n_threads: int = 4):
+    """Bulk-read records by (offset, length) — parallel pread in C when
+    built (the DataLoader hot path), sequential python otherwise."""
+    nat = _native()
+    if nat is not None:
+        return nat.read_batch(uri, list(offsets), list(lengths),
+                              n_threads)
+    out = []
+    with open(uri, "rb") as f:
+        for off in offsets:
+            f.seek(off)
+            header = f.read(8)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _K_MAGIC:
+                raise MXNetError(f"invalid magic at offset {off}")
+            cflag, length = _decode_lrec(lrec)
+            parts = [f.read(length)]
+            while cflag not in (0, 3):
+                f.seek((4 - (length & 3)) & 3, 1)
+                magic, lrec = struct.unpack("<II", f.read(8))
+                cflag, length = _decode_lrec(lrec)
+                parts.append(f.read(length))
+            out.append(b"".join(parts))
+    return out
 
 _K_MAGIC = 0xCED7230A
 _FLAG_BITS = 29
@@ -179,6 +255,19 @@ class MXIndexedRecordIO(MXRecordIO):
                         key = self.key_type(parts[0])
                         self.idx[key] = int(parts[1])
                         self.keys.append(key)
+            else:
+                # no .idx sidecar: rebuild the index by scanning the
+                # record chain (C-speed when the native core is built);
+                # cached on the instance so reset()/post-fork reopen
+                # don't rescan the whole file
+                cached = getattr(self, "_scan_cache", None)
+                if cached is None:
+                    cached, _ = scan(self.uri)
+                    self._scan_cache = cached
+                for i, off in enumerate(cached):
+                    key = self.key_type(i)
+                    self.idx[key] = off
+                    self.keys.append(key)
 
     def close(self):
         if self.is_open and self.fidx is not None:

@@ -198,3 +198,79 @@ def test_image_module(tmp_path):
         out = a(out)
     assert out.shape == (8, 8, 3)
     assert out.asnumpy().dtype == np.float32
+
+
+def test_ctc_loss_with_lengths():
+    """data_lengths/label_lengths inputs (review regression)."""
+    rng = np.random.RandomState(3)
+    T, N, C = 6, 2, 4
+    logits = rng.randn(T, N, C).astype(np.float64)
+    # row 0 uses only 4 timesteps and 2 labels
+    loss = nd.ctc_loss(nd.array(logits.astype(np.float32)),
+                       nd.array(np.array([[1, 2, 3], [3, 1, 0]],
+                                         np.float32)),
+                       nd.array(np.array([4, 6], np.float32)),
+                       nd.array(np.array([2, 2], np.float32)),
+                       use_data_lengths=True, use_label_lengths=True)
+    ref0 = _np_ctc_ref(logits[:4, 0], [1, 2], blank=0)
+    ref1 = _np_ctc_ref(logits[:, 1], [3, 1], blank=0)
+    np.testing.assert_allclose(loss.asnumpy(), [ref0, ref1], rtol=1e-4)
+
+
+def test_ctc_loss_empty_label():
+    """Empty transcript: loss = -log P(all blanks), no double count
+    (review regression)."""
+    rng = np.random.RandomState(4)
+    T, C = 3, 3
+    logits = rng.randn(T, 1, C).astype(np.float64)
+    loss = nd.ctc_loss(nd.array(logits.astype(np.float32)),
+                       nd.array(np.zeros((1, 2), np.float32)))
+    ref = _np_ctc_ref(logits[:, 0], [], blank=0)
+    np.testing.assert_allclose(loss.asnumpy(), [ref], rtol=1e-4)
+
+
+def test_multibox_prior_nonunit_first_ratio():
+    """sizes expand at ratios[0], not hardcoded square (review
+    regression)."""
+    x = nd.zeros((1, 3, 2, 2))
+    a = nd.MultiBoxPrior(x, sizes=(0.5,), ratios=(2.0,)).asnumpy()[0]
+    w = a[0, 2] - a[0, 0]
+    h = a[0, 3] - a[0, 1]
+    np.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+    np.testing.assert_allclose(w * h, 0.25, rtol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.4, 0.4],
+          [0.5, 0.5, 1.0, 1.0],
+          [0.0, 0.6, 0.3, 1.0],
+          [0.6, 0.0, 1.0, 0.3]]], np.float32))
+    labels = nd.array(np.array(
+        [[[1.0, 0.55, 0.55, 0.95, 0.95]]], np.float32))
+    # anchor 2 has high fg confidence → hard negative kept; anchor 0/3
+    # low → ignored (ratio 1:1 with a single positive)
+    cls_preds = np.zeros((1, 3, 4), np.float32)
+    cls_preds[0, 1, 2] = 5.0
+    bt, bm, ct = nd.MultiBoxTarget(anchors, labels,
+                                   nd.array(cls_preds),
+                                   negative_mining_ratio=1.0)
+    c = ct.asnumpy()[0]
+    assert c[1] == 2.0          # positive
+    assert c[2] == 0.0          # hard negative kept as background
+    assert c[0] == -1.0 and c[3] == -1.0  # easy negatives ignored
+
+
+def test_multibox_detection_topk():
+    """nms_topk discards boxes beyond top-k (review regression)."""
+    A = 6
+    anchors = np.zeros((1, A, 4), np.float32)
+    for i in range(A):  # disjoint boxes: nothing suppressed by IoU
+        anchors[0, i] = [i * 0.15, 0.0, i * 0.15 + 0.1, 0.1]
+    cls_prob = np.zeros((1, 2, A), np.float32)
+    cls_prob[0, 1] = np.linspace(0.9, 0.4, A)
+    loc = np.zeros((1, A * 4), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc),
+                               nd.array(anchors), nms_topk=2)
+    o = out.asnumpy()[0]
+    assert (o[:, 0] >= 0).sum() == 2

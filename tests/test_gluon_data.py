@@ -361,3 +361,39 @@ def test_record_dataset_threaded_reads(tmp_path):
     with ThreadPoolExecutor(8) as pool:
         results = list(pool.map(check, list(range(64)) * 8))
     assert len(results) == 512
+
+
+def test_recordio_scan_and_read_batch(tmp_path):
+    """Native + python codecs agree on scan/read_batch; indexed reader
+    works without a .idx sidecar."""
+    from mxtpu import recordio
+    path = str(tmp_path / "scan.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i % 251]) * (10 + i * 7) for i in range(50)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    offs, lens = recordio.scan(path)
+    assert len(offs) == 50
+    got = recordio.read_batch(path, offs, lens)
+    assert got == payloads
+
+    # python fallback parity (force-native off)
+    import mxtpu.recordio as rio
+    nat = rio._NATIVE
+    try:
+        rio._NATIVE = False
+        offs_py, lens_py = recordio.scan(path)
+        got_py = recordio.read_batch(path, offs_py, lens_py)
+    finally:
+        rio._NATIVE = nat
+    assert offs_py == list(offs) and lens_py == list(lens)
+    assert got_py == payloads
+
+    # MXIndexedRecordIO with no .idx file: auto-index via scan
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "missing.idx"),
+                                   path, "r")
+    assert len(r.keys) == 50
+    assert r.read_idx(7) == payloads[7]
+    r.close()
