@@ -70,7 +70,7 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
         "resnet50_imagenet_train_throughput", "samples/sec"
 
 
-def bench_bert(batch_size=8, seq_len=128, warmup=3, iters=20):
+def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20):
     """BERT-Large MLM-style training step, tokens/sec (north-star #2).
     bf16 compute by default (set MXTPU_BENCH_DTYPE= to override)."""
     from mxtpu import nd

@@ -87,7 +87,13 @@ def _traced_forward(block, params, param_vals, nd_ins, training, key_data):
     provider = _rnd._TraceKeyProvider(jax.random.wrap_key_data(key_data))
     _rnd._push_trace_provider(provider)
     try:
-        out = block.forward(*nd_ins)
+        # honour set_remat on the ROOT block too (child blocks route
+        # through __call__, which carries the remat dispatch)
+        if getattr(block, "_remat", False) and \
+                hasattr(block, "_forward_remat"):
+            out = block._forward_remat(tuple(nd_ins), {})
+        else:
+            out = block.forward(*nd_ins)
     finally:
         _rnd._pop_trace_provider()
         autograd.set_training(prev_train)
@@ -293,6 +299,10 @@ class HybridBlock(Block):
     def __call__(self, *args, **kwargs):
         if args:
             self._num_inputs = len(args)  # recorded for export()
+        if getattr(self, "_remat", False) and not \
+                getattr(self, "_in_remat", False) \
+                and _TRACE.param_sub is not None:
+            return self._forward_remat(args, kwargs)
         if self._active and _TRACE.param_sub is None \
                 and not kwargs and args:
             leaves, treedef = _flatten_args(args)
@@ -318,6 +328,60 @@ class HybridBlock(Block):
         self._ensure_init(*args)
         pvals = {name: p.data() for name, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, *args, **pvals, **kwargs)
+
+    def set_remat(self, active: bool = True):
+        """Rematerialize this block's activations in the backward pass
+        (``jax.checkpoint`` around the block when traced) — trades
+        recompute FLOPs for HBM, the lever for long-sequence /
+        large-batch training (SURVEY §0: use jax.checkpoint to trade
+        FLOPs for memory).  Apply to repeated layers (transformer
+        cells), NOT to blocks emitting BatchNorm aux updates in
+        training (their running-stat tracers must not cross the
+        checkpoint boundary)."""
+        self._remat = active
+        return self
+
+    def _forward_remat(self, args, kwargs):
+        leaves, treedef = _flatten_args(args)
+        if not leaves or not all(isinstance(a, NDArray) for a in leaves):
+            self._in_remat = True
+            try:
+                return self.__call__(*args, **kwargs)
+            finally:
+                self._in_remat = False
+        raw = [a.data for a in leaves]
+        sink_before = len(_TRACE.aux_sink) if _TRACE.aux_sink is not None \
+            else None
+        box = {}
+
+        def _pure(*raw_in):
+            nds = [NDArray(r, None, _placed=True) for r in raw_in]
+            rebuilt = jax.tree_util.tree_unflatten(treedef, nds)
+            # re-enter the normal call path (guarded against recursing
+            # back here); params resolve to the substituted trace
+            # values inside and become checkpoint constants (saved,
+            # not recomputed)
+            self._in_remat = True
+            try:
+                out = self.__call__(*rebuilt, **kwargs)
+            finally:
+                self._in_remat = False
+            outs_flat, out_tree = _flatten_args((out,))
+            box["tree"] = out_tree
+            return tuple(o.data if isinstance(o, NDArray) else o
+                         for o in outs_flat)
+
+        outs = jax.checkpoint(_pure)(*raw)
+        if sink_before is not None and \
+                len(_TRACE.aux_sink) != sink_before:
+            raise MXNetError(
+                f"{type(self).__name__}.set_remat: block emitted aux "
+                f"(BatchNorm running-stat) updates inside the "
+                f"checkpoint region — their tracers cannot cross the "
+                f"boundary; remat a smaller block or disable remat")
+        outs_nd = [NDArray(o, None, _placed=True) for o in outs]
+        (out,) = jax.tree_util.tree_unflatten(box["tree"], outs_nd)
+        return out
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError(

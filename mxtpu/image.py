@@ -235,17 +235,17 @@ class ImageIter:
     def next(self):
         batch = self._inner.next()
         if self.auglist:
-            # augmenters operate per-sample on HWC; convert from the
-            # inner CHW batch and back
+            # augmenters operate per-sample on HWC; stay on device the
+            # whole way (no per-sample host syncs) and restack once
+            from .ndarray import stack as _stack
             data = batch.data[0]
             samples = []
             for i in range(data.shape[0]):
                 img = data[i].transpose(1, 2, 0)
                 for aug in self.auglist:
                     img = aug(img)
-                samples.append(img.transpose(2, 0, 1).asnumpy())
-            from .ndarray import array as _arr
-            batch.data = [_arr(np.stack(samples))]
+                samples.append(img.transpose(2, 0, 1))
+            batch.data = [_stack(*samples, axis=0)]
         return batch
 
     __next__ = next

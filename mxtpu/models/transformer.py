@@ -98,12 +98,17 @@ class TransformerEncoder(HybridBlock):
     """Stack of encoder cells."""
 
     def __init__(self, num_layers, units, hidden_size, num_heads,
-                 dropout=0.0, causal=False, **kwargs):
+                 dropout=0.0, causal=False, remat=False, **kwargs):
         super().__init__(**kwargs)
         self.layers = nn.HybridSequential()
         for i in range(num_layers):
-            self.layers.add(TransformerEncoderCell(
-                units, hidden_size, num_heads, dropout, causal))
+            cell = TransformerEncoderCell(
+                units, hidden_size, num_heads, dropout, causal)
+            if remat:
+                # per-layer activation rematerialization: O(sqrt)-style
+                # memory for deep stacks (SURVEY §0)
+                cell.set_remat(True)
+            self.layers.add(cell)
 
     def hybrid_forward(self, F, x):
         return self.layers(x)
@@ -115,7 +120,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size, units, hidden_size, num_layers,
                  num_heads, max_length=512, dropout=0.1,
-                 use_token_type=True, **kwargs):
+                 use_token_type=True, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
@@ -127,7 +132,7 @@ class BERTModel(HybridBlock):
         self.embed_drop = nn.Dropout(dropout) if dropout else None
         self.encoder = TransformerEncoder(num_layers, units,
                                           hidden_size, num_heads,
-                                          dropout)
+                                          dropout, remat=remat)
         self.mlm = nn.Dense(vocab_size, flatten=False)
 
     def hybrid_forward(self, F, tokens, token_types=None,
@@ -159,11 +164,12 @@ def bert_base(vocab_size=30522, max_length=512, dropout=0.1):
     return BERTModel(vocab_size, 768, 3072, 12, 12, max_length, dropout)
 
 
-def bert_large(vocab_size=30522, max_length=512, dropout=0.1):
+def bert_large(vocab_size=30522, max_length=512, dropout=0.1,
+               remat=False):
     """BERT-Large: 24 layers, 1024 units, 16 heads — north-star
     workload 3."""
     return BERTModel(vocab_size, 1024, 4096, 24, 16, max_length,
-                     dropout)
+                     dropout, remat=remat)
 
 
 def transformer_encoder(num_layers=6, units=512, hidden_size=2048,

@@ -194,3 +194,75 @@ def test_mixed_precision_preserves_token_ids():
     l2 = float(step(nd.array(np.array([[4094, 1, 2, 3]], np.float32)),
                     y).asscalar())
     assert abs(l1 - l2) > 1e-9, (l1, l2)
+
+
+def test_remat_matches_no_remat():
+    """set_remat: identical results, gradients intact (memory-only
+    change)."""
+    from mxtpu import parallel
+    from mxtpu.models.transformer import BERTModel
+    import mxtpu as mx
+
+    def build(remat):
+        mx.random.seed(11)
+        np.random.seed(11)
+        net = BERTModel(vocab_size=32, units=32, hidden_size=64,
+                        num_layers=2, num_heads=4, max_length=16,
+                        dropout=0.0, remat=remat)
+        net.initialize(init="xavier")
+        return net
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 32, (4, 8)).astype(np.float32)
+
+    losses = {}
+    for remat in (False, True):
+        net = build(remat)
+        step = parallel.build_train_step(
+            net, lambda p, y: gloss.SoftmaxCrossEntropyLoss()(
+                p.reshape((-1, 32)), y.reshape((-1,))),
+            "sgd", {"learning_rate": 0.1})
+        x = nd.array(toks)
+        losses[remat] = [float(step(x, x).asscalar()) for _ in range(4)]
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_remat_rejects_batchnorm_aux():
+    """Blocks emitting BN aux updates inside a remat region fail
+    loudly, not silently."""
+    from mxtpu import parallel
+    from mxtpu.gluon import nn
+    import pytest as _pytest
+
+    net = nn.HybridSequential()
+    inner = nn.HybridSequential()
+    inner.add(nn.Dense(4, flatten=False), nn.BatchNorm(axis=-1))
+    inner.set_remat(True)
+    net.add(inner)
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, lambda p, y: gloss.L2Loss()(p, y), "sgd",
+        {"learning_rate": 0.1})
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    y = nd.array(np.zeros((4, 4), np.float32))
+    with _pytest.raises(Exception):
+        step(x, y)
+
+
+def test_remat_on_root_block():
+    """set_remat on the net passed to build_train_step engages (review
+    regression: used to be a silent no-op)."""
+    from mxtpu import parallel
+    from mxtpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    net.set_remat(True)
+    step = parallel.build_train_step(
+        net, lambda p, y: gloss.L2Loss()(p, y), "sgd",
+        {"learning_rate": 0.1})
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    y = nd.array(np.zeros((4, 2), np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(5)]
+    assert losses[-1] < losses[0]
