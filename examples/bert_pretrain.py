@@ -1,0 +1,79 @@
+"""BERT MLM pretraining step — north-star workload 3
+(BASELINE.md; the reference era ran this via GluonNLP scripts).
+
+Single chip:
+  python examples/bert_pretrain.py --model base --batch-size 32
+Multi-chip data parallel (virtual CPU mesh for testing):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python examples/bert_pretrain.py --model tiny --dp 8
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import nd, parallel
+from mxtpu.gluon import loss as gloss
+from mxtpu.models.transformer import BERTModel
+
+CONFIGS = {
+    "tiny": dict(units=128, hidden_size=512, num_layers=2, num_heads=2),
+    "base": dict(units=768, hidden_size=3072, num_layers=12,
+                 num_heads=12),
+    "large": dict(units=1024, hidden_size=4096, num_layers=24,
+                  num_heads=16),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=CONFIGS, default="base")
+    p.add_argument("--vocab", type=int, default=30522)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--dp", type=int, default=0,
+                   help="data-parallel mesh size (0 = single device)")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = BERTModel(args.vocab, max_length=args.seq_len, dropout=0.1,
+                    remat=args.remat, **CONFIGS[args.model])
+    net.initialize(init="xavier")
+
+    def mlm_loss(pred, y):
+        return gloss.SoftmaxCrossEntropyLoss()(
+            pred.reshape((-1, args.vocab)), y.reshape((-1,)))
+
+    mesh = parallel.make_mesh({"dp": args.dp}) if args.dp else None
+    step = parallel.build_train_step(
+        net, mlm_loss, "adam", {"learning_rate": args.lr}, mesh=mesh,
+        compute_dtype=args.dtype or None, cast_batch=False)
+
+    rng = np.random.RandomState(0)
+    toks = nd.array(rng.randint(0, args.vocab,
+                                (args.batch_size, args.seq_len))
+                    .astype(np.float32))
+    loss = step(toks, toks)  # compile
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step(toks, toks)
+        if (i + 1) % 10 == 0:
+            logging.info("step %d loss %.4f", i + 1,
+                         float(loss.asscalar()))
+    dt = time.perf_counter() - t0
+    tokens = args.batch_size * args.seq_len * args.steps
+    logging.info("%.1f tokens/sec", tokens / dt)
+
+
+if __name__ == "__main__":
+    main()

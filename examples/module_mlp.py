@@ -1,0 +1,47 @@
+"""Legacy symbolic workflow: symbol + Module.fit
+(reference ``example/image-classification/common/fit.py``† shape).
+
+  python examples/module_mlp.py
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu.io import NDArrayIter
+
+
+def build_symbol(hidden=64, classes=10):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 20).astype(np.float32)
+    y = X[:, :10].argmax(1).astype(np.float32)
+    train = NDArrayIter(X[:1600], y[:1600], batch_size=64, shuffle=True,
+                        label_name="softmax_label")
+    val = NDArrayIter(X[1600:], y[1600:], batch_size=64,
+                      label_name="softmax_label")
+
+    mod = mx.mod.Module(build_symbol())
+    mod.fit(train, eval_data=val, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer="xavier",
+            batch_end_callback=mx.callback.Speedometer(64, 10),
+            epoch_end_callback=mx.callback.do_checkpoint("mlp",
+                                                         period=4))
+    print(mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

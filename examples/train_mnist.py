@@ -1,0 +1,94 @@
+"""LeNet on MNIST — north-star workload 1
+(reference ``example/image-classification/train_mnist.py``†).
+
+Uses the MNIST idx files under --data-dir if present, else synthetic
+MNIST-shaped data (no network access in this environment).
+
+  python examples/train_mnist.py --epochs 3 --batch-size 256
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import loss as gloss
+from mxtpu.models import lenet
+
+
+def load_data(data_dir, batch_size):
+    from mxtpu.io import MNISTIter, NDArrayIter
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    lab = os.path.join(data_dir, "train-labels-idx1-ubyte")
+    if os.path.exists(img):
+        return MNISTIter(image=img, label=lab, batch_size=batch_size)
+    logging.warning("MNIST files not found under %s — synthetic data",
+                    data_dir)
+    rng = np.random.RandomState(0)
+    X = rng.rand(4096, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 4096).astype(np.float32)
+    return NDArrayIter(X, y, batch_size=batch_size, shuffle=True,
+                       last_batch_handle="discard")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=os.path.expanduser(
+        "~/.mxnet/datasets/mnist"))
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--compiled", action="store_true",
+                   help="use the fused SPMD train step")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = lenet()
+    net.initialize(init="xavier")
+    train = load_data(args.data_dir, args.batch_size)
+    metric = mx.metric.Accuracy()
+    speed = mx.callback.Speedometer(args.batch_size, 20)
+    from mxtpu.module.base_module import BatchEndParam
+
+    if args.compiled:
+        from mxtpu import parallel
+        step = parallel.build_train_step(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": args.lr, "momentum": 0.9})
+        for epoch in range(args.epochs):
+            train.reset()
+            for i, batch in enumerate(train):
+                loss = step(batch.data[0], batch.label[0])
+                speed(BatchEndParam(epoch, i, None, None))
+            logging.info("epoch %d loss %.4f", epoch,
+                         float(loss.asscalar()))
+        return
+
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        for i, batch in enumerate(train):
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = L(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            speed(BatchEndParam(epoch, i, metric, None))
+        logging.info("epoch %d train-acc %.4f", epoch,
+                     metric.get()[1])
+    net.save_parameters("lenet.params")
+
+
+if __name__ == "__main__":
+    main()

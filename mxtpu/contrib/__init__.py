@@ -1,0 +1,18 @@
+"""``mx.contrib`` (reference ``python/mxnet/contrib/``†):
+quantization calibration + ndarray contrib re-exports.  (ONNX
+import/export is not implemented; ``onnx`` raises with guidance.)"""
+from . import quantization
+from ..ndarray import contrib as ndarray  # mx.contrib.ndarray.* ops
+
+__all__ = ["quantization", "ndarray"]
+
+
+def __getattr__(name):
+    if name == "onnx":
+        from ..base import MXNetError
+        raise MXNetError(
+            "contrib.onnx import/export is not implemented in this "
+            "build; export via Block.export (native symbol.json + "
+            "params) instead")
+    raise AttributeError(f"module 'mxtpu.contrib' has no attribute "
+                         f"{name!r}")

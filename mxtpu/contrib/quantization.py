@@ -1,0 +1,49 @@
+"""Post-training quantization calibration (reference
+``python/mxnet/contrib/quantization.py``†, simplified to the min/max
+calibration mode the int8 deployment path needs)."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["calib_minmax", "quantize_params"]
+
+
+def calib_minmax(data_iter, num_batches: int = 10,
+                 layer_outputs=None) -> Dict[str, Tuple[float, float]]:
+    """Collect per-input min/max ranges over calibration batches
+    (the 'naive' calibration mode†)."""
+    ranges: Dict[str, Tuple[float, float]] = {}
+    data_iter.reset()
+    for i, batch in enumerate(data_iter):
+        if i >= num_batches:
+            break
+        for desc, arr in zip(batch.provide_data or [], batch.data):
+            a = arr.asnumpy()
+            lo, hi = float(a.min()), float(a.max())
+            if desc.name in ranges:
+                plo, phi = ranges[desc.name]
+                ranges[desc.name] = (min(lo, plo), max(hi, phi))
+            else:
+                ranges[desc.name] = (lo, hi)
+    return ranges
+
+
+def quantize_params(params: Dict[str, NDArray], out_type: str = "int8"):
+    """Quantize a parameter dict → (quantized arrays, ranges)
+    (the weight half of ``quantize_model``†)."""
+    from .. import nd
+    qparams, ranges = {}, {}
+    for name, arr in params.items():
+        a = arr.asnumpy()
+        lo, hi = float(a.min()), float(a.max())
+        q, qlo, qhi = nd.quantize_v2(arr, min_calib_range=lo,
+                                     max_calib_range=hi,
+                                     out_type=out_type)
+        qparams[name] = q
+        ranges[name] = (lo, hi)
+    return qparams, ranges

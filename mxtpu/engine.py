@@ -1,0 +1,55 @@
+"""Engine controls (reference ``python/mxnet/engine.py``† +
+``MXNET_ENGINE_TYPE`` semantics, SURVEY §5.2).
+
+The dependency engine itself is subsumed by XLA/PjRt async dispatch
+(SURVEY §2.1-N5); what survives is the *debugging surface*:
+
+- ``set_bulk_size`` — the reference's bulked-execution knob; here jax
+  already batches dispatch, so the value is recorded and returned (kept
+  for API compatibility; harmless).
+- NaiveEngine mode — ``MXNET_ENGINE_TYPE=NaiveEngine`` (or
+  ``set_sync_mode(True)``) makes every eager op synchronous: each
+  dispatch blocks until the result is materialized, turning async
+  heisenbugs into reproducible stack traces, exactly the reference's
+  serial-debug switch.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+__all__ = ["set_bulk_size", "bulk", "set_sync_mode", "sync_enabled"]
+
+_BULK_SIZE = 15
+_SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine" or \
+    os.environ.get("MXTPU_ENGINE_SYNC", "0") == "1"
+
+
+def set_bulk_size(size: int) -> int:
+    """Set (and return the previous) bulk execution size
+    (reference ``set_bulk_size``†)."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size: int):
+    """Bulk-execution scope (reference ``engine.bulk``†)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def set_sync_mode(sync: bool) -> bool:
+    """Serial (NaiveEngine-style) execution: every op blocks until
+    complete.  Returns the previous setting."""
+    global _SYNC
+    prev, _SYNC = _SYNC, bool(sync)
+    return prev
+
+
+def sync_enabled() -> bool:
+    return _SYNC

@@ -329,6 +329,8 @@ class HybridBlock(Block):
         pvals = {name: p.data() for name, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, *args, **pvals, **kwargs)
 
+    _REMAT_GENERATION = [0]  # class-level; bumped by every set_remat
+
     def set_remat(self, active: bool = True):
         """Rematerialize this block's activations in the backward pass
         (``jax.checkpoint`` around the block when traced) — trades
@@ -339,24 +341,35 @@ class HybridBlock(Block):
         training (their running-stat tracers must not cross the
         checkpoint boundary)."""
         self._remat = active
+        # invalidate every hybridize cache: a parent block's compiled
+        # executable may have been traced with the old remat setting
+        # and its cache key cannot see a child's flag — the generation
+        # counter is part of every cache key
+        HybridBlock._REMAT_GENERATION[0] += 1
         return self
 
     def _forward_remat(self, args, kwargs):
         leaves, treedef = _flatten_args(args)
-        if not leaves or not all(isinstance(a, NDArray) for a in leaves):
-            self._in_remat = True
-            try:
-                return self.__call__(*args, **kwargs)
-            finally:
-                self._in_remat = False
-        raw = [a.data for a in leaves]
+        nd_idx = [i for i, a in enumerate(leaves)
+                  if isinstance(a, NDArray)]
+        if not nd_idx:
+            raise MXNetError(
+                f"{type(self).__name__}.set_remat: no NDArray inputs "
+                f"to checkpoint — remat cannot engage on this call "
+                f"(disable remat on this block or pass tensor inputs)")
+        raw = [leaves[i].data for i in nd_idx]
         sink_before = len(_TRACE.aux_sink) if _TRACE.aux_sink is not None \
             else None
         box = {}
 
         def _pure(*raw_in):
-            nds = [NDArray(r, None, _placed=True) for r in raw_in]
-            rebuilt = jax.tree_util.tree_unflatten(treedef, nds)
+            # rebuild the arg tree: tensor leaves from the checkpoint
+            # inputs, non-tensor leaves (python scalars/config) closed
+            # over unchanged
+            all_leaves = list(leaves)
+            for i, r in zip(nd_idx, raw_in):
+                all_leaves[i] = NDArray(r, None, _placed=True)
+            rebuilt = jax.tree_util.tree_unflatten(treedef, all_leaves)
             # re-enter the normal call path (guarded against recursing
             # back here); params resolve to the substituted trace
             # values inside and become checkpoint constants (saved,
@@ -430,7 +443,8 @@ class HybridBlock(Block):
         training = autograd.is_training()
         key = (in_treedef,
                tuple((tuple(a.shape), str(a.data.dtype)) for a in leaves),
-               training, len(params))
+               training, len(params),
+               HybridBlock._REMAT_GENERATION[0])
         entry = self._cached_entries.get(key)
         if entry is None:
             entry = self._build_cached(key, in_treedef, leaves, params,

@@ -1,0 +1,50 @@
+"""Contrib layers (reference
+``python/mxnet/gluon/contrib/nn/basic_layers.py``†)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn
+from ..block import Block, HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class Concurrent(nn.Sequential):
+    """Run children on the same input, concat outputs
+    (reference ``Concurrent``†)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd_mod
+        return nd_mod.concat(*[block(x)
+                               for block in self._children.values()],
+                             dim=self.axis)
+
+
+class HybridConcurrent(nn.HybridSequential):
+    """Hybridizable Concurrent (reference ``HybridConcurrent``†)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x)
+                          for block in self._children.values()],
+                        dim=self.axis)
+
+    def forward(self, x):
+        from ... import ndarray as nd_mod
+        return nd_mod.concat(*[block(x)
+                               for block in self._children.values()],
+                             dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity block (reference ``Identity``†)."""
+
+    def hybrid_forward(self, F, x):
+        return x

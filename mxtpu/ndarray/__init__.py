@@ -29,21 +29,31 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "random", "sparse", "linalg", "contrib"]
 
 
-_prof = None  # lazily bound profiler module (circular import at load)
+_prof = None    # lazily bound profiler module (circular import at load)
+_engine = None  # lazily bound engine module
 
 
 def _invoke_op(name: str, *inputs, **kwargs):
     """Eager dispatch — the role of ``MXImperativeInvokeEx``
     (``src/c_api/c_api_ndarray.cc``† → ``Imperative::Invoke``†).
     jax's dispatch cache plays the part of the engine's async push."""
-    global _prof
+    global _prof, _engine
     if _prof is None:
         from .. import profiler as _prof_mod
+        from .. import engine as _engine_mod
         _prof = _prof_mod
-    if _prof._ACTIVE:
+        _engine = _engine_mod
+    if _prof._ACTIVE or _engine._SYNC:
         t0 = _prof._now_us()
         out = _invoke_op_inner(name, *inputs, **kwargs)
-        _prof.record_op(name, t0, _prof._now_us() - t0)
+        if _engine._SYNC:
+            # NaiveEngine debug mode: serialize every dispatch so async
+            # failures surface at the faulting op (SURVEY §5.2)
+            jax.block_until_ready(tuple(
+                o._data for o in (out if isinstance(out, tuple)
+                                  else (out,))))
+        if _prof._ACTIVE:
+            _prof.record_op(name, t0, _prof._now_us() - t0)
         return out
     return _invoke_op_inner(name, *inputs, **kwargs)
 

@@ -1,0 +1,122 @@
+"""Custom operators defined in Python (reference
+``python/mxnet/operator.py``† over ``src/operator/custom/custom.cc``†).
+
+TPU-native note: custom python ops are host callbacks by definition —
+they execute eagerly on materialized arrays (the reference runs them on
+a dedicated callback thread for the same reason).  They compose with
+autograd through the same tape as every other op, but are opaque to
+``hybridize()``/jit (use ``mxtpu.rtc.PallasKernel`` or a registry
+lowering rule for compiled custom ops).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from . import autograd
+from .ndarray import NDArray, array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_custom_op",
+           "Custom"]
+
+_CUSTOM_REGISTRY: Registry = Registry("custom_op")
+
+
+class CustomOp:
+    """Base custom operator (reference ``mx.operator.CustomOp``†)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst: NDArray, req: str, src) -> None:
+        """Write ``src`` into ``dst`` honouring the grad request
+        (reference ``assign``†)."""
+        if req == "null":
+            return
+        src_nd = src if isinstance(src, NDArray) else array(src)
+        if req == "add":
+            dst._data = dst._data + src_nd._data
+        else:  # write / inplace
+            dst._data = src_nd._data
+
+
+class CustomOpProp:
+    """Operator properties: arity, shapes, op factory
+    (reference ``mx.operator.CustomOpProp``†)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass
+    (reference ``mx.operator.register``†)."""
+    def _wrap(prop_cls: Type[CustomOpProp]):
+        _CUSTOM_REGISTRY.register(reg_name)(prop_cls)
+        return prop_cls
+    return _wrap
+
+
+def get_custom_op(name: str) -> Type[CustomOpProp]:
+    return _CUSTOM_REGISTRY.get(name)
+
+
+def Custom(*inputs, op_type: str, **kwargs):
+    """Run a registered custom op eagerly (the ``mx.nd.Custom``
+    surface†).  Differentiable via the autograd tape when recording."""
+    prop_cls = get_custom_op(op_type)
+    prop = prop_cls(**kwargs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes,
+                              [x.dtype for x in inputs])
+    out_data = [array(np.zeros(s, np.float32)) for s in out_shapes]
+    aux = [array(np.zeros(s, np.float32)) for s in aux_shapes]
+
+    recording = autograd.is_recording() and any(
+        autograd._needs_grad(x) for x in inputs)
+
+    class _Bridge(autograd.Function):
+        def forward(self, *ins):
+            op.forward(is_train=recording,
+                       req=["write"] * len(out_data),
+                       in_data=list(ins), out_data=out_data, aux=aux)
+            self._ins = list(ins)
+            return tuple(out_data) if len(out_data) > 1 else out_data[0]
+
+        def backward(self, *ograds):
+            in_grads = [array(np.zeros(s, np.float32))
+                        for s in in_shapes]
+            op.backward(req=["write"] * len(in_grads),
+                        out_grad=list(ograds), in_data=self._ins,
+                        out_data=out_data, in_grad=in_grads, aux=aux)
+            return tuple(in_grads) if len(in_grads) > 1 else in_grads[0]
+
+    if recording:
+        return _Bridge()(*inputs)
+    op.forward(is_train=False, req=["write"] * len(out_data),
+               in_data=list(inputs), out_data=out_data, aux=aux)
+    return tuple(out_data) if len(out_data) > 1 else out_data[0]

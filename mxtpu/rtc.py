@@ -1,0 +1,55 @@
+"""Runtime kernel compilation (reference ``python/mxnet/rtc.py``† —
+NVRTC CUDA-from-string).
+
+TPU-native analogue: Pallas-from-Python.  ``PallasKernel`` wraps a
+user-written Pallas kernel function into an NDArray-callable — the
+same "write a custom kernel without rebuilding the framework" facility
+the reference's ``CudaModule`` provides, targeting the MXU/VPU instead
+of CUDA cores.  The CUDA-source entry points raise with guidance.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["CudaModule", "PallasKernel"]
+
+
+class CudaModule:
+    """Reference API stub: CUDA source cannot target a TPU."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CudaModule compiles CUDA C — not supported on TPU. Write "
+            "the kernel as a Pallas function and wrap it with "
+            "mxtpu.rtc.PallasKernel (see mxtpu/kernels/ for worked "
+            "examples).")
+
+
+class PallasKernel:
+    """Wrap a Pallas kernel into an NDArray-in/NDArray-out callable.
+
+    kernel_fn: the Pallas body ``(in_ref..., out_ref...) -> None``.
+    out_shape: ShapeDtypeStruct (or list) for outputs.
+    Extra pallas_call kwargs (grid, in_specs, out_specs, …) pass
+    through.  Compiled (and cached) per input shape by jax.jit.
+    """
+
+    def __init__(self, kernel_fn, out_shape, **pallas_kwargs):
+        from jax.experimental import pallas as pl
+
+        def run(*arrays):
+            return pl.pallas_call(kernel_fn, out_shape=out_shape,
+                                  **pallas_kwargs)(*arrays)
+        self._jitted = jax.jit(run)
+
+    def __call__(self, *inputs):
+        raws = [x.data if isinstance(x, NDArray) else x for x in inputs]
+        out = self._jitted(*raws)
+        if isinstance(out, (tuple, list)):
+            return tuple(NDArray(o, None, _placed=True) for o in out)
+        return NDArray(out, None, _placed=True)

@@ -1,0 +1,38 @@
+"""Misc utilities (reference ``python/mxnet/util.py``†)."""
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+
+__all__ = ["makedirs", "use_np_shape", "wrap_ctx_to_device_func"]
+
+
+def makedirs(d: str) -> None:
+    """mkdir -p (reference ``util.makedirs``†)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def use_np_shape(func):
+    """Numpy-shape-semantics decorator — this framework already uses
+    numpy shape semantics everywhere (zero-dim/zero-size arrays are
+    native to jax), so this is the identity (reference gates legacy
+    shape behavior)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper
+
+
+def wrap_ctx_to_device_func(func):
+    """Compatibility alias decorator (ctx= → device=) used by 2.x-era
+    code; accepts both spellings."""
+    sig_params = inspect.signature(func).parameters
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if "device" in kwargs and "device" not in sig_params \
+                and "ctx" in sig_params:
+            kwargs["ctx"] = kwargs.pop("device")
+        return func(*args, **kwargs)
+    return wrapper

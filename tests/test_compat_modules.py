@@ -1,0 +1,126 @@
+"""engine / operator(CustomOp) / rtc / contrib / util compat modules
+(reference ``test_operator.py::test_custom_op``†, ``test_engine.py``†)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_engine_controls():
+    from mxtpu import engine
+    prev = engine.set_bulk_size(4)
+    assert engine.set_bulk_size(prev) == 4
+    with engine.bulk(8):
+        pass
+    assert not engine.sync_enabled()
+    engine.set_sync_mode(True)
+    try:
+        # ops still work (each now blocks until materialized)
+        out = nd.relu(nd.array(np.array([-1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+    finally:
+        engine.set_sync_mode(False)
+
+
+def test_custom_op_forward_backward():
+    """The reference's 'quadratic' custom-op tutorial, through the
+    CustomOp/CustomOpProp surface."""
+    import mxtpu.operator as op_mod
+
+    class Quadratic(op_mod.CustomOp):
+        def __init__(self, a):
+            self.a = a
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0]
+            self.assign(out_data[0], req[0], x * x * self.a)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            x = in_data[0]
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * x * (2.0 * self.a))
+
+    @op_mod.register("quadratic_test")
+    class QuadraticProp(op_mod.CustomOpProp):
+        def __init__(self, a="1.0"):
+            super().__init__(need_top_grad=True)
+            self.a = float(a)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Quadratic(self.a)
+
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    out = op_mod.Custom(x, op_type="quadratic_test", a="2.0")
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 8.0, 18.0])
+
+    x.attach_grad()
+    with autograd.record():
+        y = op_mod.Custom(x, op_type="quadratic_test", a="2.0")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 8.0, 12.0])
+
+
+def test_rtc_pallas_kernel():
+    import jax
+    import jax.numpy as jnp
+    import os
+    os.environ.setdefault("MXTPU_PALLAS", "interpret")
+    from mxtpu import rtc
+    with pytest.raises(mx.MXNetError):
+        rtc.CudaModule("__global__ void k() {}")
+
+    from jax.experimental import pallas as pl
+
+    def double_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    k = rtc.PallasKernel(
+        double_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        interpret=True)
+    x = nd.array(np.random.randn(8, 128).astype(np.float32))
+    out = k(x)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2.0,
+                               rtol=1e-6)
+
+
+def test_contrib_quantization():
+    from mxtpu.contrib import quantization as q
+    from mxtpu.io import NDArrayIter
+    X = np.random.uniform(-2, 3, (20, 4)).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(20), batch_size=5)
+    ranges = q.calib_minmax(it, num_batches=4)
+    assert "data" in ranges
+    lo, hi = ranges["data"]
+    assert lo <= X.min() + 1e-5 and hi >= X.max() - 1e-5
+
+    params = {"w": nd.array(np.random.randn(3, 3).astype(np.float32))}
+    qp, r = q.quantize_params(params)
+    assert qp["w"].asnumpy().dtype == np.int8
+
+
+def test_gluon_contrib_layers():
+    from mxtpu.gluon.contrib import nn as cnn
+    from mxtpu.gluon import nn
+    net = cnn.HybridConcurrent(axis=-1)
+    net.add(nn.Dense(3, flatten=False), nn.Dense(5, flatten=False),
+            cnn.Identity())
+    net.initialize(init="xavier")
+    x = nd.array(np.random.randn(2, 4).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 3 + 5 + 4)
+
+
+def test_util_helpers(tmp_path):
+    from mxtpu import utils
+    d = str(tmp_path / "a" / "b")
+    utils.makedirs(d)
+    utils.makedirs(d)  # idempotent
+    import os
+    assert os.path.isdir(d)

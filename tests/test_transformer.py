@@ -266,3 +266,22 @@ def test_remat_on_root_block():
     y = nd.array(np.zeros((4, 2), np.float32))
     losses = [float(step(x, y).asscalar()) for _ in range(5)]
     assert losses[-1] < losses[0]
+
+
+def test_set_remat_invalidates_hybridize_cache():
+    """Toggling remat after a hybridized call must not reuse the stale
+    executable (review regression)."""
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.block import HybridBlock
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize(init="xavier")
+    net.hybridize()
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    out1 = net(x).asnumpy()
+    n_entries = len(net._cached_entries)
+    assert n_entries == 1
+    net[0].set_remat(True)  # child toggle must invalidate parent cache
+    out2 = net(x).asnumpy()
+    assert len(net._cached_entries) == 2  # new generation, new entry
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)

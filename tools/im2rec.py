@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Pack an image folder or .lst file into RecordIO
+(reference ``tools/im2rec.py``†; output loads in both this framework
+and upstream MXNet — same wire format).
+
+  python tools/im2rec.py prefix image_root          # folder mode
+  python tools/im2rec.py prefix.lst image_root      # list mode
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu import recordio
+
+
+def list_images(root, exts=(".jpg", ".jpeg", ".png")):
+    """Yield (index, relpath, label) walking class subfolders
+    (reference ``list_image``†)."""
+    idx = 0
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    for label, cls in enumerate(classes):
+        for fname in sorted(os.listdir(os.path.join(root, cls))):
+            if os.path.splitext(fname)[1].lower() in exts:
+                yield idx, os.path.join(cls, fname), float(label)
+                idx += 1
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), parts[-1], float(parts[1])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("prefix", help="output prefix or existing .lst file")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge")
+    p.add_argument("--encoding", default=".jpg")
+    args = p.parse_args()
+
+    if args.prefix.endswith(".lst"):
+        items = list(read_list(args.prefix))
+        prefix = args.prefix[:-4]
+    else:
+        items = list(list_images(args.root))
+        prefix = args.prefix
+        with open(prefix + ".lst", "w") as f:
+            for idx, rel, label in items:
+                f.write(f"{idx}\t{label}\t{rel}\n")
+
+    import cv2
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    for idx, rel, label in items:
+        img = cv2.imread(os.path.join(args.root, rel))
+        if img is None:
+            print(f"skip unreadable {rel}", file=sys.stderr)
+            continue
+        if args.resize:
+            h, w = img.shape[:2]
+            scale = args.resize / min(h, w)
+            img = cv2.resize(img, (int(w * scale), int(h * scale)))
+        packed = recordio.pack_img(
+            recordio.IRHeader(0, label, idx, 0), img,
+            quality=args.quality, img_fmt=args.encoding)
+        rec.write_idx(idx, packed)
+    rec.close()
+    print(f"wrote {len(items)} records to {prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()

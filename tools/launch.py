@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Multi-host job launcher (reference ``tools/launch.py``† +
+dmlc_tracker).
+
+The reference spawns a ps-lite scheduler + servers + workers over
+ssh/mpi and wires ``DMLC_*`` env.  The TPU-native job is SPMD: every
+host runs the SAME program and ``jax.distributed.initialize`` forms
+the mesh, so the launcher's job collapses to exporting the
+coordination env and execing one process per host (SURVEY §5.8).
+
+Local simulation of an N-process cluster (the reference's
+``--launcher local`` trick, SURVEY §4.5):
+
+  python tools/launch.py -n 4 --launcher local python train.py
+
+Real multi-host: run on each host with --host-rank set (or under your
+scheduler, e.g. one task per host):
+
+  python tools/launch.py -n 16 --coordinator host0:1234 \
+      --host-rank $RANK python train.py
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", "--num-processes", type=int, required=True,
+                   help="total hosts (processes) in the job")
+    p.add_argument("--coordinator", default="127.0.0.1:49375",
+                   help="coordinator address host:port")
+    p.add_argument("--host-rank", type=int, default=None)
+    p.add_argument("--launcher", choices=("local", "env"),
+                   default="env")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    if not args.command:
+        p.error("no command given")
+
+    base_env = dict(os.environ)
+    base_env["MXTPU_COORDINATOR"] = args.coordinator
+    base_env["MXTPU_NUM_PROCESSES"] = str(args.num_processes)
+    # jax.distributed.initialize() reads these directly
+    base_env["JAX_COORDINATOR_ADDRESS"] = args.coordinator
+    base_env["JAX_NUM_PROCESSES"] = str(args.num_processes)
+
+    if args.launcher == "local":
+        # N local processes, each pretending to be one host — the
+        # distributed test harness (no real multi-chip needed)
+        procs = []
+        for rank in range(args.num_processes):
+            env = dict(base_env)
+            env["JAX_PROCESS_ID"] = str(rank)
+            env["MXTPU_PROCESS_ID"] = str(rank)
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for proc in procs:
+            rc |= proc.wait()
+        sys.exit(rc)
+
+    rank = args.host_rank
+    if rank is None:
+        p.error("--host-rank required with --launcher env (or use "
+                "--launcher local)")
+    base_env["JAX_PROCESS_ID"] = str(rank)
+    base_env["MXTPU_PROCESS_ID"] = str(rank)
+    os.execvpe(args.command[0], args.command, base_env)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Parse training logs into (epoch, train-acc, val-acc, samples/sec)
+rows (reference ``tools/parse_log.py``†).
+
+  python tools/parse_log.py train.log
+"""
+import argparse
+import re
+import sys
+
+TRAIN = re.compile(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.]+)")
+VAL = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.]+)")
+SPEED = re.compile(r"Epoch\[(\d+)\].*Speed: ([\d.]+) samples/sec")
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = TRAIN.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})[
+                "train-" + m.group(2)] = float(m.group(3))
+        m = VAL.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})[
+                "val-" + m.group(2)] = float(m.group(3))
+        m = SPEED.search(line)
+        if m:
+            row = rows.setdefault(int(m.group(1)), {})
+            row.setdefault("speeds", []).append(float(m.group(2)))
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    cols = sorted({k for r in rows.values() for k in r if k != "speeds"})
+    print("\t".join(["epoch"] + cols + ["samples/sec"]))
+    for epoch in sorted(rows):
+        row = rows[epoch]
+        speeds = row.get("speeds", [])
+        avg = sum(speeds) / len(speeds) if speeds else float("nan")
+        print("\t".join([str(epoch)] +
+                        [f"{row.get(c, float('nan')):.4f}"
+                         for c in cols] + [f"{avg:.1f}"]))
+
+
+if __name__ == "__main__":
+    main()
