@@ -5,6 +5,13 @@ import pytest
 import mxtpu as mx
 from mxtpu import autograd, nd
 
+import jax as _jax
+
+# backend-aware tolerance: MXU bf16-pass matmuls / TPU transcendentals
+# don't match exact-f32 numpy refs to 1e-5 (SURVEY §7 hard-part 9)
+_RTOL = 1e-2 if _jax.default_backend() != "cpu" else 1e-5
+_RTOL6 = 1e-4 if _jax.default_backend() != "cpu" else 1e-6
+
 
 def test_simple_backward():
     x = nd.array([1.0, 2.0, 3.0])
@@ -23,7 +30,7 @@ def test_chain():
         z = y.sum()
     z.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()),
-                               rtol=1e-6)
+                               rtol=_RTOL6)
 
 
 def test_two_inputs():
@@ -56,9 +63,9 @@ def test_dot_grad():
         c = nd.dot(a, b).sum()
     c.backward()
     np.testing.assert_allclose(a.grad.asnumpy(),
-                               np.ones((3, 2)) @ b.asnumpy().T, rtol=1e-5)
+                               np.ones((3, 2)) @ b.asnumpy().T, rtol=_RTOL)
     np.testing.assert_allclose(b.grad.asnumpy(),
-                               a.asnumpy().T @ np.ones((3, 2)), rtol=1e-5)
+                               a.asnumpy().T @ np.ones((3, 2)), rtol=_RTOL)
 
 
 def test_grad_add_req():
@@ -151,7 +158,7 @@ def test_custom_function():
         y = f(x)
     y.backward()
     s = 1 / (1 + np.exp(-x.asnumpy()))
-    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=_RTOL)
 
 
 def test_multi_output_split_grad():

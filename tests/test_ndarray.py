@@ -6,6 +6,13 @@ import pytest
 import mxtpu as mx
 from mxtpu import nd
 
+import jax as _jax
+
+# backend-aware tolerance: MXU bf16-pass matmuls / TPU transcendentals
+# don't match exact-f32 numpy refs to 1e-5 (SURVEY §7 hard-part 9)
+_RTOL = 1e-2 if _jax.default_backend() != "cpu" else 1e-5
+_RTOL6 = 1e-4 if _jax.default_backend() != "cpu" else 1e-6
+
 
 def test_creation():
     a = nd.array([[1, 2], [3, 4]])
@@ -105,13 +112,13 @@ def test_dot():
     b = nd.array(np.random.rand(4, 5).astype(np.float32))
     c = nd.dot(a, b)
     np.testing.assert_allclose(c.asnumpy(),
-                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+                               a.asnumpy() @ b.asnumpy(), rtol=_RTOL)
     d = nd.dot(a, b, transpose_a=False, transpose_b=False)
     assert d.shape == (3, 5)
     bt = nd.array(np.random.rand(5, 4).astype(np.float32))
     np.testing.assert_allclose(
         nd.dot(a, bt, transpose_b=True).asnumpy(),
-        a.asnumpy() @ bt.asnumpy().T, rtol=1e-5)
+        a.asnumpy() @ bt.asnumpy().T, rtol=_RTOL)
 
 
 def test_concat_stack_split():
@@ -137,13 +144,13 @@ def test_broadcast_ops():
 def test_unary_math():
     x = nd.array([0.5, 1.0, 2.0])
     np.testing.assert_allclose(nd.exp(x).asnumpy(),
-                               np.exp(x.asnumpy()), rtol=1e-6)
+                               np.exp(x.asnumpy()), rtol=_RTOL6)
     np.testing.assert_allclose(nd.log(x).asnumpy(),
-                               np.log(x.asnumpy()), rtol=1e-6)
+                               np.log(x.asnumpy()), rtol=_RTOL6)
     np.testing.assert_allclose(nd.sqrt(x).asnumpy(),
-                               np.sqrt(x.asnumpy()), rtol=1e-6)
+                               np.sqrt(x.asnumpy()), rtol=_RTOL6)
     np.testing.assert_allclose(nd.sigmoid(x).asnumpy(),
-                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=_RTOL6)
     np.testing.assert_allclose(nd.relu(nd.array([-1.0, 1.0])).asnumpy(),
                                [0, 1])
 
@@ -224,7 +231,7 @@ def test_dtype_propagation():
 
 def test_norm_pad_tile():
     x = nd.array([[3.0, 4.0]])
-    np.testing.assert_allclose(nd.norm(x).asnumpy(), [5.0], rtol=1e-6)
+    np.testing.assert_allclose(nd.norm(x).asnumpy(), [5.0], rtol=_RTOL6)
     p = nd.pad(nd.ones((1, 1, 2, 2)), mode="constant",
                pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=0.0)
     assert p.shape == (1, 1, 4, 4)

@@ -1,10 +1,26 @@
 """NN operator tests — modeled on tests/python/unittest/test_operator.py†
-(the reference's largest test file).  Numpy references computed inline."""
+(the reference's largest test file).  Numpy references computed inline.
+
+Tolerances are backend-aware (test_utils tables): on the real chip the
+MXU evaluates f32 matmuls/convs in bf16 passes, so exact-f32 numpy refs
+match only to ~1e-2 relative — the check_consistency discipline
+(SURVEY §7 hard-part 9)."""
 import numpy as np
 import pytest
 
+import jax
+
 import mxtpu as mx
 from mxtpu import autograd, nd
+
+_ACCEL = jax.default_backend() != "cpu"
+RTOL = 1e-2 if _ACCEL else 1e-5
+ATOL = 1e-3 if _ACCEL else 1e-6
+
+
+def _close(a, b, rtol=None, atol=None):
+    np.testing.assert_allclose(a, b, rtol=rtol or RTOL,
+                               atol=atol or ATOL)
 
 
 def test_fully_connected():
@@ -13,7 +29,7 @@ def test_fully_connected():
     b = nd.array(np.random.rand(5).astype(np.float32))
     y = nd.FullyConnected(x, w, b, num_hidden=5)
     ref = x.asnumpy().reshape(2, 12) @ w.asnumpy().T + b.asnumpy()
-    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+    _close(y.asnumpy(), ref)
     y2 = nd.FullyConnected(nd.array(np.random.rand(2, 12).astype(np.float32)),
                            w, num_hidden=5, no_bias=True)
     assert y2.shape == (2, 5)
@@ -44,7 +60,7 @@ def test_convolution_value():
     w[0, 0, 1, 1] = 1.0
     y = nd.Convolution(x, nd.array(w), kernel=(3, 3), num_filter=1,
                        pad=(1, 1), no_bias=True)
-    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-5)
+    _close(y.asnumpy(), x.asnumpy())
 
 
 def test_grouped_and_1d_conv():
@@ -85,15 +101,15 @@ def test_activation_family():
         nd.Activation(x, act_type="relu").asnumpy(), [0, 0, 0, 1])
     np.testing.assert_allclose(
         nd.Activation(x, act_type="tanh").asnumpy(),
-        np.tanh(x.asnumpy()), rtol=1e-6)
+        np.tanh(x.asnumpy()), rtol=RTOL)
     np.testing.assert_allclose(
         nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
         np.where(x.asnumpy() > 0, x.asnumpy(), 0.1 * x.asnumpy()),
-        rtol=1e-6)
+        rtol=RTOL)
     np.testing.assert_allclose(
         nd.LeakyReLU(x, act_type="elu", slope=1.0).asnumpy(),
         np.where(x.asnumpy() > 0, x.asnumpy(),
-                 np.exp(x.asnumpy()) - 1), rtol=1e-5)
+                 np.exp(x.asnumpy()) - 1), rtol=RTOL)
     g = nd.LeakyReLU(x, act_type="gelu")
     assert g.shape == x.shape
 
@@ -101,15 +117,14 @@ def test_activation_family():
 def test_softmax_ops():
     x = nd.array(np.random.rand(3, 5).astype(np.float32))
     s = nd.softmax(x)
-    np.testing.assert_allclose(s.asnumpy().sum(axis=1), np.ones(3),
-                               rtol=1e-5)
+    _close(s.asnumpy().sum(axis=1), np.ones(3))
     ls = nd.log_softmax(x)
-    np.testing.assert_allclose(np.exp(ls.asnumpy()), s.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(np.exp(ls.asnumpy()), s.asnumpy(), rtol=RTOL)
     lbl = nd.array([1.0, 0.0, 4.0])
     ce = nd.softmax_cross_entropy(x, lbl)
     ref = -np.sum(np.log(s.asnumpy())[np.arange(3),
                                       lbl.asnumpy().astype(int)])
-    np.testing.assert_allclose(ce.asnumpy(), ref, rtol=1e-5)
+    _close(ce.asnumpy(), ref)
 
 
 def test_layernorm():
@@ -118,7 +133,7 @@ def test_layernorm():
     b = nd.zeros((6,))
     y = nd.LayerNorm(x, g, b)
     out = y.asnumpy()
-    np.testing.assert_allclose(out.mean(axis=1), np.zeros(4), atol=1e-5)
+    _close(out.mean(axis=1), np.zeros(4))
     np.testing.assert_allclose(out.std(axis=1), np.ones(4), atol=1e-2)
 
 
@@ -147,7 +162,7 @@ def test_dropout():
     frac = (y.asnumpy() == 0).mean()
     assert 0.3 < frac < 0.7
     kept = y.asnumpy()[y.asnumpy() != 0]
-    np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept), rtol=1e-5)
+    _close(kept, 2.0 * np.ones_like(kept))
     # eval mode: identity
     y2 = nd.Dropout(x, p=0.5)
     np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
@@ -175,8 +190,8 @@ def test_batch_dot():
     a = nd.array(np.random.rand(4, 2, 3).astype(np.float32))
     b = nd.array(np.random.rand(4, 3, 5).astype(np.float32))
     c = nd.batch_dot(a, b)
-    np.testing.assert_allclose(c.asnumpy(),
-                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    _close(c.asnumpy(),
+                               a.asnumpy() @ b.asnumpy())
     ct = nd.batch_dot(a, nd.array(np.random.rand(4, 5, 3).astype(np.float32)),
                       transpose_b=True)
     assert ct.shape == (4, 2, 5)
@@ -264,7 +279,7 @@ def test_contrib_boxes():
                      dtype="float32")
     iou = contrib.box_iou(boxes, boxes)
     np.testing.assert_allclose(np.diag(iou.asnumpy()), np.ones(3),
-                               rtol=1e-5)
+                               rtol=RTOL)
     assert iou.asnumpy()[0, 2] == 0.0
     # NMS: identical boxes suppressed, far box kept
     data = nd.array([[0, 0.9, 0, 0, 2, 2],
@@ -278,6 +293,7 @@ def test_contrib_boxes():
     assert o[2, 1] == pytest.approx(0.7)
 
 
+@pytest.mark.skipif(_ACCEL, reason="finite differences need f64; run on CPU")
 def test_numeric_gradient_conv():
     """Finite-difference check of Convolution backward (VERDICT item 7;
     reference check_numeric_gradient over conv in test_operator.py†)."""
@@ -293,6 +309,7 @@ def test_numeric_gradient_conv():
                               atol=1e-3)
 
 
+@pytest.mark.skipif(_ACCEL, reason="finite differences need f64; run on CPU")
 def test_numeric_gradient_pool():
     from mxtpu import test_utils as tu
     sym = mx.sym.Pooling(mx.sym.var("x"), kernel=(2, 2), stride=(2, 2),
@@ -302,6 +319,7 @@ def test_numeric_gradient_pool():
                               atol=1e-3)
 
 
+@pytest.mark.skipif(_ACCEL, reason="finite differences need f64; run on CPU")
 def test_numeric_gradient_layernorm():
     from mxtpu import test_utils as tu
     sym = mx.sym.LayerNorm(mx.sym.var("x"), mx.sym.var("g"),
