@@ -60,6 +60,7 @@ def __getattr__(name):
         "contrib": ".contrib",
         "util": ".utils",
         "utils": ".utils",
+        "rnn": ".rnn",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

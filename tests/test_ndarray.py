@@ -9,8 +9,10 @@ from mxtpu import nd
 import jax as _jax
 
 # backend-aware tolerance: MXU bf16-pass matmuls / TPU transcendentals
-# don't match exact-f32 numpy refs to 1e-5 (SURVEY §7 hard-part 9)
-_RTOL = 1e-2 if _jax.default_backend() != "cpu" else 1e-5
+# don't match exact-f32 numpy refs to 1e-5 (SURVEY §7 hard-part 9);
+# matmul bound comes from the shared test_utils tables
+from mxtpu.test_utils import get_tolerance as _get_tol
+_RTOL = _get_tol(__import__("numpy").float32)[0]
 _RTOL6 = 1e-4 if _jax.default_backend() != "cpu" else 1e-6
 
 
