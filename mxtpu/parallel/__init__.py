@@ -30,7 +30,9 @@ from ..ndarray.ndarray import NDArray
 from ..ops.registry import get_op
 
 __all__ = ["make_mesh", "shard_batch", "replicate", "TrainStep",
-           "build_train_step", "Mesh", "PartitionSpec", "P"]
+           "build_train_step", "Mesh", "PartitionSpec", "P",
+           "spmd_pipeline", "stack_stage_params", "PipelineTrainStep",
+           "build_pipeline_train_step"]
 
 PartitionSpec = P
 
@@ -68,6 +70,16 @@ def replicate(mesh: Mesh, arr):
     out = jax.device_put(raw, NamedSharding(mesh, P()))
     return NDArray(out, None, _placed=True) if isinstance(arr, NDArray) \
         else out
+
+
+def _adam_bias_correction(opt, t: int) -> float:
+    """The raw ``adam_update`` op does not bias-correct; fold the
+    correction into the lr (single source for TrainStep AND
+    PipelineTrainStep)."""
+    if isinstance(opt, opt_mod.Adam) and t > 0:
+        return float(np.sqrt(1.0 - opt.beta2 ** t) /
+                     (1.0 - opt.beta1 ** t))
+    return 1.0
 
 
 # ----------------------------------------------------------------------
@@ -364,10 +376,7 @@ class TrainStep:
         opt = self.optimizer
         opt.num_update = self._t
         base_lr = opt.learning_rate
-        bias = 1.0
-        if isinstance(opt, opt_mod.Adam):
-            t = self._t
-            bias = np.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+        bias = _adam_bias_correction(opt, self._t)
         # Mults are read live (not cached at setup) so mid-training
         # changes to Parameter.lr_mult/wd_mult or optimizer.set_lr_mult
         # take effect on the next step — matching the eager Trainer.
@@ -400,3 +409,7 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
                      donate=donate, compute_dtype=compute_dtype,
                      cast_batch=cast_batch)
+
+
+from .pipeline import (spmd_pipeline, stack_stage_params,  # noqa: E402
+                       PipelineTrainStep, build_pipeline_train_step)
