@@ -65,15 +65,34 @@ class Trainer:
         Types 'local'/'device' map to in-graph reduction; with one
         device there is nothing to reduce."""
         if self._kvstore_type in (None, "nccl") or self._kv_initialized:
+            if not self._kv_initialized and self._compression_params:
+                raise MXNetError(
+                    f"compression_params given but kvstore="
+                    f"{self._kvstore_type!r} creates no store to carry "
+                    f"the compressed gradients")
             self._kv_initialized = True
             return
         try:
             from .. import kvstore as kv_mod
             self._kvstore = kv_mod.create(self._kvstore_type)
-            if self._kvstore is not None and self._kvstore.num_devices <= 1:
+            if self._kvstore is not None and self._kvstore.num_devices <= 1 \
+                    and not self._compression_params:
+                # with one device there is nothing to reduce — unless
+                # compression is requested, whose error-feedback
+                # quantization changes the update numerics even solo
                 self._kvstore = None
         except (ImportError, MXNetError):
             self._kvstore = None
+        if self._compression_params:
+            if self._kvstore is None:
+                # a silently-uncompressed run is worse than an error
+                raise MXNetError(
+                    "compression_params given but no kvstore is "
+                    f"available (type={self._kvstore_type!r})")
+            # outside the try: invalid compression params must raise,
+            # not silently disable the kvstore
+            self._kvstore.set_gradient_compression(
+                self._compression_params)
         self._kv_initialized = True
 
     # ------------------------------------------------------------------
