@@ -29,6 +29,7 @@ import warnings
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .base import MXNetError, _as_list
@@ -42,9 +43,6 @@ __all__ = ["KVStore", "create"]
 # ----------------------------------------------------------------------
 # gradient compression (reference ``src/kvstore/gradient_compression.cc``†)
 # ----------------------------------------------------------------------
-import jax.numpy as jnp
-
-
 @jax.jit
 def _quantize_2bit(g, residual, threshold):
     """2-bit quantization with error feedback: accumulate the residual,
@@ -75,6 +73,7 @@ class KVStore:
         self._optimizer = None
         self._compression = {}
         self._residuals: Dict[Any, jax.Array] = {}
+        self._slot_counts: Dict[Any, int] = {}
 
     # ------------------------------------------------------------------
     @property
@@ -101,6 +100,10 @@ class KVStore:
                 continue
             vv = _as_list(v)[0]
             self._store[k] = vv.copy()
+            # fresh key = fresh compression state
+            self._slot_counts.pop(k, None)
+            for rk in [rk for rk in self._residuals if rk[0] == k]:
+                del self._residuals[rk]
 
     def push(self, key, value, priority: int = 0) -> None:
         """Reduce ``value`` (list = per-device grads) into the store;
@@ -109,6 +112,15 @@ class KVStore:
         for k, v in zip(keys, values):
             parts = _as_list(v)
             if self._compression:
+                nslots = self._slot_counts.setdefault(k, len(parts))
+                if nslots != len(parts):
+                    raise MXNetError(
+                        f"gradient compression: key {k!r} was pushed "
+                        f"with {nslots} device parts before, now "
+                        f"{len(parts)} — per-slot residuals would be "
+                        f"misattributed; call set_gradient_compression "
+                        f"again after a device-set change to reset "
+                        f"residuals")
                 parts = [self._compress(k, i, p)
                          for i, p in enumerate(parts)]
             reduced = parts[0]
@@ -167,6 +179,7 @@ class KVStore:
             # explicit empty request = no compression (old behaviour)
             self._compression = {}
             self._residuals.clear()
+            self._slot_counts.clear()
             return
         unknown = set(params) - {"type", "threshold"}
         if unknown:
@@ -187,11 +200,17 @@ class KVStore:
             raise MXNetError("compression threshold must be positive")
         self._compression = {"type": ctype, "threshold": threshold}
         self._residuals.clear()
+        self._slot_counts.clear()
 
     def _compress(self, key, slot, grad: NDArray) -> NDArray:
         raw = grad.data if isinstance(grad, NDArray) else jnp.asarray(grad)
         rk = (key, slot)
         res = self._residuals.get(rk)
+        if res is not None and res.shape != raw.shape:
+            raise MXNetError(
+                f"gradient compression: key {key!r} slot {slot} shape "
+                f"changed {res.shape} -> {raw.shape}; call "
+                f"set_gradient_compression again to reset residuals")
         res_raw = res if res is not None else jnp.zeros_like(raw)
         fn = _quantize_2bit if self._compression["type"] == "2bit" \
             else _quantize_1bit

@@ -17,6 +17,9 @@ from mxtpu.kernels.flash_attention import (attention_reference,
 @pytest.fixture(autouse=True)
 def _interpret(monkeypatch):
     monkeypatch.setenv("MXTPU_PALLAS", "interpret")
+    # force the blockwise backward kernels (auto mode would pick the
+    # AD-through-reference path at these small test shapes)
+    monkeypatch.setenv("MXTPU_FLASH_BWD", "pallas")
 
 
 def test_layer_norm_forward_parity():
@@ -146,6 +149,121 @@ def test_flash_attention_grad():
     for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(e),
                                    rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_flash_attention_grad_multiblock():
+    """Backward with several q and kv blocks (T=256 → 2×128 blocks),
+    causal and not — exercises the blockwise dq/dkv accumulation and
+    the causal block-skip in both backward kernels."""
+    rng = np.random.RandomState(9)
+    B, H, T, D = 1, 2, 256, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    do = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    for causal in (False, True):
+        def f(q, k, v):
+            return jnp.sum(_flash_attention_pallas(
+                q, k, v, causal, 1.0 / np.sqrt(D)) * do)
+
+        def f_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal) * do)
+
+        gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} causal={causal}")
+
+
+def test_flash_attention_grad_cross_lengths():
+    """Tk != Tq backward (cached decoding shapes), causal diagonal
+    offset included."""
+    rng = np.random.RandomState(10)
+    B, H, Tq, Tk, D = 1, 1, 8, 32, 8
+    q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+    do = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32))
+    for causal in (False, True):
+        def f(q, k, v):
+            return jnp.sum(_flash_attention_pallas(
+                q, k, v, causal, 1.0 / np.sqrt(D)) * do)
+
+        def f_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal) * do)
+
+        gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} causal={causal}")
+
+
+def test_flash_attention_causal_tq_gt_tk():
+    """Tq > Tk causal: the first Tq-Tk rows have NO visible key.
+    Convention: those rows output 0 with zero gradients (kernel and
+    reference agree); regression for the lse-sentinel-absorption bug
+    that inflated their backward by Tk×."""
+    rng = np.random.RandomState(12)
+    B, H, Tq, Tk, D = 1, 1, 16, 8, 8
+    q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+    do = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32))
+    got = _flash_attention_pallas(q, k, v, True, 1.0 / np.sqrt(D))
+    ref = attention_reference(q, k, v, True)
+    # fully-masked rows are exactly zero in both
+    assert np.all(np.asarray(got)[:, :, :Tq - Tk] == 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def f(q, k, v):
+        return jnp.sum(_flash_attention_pallas(
+            q, k, v, True, 1.0 / np.sqrt(D)) * do)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, True) * do)
+
+    gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+    # masked rows contribute zero dq
+    assert np.all(np.asarray(gp[0])[:, :, :Tq - Tk] == 0.0)
+
+
+def test_flash_attention_grad_dispatch_modes(monkeypatch):
+    """'auto' (→ ref path at small T) and 'ref' agree with 'pallas';
+    unknown modes raise.  Covers the dispatch predicate the autouse
+    fixture otherwise pins to 'pallas'."""
+    rng = np.random.RandomState(11)
+    B, H, T, D = 1, 1, 16, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    do = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+
+    def grads():
+        def f(q, k, v):
+            return jnp.sum(_flash_attention_pallas(
+                q, k, v, True, 1.0 / np.sqrt(D)) * do)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    results = {}
+    for mode in ("pallas", "auto", "ref"):
+        monkeypatch.setenv("MXTPU_FLASH_BWD", mode)
+        results[mode] = grads()
+    for mode in ("auto", "ref"):
+        for a, e in zip(results[mode], results["pallas"]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=1e-4, atol=1e-4)
+    monkeypatch.setenv("MXTPU_FLASH_BWD", "blockwise")
+    with pytest.raises(ValueError):
+        grads()
 
 
 def test_flash_attention_op():

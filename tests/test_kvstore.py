@@ -138,6 +138,19 @@ def test_compression_rejects_bad_params():
     assert kv._compression == {}
 
 
+def test_compression_slot_and_shape_guards():
+    kv = kv_mod.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", nd.zeros((2,)))
+    kv.push("g", [nd.ones((2,)), nd.ones((2,))])
+    with pytest.raises(MXNetError):  # part count changed
+        kv.push("g", nd.ones((2,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push("g", nd.ones((2,)))  # reset → new slot layout accepted
+    with pytest.raises(MXNetError):  # shape changed for a live residual
+        kv.push("g", nd.ones((3,)))
+
+
 def test_trainer_compression_without_store_raises():
     from mxtpu.gluon import Trainer, nn
     net = nn.Dense(1)
