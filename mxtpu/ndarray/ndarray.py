@@ -494,27 +494,52 @@ def waitall() -> None:
 
 # ----------------------------------------------------------------------
 # save / load — named-tensor checkpoint files
-# Reference format: dmlc::Stream binary with magic + names
-# (src/ndarray/ndarray.cc† Save/Load, used for .params).  We write an
-# ``MXTPU01`` container: header magic, then a numpy .npz payload; loaders
-# accept plain .npz/.npy too.  Binary parity with the 2018 dmlc stream is
-# a round-2 follow-up (documented divergence).
+# Two on-disk formats:
+#   * "legacy" — byte-parity with the reference's dmlc::Stream binary
+#     (src/ndarray/ndarray.cc† Save/Load, the .params format), so
+#     reference-era checkpoints interchange directly
+#     (mxtpu/ndarray/legacy_format.py);
+#   * "mxtpu" — MXTPU01 header + numpy .npz payload (the native
+#     container; loaders accept plain .npz/.npy too).
+# load() auto-detects by magic.  save() format: the ``format=`` arg,
+# else MXTPU_SAVE_FORMAT env, else by file extension (.params →
+# legacy), else mxtpu.
 # ----------------------------------------------------------------------
 _SAVE_MAGIC = b"MXTPU01\n"
 
 
-def save(fname: str, data) -> None:
+def _pick_format(fname: str, fmt) -> str:
+    from ..base import get_env
+    fmt = fmt or get_env("MXTPU_SAVE_FORMAT", None) or \
+        ("legacy" if fname.endswith(".params") else "mxtpu")
+    if fmt not in ("legacy", "mxtpu"):
+        raise MXNetError(f"unknown save format {fmt!r}; "
+                         f"choices: legacy, mxtpu")
+    return fmt
+
+
+def save(fname: str, data, format=None) -> None:
     if isinstance(data, NDArray):
-        payload = {"0": data.asnumpy()}
-    elif isinstance(data, (list, tuple)):
-        payload = {str(i): a.asnumpy() for i, a in enumerate(data)}
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        names, arrays = None, [a.asnumpy() for a in data]
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data.keys())
+        arrays = [v.asnumpy() for v in data.values()]
     else:
         raise MXNetError("save expects NDArray, list or dict of NDArray")
+    if _pick_format(fname, format) == "legacy":
+        from . import legacy_format
+        blob = legacy_format.dumps(
+            arrays if names is None else dict(zip(names, arrays)))
+        with open(fname, "wb") as f:
+            f.write(blob)
+        return
     import io as _io
     buf = _io.BytesIO()
-    np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+    if names is None:
+        names = [str(i) for i in range(len(arrays))]
+    np.savez(buf, **dict(zip(names, arrays)))
     with open(fname, "wb") as f:
         f.write(_SAVE_MAGIC)
         f.write(buf.getvalue())
@@ -522,12 +547,17 @@ def save(fname: str, data) -> None:
 
 def load(fname: str):
     with open(fname, "rb") as f:
-        head = f.read(len(_SAVE_MAGIC))
-        rest = f.read()
+        blob = f.read()
+    from . import legacy_format
+    if legacy_format.is_legacy(blob[:8]):
+        arrays, names = legacy_format.loads(blob)
+        if names:
+            return {n: array(a) for n, a in zip(names, arrays)}
+        return [array(a) for a in arrays]
     import io as _io
-    if head != _SAVE_MAGIC:
-        rest = head + rest
-    npz = np.load(_io.BytesIO(rest), allow_pickle=False)
+    if blob[:len(_SAVE_MAGIC)] == _SAVE_MAGIC:
+        blob = blob[len(_SAVE_MAGIC):]
+    npz = np.load(_io.BytesIO(blob), allow_pickle=False)
     keys = list(npz.keys())
     if all(k.isdigit() for k in keys):
         # list payloads always load as a list, even length-1, matching

@@ -1,0 +1,157 @@
+"""Reference-binary .params serialization: byte-layout pinning,
+round-trips, auto-detection, V3 read support, error paths.
+
+Reference: ``src/ndarray/ndarray.cc``† Save/Load + ``MXNDArraySave``†
+framing.  The golden-bytes test pins the exact dmlc::Stream layout so
+a refactor can't silently break interchange.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from mxtpu import nd
+from mxtpu.base import MXNetError
+from mxtpu.ndarray import legacy_format as lf
+
+
+def test_golden_bytes_layout():
+    """Byte-for-byte: one named f32 (2,3) array."""
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    blob = lf.dumps({"w": a})
+    expect = b"".join([
+        struct.pack("<QQ", 0x112, 0),          # list magic + reserved
+        struct.pack("<Q", 1),                  # one array
+        struct.pack("<I", 0xF993FAC9),         # V2 magic
+        struct.pack("<i", 0),                  # dense stype
+        struct.pack("<I", 2),                  # ndim
+        struct.pack("<II", 2, 3),              # dims (uint32)
+        struct.pack("<ii", 1, 0),              # cpu(0) context
+        struct.pack("<i", 0),                  # type_flag f32
+        a.tobytes(),                           # raw LE payload
+        struct.pack("<Q", 1),                  # one name
+        struct.pack("<Q", 1), b"w",            # name record
+    ])
+    assert blob == expect
+
+
+def test_roundtrip_dict_and_list(tmp_path):
+    rng = np.random.RandomState(0)
+    # (f64/i64 through NDArray downcast to f32/i32 — jax x64 is off;
+    # the format itself round-trips all flags, see
+    # test_all_type_flags_roundtrip)
+    d = {"arg:fc1_weight": rng.randn(4, 5).astype(np.float32),
+         "aux:bn_mean": rng.randn(5).astype(np.float32),
+         "idx": np.arange(7, dtype=np.int32)}
+    f = str(tmp_path / "net.params")
+    nd.save(f, {k: nd.array(v) for k, v in d.items()})
+    # .params extension → legacy binary on disk
+    with open(f, "rb") as fh:
+        assert lf.is_legacy(fh.read(8))
+    back = nd.load(f)
+    assert set(back) == set(d)
+    for k in d:
+        np.testing.assert_array_equal(back[k].asnumpy(), d[k])
+        assert back[k].dtype == d[k].dtype
+    # anonymous list save
+    f2 = str(tmp_path / "list.params")
+    nd.save(f2, [nd.array(d["idx"]), nd.ones((2, 2))])
+    back2 = nd.load(f2)
+    assert isinstance(back2, list) and len(back2) == 2
+    np.testing.assert_array_equal(back2[0].asnumpy(), d["idx"])
+
+
+def test_all_type_flags_roundtrip():
+    rng = np.random.RandomState(1)
+    for dt in (np.float32, np.float64, np.float16, np.uint8, np.int32,
+               np.int8, np.int64):
+        a = (rng.randn(3, 4) * 10).astype(dt)
+        arrays, names = lf.loads(lf.dumps({"x": a}))
+        assert names == ["x"]
+        np.testing.assert_array_equal(arrays[0], a)
+        assert arrays[0].dtype == dt
+
+
+def test_scalar_and_empty_shapes():
+    for shape in ((), (0,), (3, 0, 2)):
+        a = np.ones(shape, np.float32)
+        arrays, _ = lf.loads(lf.dumps([a]))
+        assert arrays[0].shape == shape
+
+
+def test_v3_int64_dims_read():
+    """Streams written by later 1.x (V3 magic, int64 dims) load too."""
+    a = np.arange(12, dtype=np.int32).reshape(3, 4)
+    blob = b"".join([
+        struct.pack("<QQ", 0x112, 0),
+        struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FACA),         # V3 magic
+        struct.pack("<i", 0),
+        struct.pack("<I", 2),
+        struct.pack("<qq", 3, 4),              # int64 dims
+        struct.pack("<ii", 1, 0),
+        struct.pack("<i", 4),                  # type_flag i32
+        a.tobytes(),
+        struct.pack("<Q", 0),                  # anonymous
+    ])
+    arrays, names = lf.loads(blob)
+    assert names == []
+    np.testing.assert_array_equal(arrays[0], a)
+
+
+def test_mxtpu_format_still_default_for_other_extensions(tmp_path):
+    f = str(tmp_path / "x.ndarray")
+    nd.save(f, {"a": nd.ones((2,))})
+    with open(f, "rb") as fh:
+        assert fh.read(8) == b"MXTPU01\n"
+    back = nd.load(f)
+    np.testing.assert_array_equal(back["a"].asnumpy(), np.ones(2))
+
+
+def test_format_override(tmp_path):
+    f = str(tmp_path / "x.whatever")
+    nd.save(f, {"a": nd.ones((2,))}, format="legacy")
+    with open(f, "rb") as fh:
+        assert lf.is_legacy(fh.read(8))
+    assert nd.load(f)["a"].shape == (2,)
+    with pytest.raises(MXNetError):
+        nd.save(f, {"a": nd.ones((2,))}, format="msgpack")
+
+
+def test_error_paths():
+    with pytest.raises(MXNetError):  # truncated
+        lf.loads(lf.dumps({"x": np.ones((2, 2), np.float32)})[:-3])
+    with pytest.raises(MXNetError):  # wrong list magic
+        lf.loads(struct.pack("<QQQ", 0x113, 0, 0))
+    blob = bytearray(lf.dumps([np.ones((2,), np.float32)]))
+    blob[16:20] = struct.pack("<I", 0xDEAD)  # corrupt NDArray magic
+    with pytest.raises(MXNetError):
+        lf.loads(bytes(blob))
+    # V3 negative dim must raise, not silently mis-shape + rewind
+    bad = b"".join([
+        struct.pack("<QQQ", 0x112, 0, 1),
+        struct.pack("<I", 0xF993FACA), struct.pack("<i", 0),
+        struct.pack("<I", 2), struct.pack("<qq", 2, -1),
+        struct.pack("<ii", 1, 0), struct.pack("<i", 0),
+        struct.pack("<Q", 0),
+    ])
+    with pytest.raises(MXNetError):
+        lf.loads(bad)
+
+
+def test_gluon_save_parameters_interchange(tmp_path):
+    """save_parameters → .params now writes the reference binary and
+    round-trips through load_parameters."""
+    from mxtpu.gluon import nn
+    net = nn.Dense(3)
+    net.initialize(init="xavier")
+    net(nd.ones((2, 4)))
+    f = str(tmp_path / "dense.params")
+    net.save_parameters(f)
+    with open(f, "rb") as fh:
+        assert lf.is_legacy(fh.read(8))
+    net2 = nn.Dense(3)
+    net2.load_parameters(f)
+    np.testing.assert_array_equal(
+        net2(nd.ones((2, 4))).asnumpy(),
+        net(nd.ones((2, 4))).asnumpy())
