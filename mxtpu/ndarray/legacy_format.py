@@ -129,9 +129,18 @@ def _read_arr(r: _Reader) -> np.ndarray:
     if np_dtype is None:
         raise MXNetError(f"unknown type_flag {flag} in .params")
     size = int(np.prod(shape, dtype=np.int64)) if ndim else 1
-    raw = r.take(size * np.dtype(np_dtype).itemsize)
-    return np.frombuffer(raw, dtype=np.dtype(np_dtype).newbyteorder("<")) \
-        .reshape(shape).astype(np_dtype)
+    dt = np.dtype(np_dtype).newbyteorder("<")
+    nbytes = size * dt.itemsize
+    if r.pos + nbytes > len(r.data):
+        raise MXNetError(
+            f"truncated .params stream at byte {r.pos} "
+            f"(wanted {nbytes} more of {len(r.data)})")
+    # zero-copy view into the blob (converted only on big-endian hosts)
+    arr = np.frombuffer(r.data, dtype=dt, count=size, offset=r.pos)
+    r.pos += nbytes
+    if arr.dtype != np.dtype(np_dtype):
+        arr = arr.astype(np_dtype)
+    return arr.reshape(shape)
 
 
 def dumps(payload: Union[Dict[str, np.ndarray],

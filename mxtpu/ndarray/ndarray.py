@@ -555,9 +555,10 @@ def load(fname: str):
             return {n: array(a) for n, a in zip(names, arrays)}
         return [array(a) for a in arrays]
     import io as _io
+    buf = _io.BytesIO(blob)  # copy-on-write wrap — no duplication
     if blob[:len(_SAVE_MAGIC)] == _SAVE_MAGIC:
-        blob = blob[len(_SAVE_MAGIC):]
-    npz = np.load(_io.BytesIO(blob), allow_pickle=False)
+        buf.seek(len(_SAVE_MAGIC))
+    npz = np.load(buf, allow_pickle=False)
     keys = list(npz.keys())
     if all(k.isdigit() for k in keys):
         # list payloads always load as a list, even length-1, matching

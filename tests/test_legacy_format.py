@@ -124,7 +124,8 @@ def test_error_paths():
     with pytest.raises(MXNetError):  # wrong list magic
         lf.loads(struct.pack("<QQQ", 0x113, 0, 0))
     blob = bytearray(lf.dumps([np.ones((2,), np.float32)]))
-    blob[16:20] = struct.pack("<I", 0xDEAD)  # corrupt NDArray magic
+    # NDArray magic sits at byte 24 (8 list magic + 8 reserved + 8 n)
+    blob[24:28] = struct.pack("<I", 0xDEAD)
     with pytest.raises(MXNetError):
         lf.loads(bytes(blob))
     # V3 negative dim must raise, not silently mis-shape + rewind

@@ -1,0 +1,93 @@
+"""SSD detector: shapes, anchor/predictor consistency, training step
+on a synthetic localization task, end-to-end detect().
+
+Reference: ``example/ssd/``† (training recipe), multibox op tests
+(``tests/python/unittest/test_operator.py†`` multibox cases).
+"""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd, autograd
+from mxtpu.models.ssd import SSDLoss, toy_ssd
+
+
+def _synthetic_batch(rng, n=4, size=64):
+    """Images with one bright square; label = its box, class 0."""
+    x = rng.rand(n, 3, size, size).astype(np.float32) * 0.1
+    labels = np.zeros((n, 1, 5), np.float32)
+    for i in range(n):
+        w = rng.randint(size // 4, size // 2)
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - w)
+        x[i, :, y0:y0 + w, x0:x0 + w] = 1.0
+        labels[i, 0] = [0, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + w) / size]
+    return nd.array(x), nd.array(labels)
+
+
+def test_ssd_output_shapes():
+    mx.random.seed(0)
+    net = toy_ssd(num_classes=2)
+    net.initialize(init="xavier")
+    x = nd.zeros((2, 3, 64, 64))
+    anchors, cls_preds, box_preds = net(x)
+    A = anchors.shape[1]
+    assert anchors.shape == (1, A, 4)
+    assert cls_preds.shape == (2, 3, A)  # classes+1 = 3
+    assert box_preds.shape == (2, A * 4)
+    # anchors within ±margin of the unit square (edge anchors overhang)
+    a = anchors.asnumpy()
+    assert a.min() > -1.0 and a.max() < 2.0
+
+
+def test_ssd_train_step_decreases_loss():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = toy_ssd(num_classes=1)
+    net.initialize(init="xavier")
+    from mxtpu.gluon import Trainer
+    x, labels = _synthetic_batch(rng)
+    net(x)
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 5e-3})
+    loss_fn = SSDLoss()
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+            l = loss_fn(cls_preds, box_preds, ct, bt, bm)
+            l = nd.mean(l)
+        l.backward()
+        trainer.step(batch_size=x.shape[0])
+        losses.append(float(l.asscalar()))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_ssd_detect_end_to_end():
+    mx.random.seed(0)
+    net = toy_ssd(num_classes=2)
+    net.initialize(init="xavier")
+    out = net.detect(nd.zeros((1, 3, 64, 64)))
+    o = out.asnumpy()
+    assert o.ndim == 3 and o.shape[2] == 6
+    # every row is either suppressed (-1) or [cls, score, box] with
+    # score in [0,1]
+    kept = o[0][o[0, :, 0] >= 0]
+    if len(kept):
+        assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()
+
+
+def test_ssd_hybridize_matches_imperative():
+    mx.random.seed(3)
+    net = toy_ssd(num_classes=1)
+    net.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(1)
+                 .randn(2, 3, 64, 64).astype(np.float32))
+    a0, c0, b0 = net(x)
+    net.hybridize()
+    a1, c1, b1 = net(x)
+    for e, g in ((a0, a1), (c0, c1), (b0, b1)):
+        np.testing.assert_allclose(e.asnumpy(), g.asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
