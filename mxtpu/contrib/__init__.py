@@ -1,18 +1,19 @@
 """``mx.contrib`` (reference ``python/mxnet/contrib/``†):
-quantization calibration + ndarray contrib re-exports.  (ONNX
-import/export is not implemented; ``onnx`` raises with guidance.)"""
+quantization calibration, ONNX interchange, ndarray contrib
+re-exports."""
 from . import quantization
 from ..ndarray import contrib as ndarray  # mx.contrib.ndarray.* ops
 
-__all__ = ["quantization", "ndarray"]
+__all__ = ["quantization", "ndarray", "onnx"]
 
 
 def __getattr__(name):
     if name == "onnx":
-        from ..base import MXNetError
-        raise MXNetError(
-            "contrib.onnx import/export is not implemented in this "
-            "build; export via Block.export (native symbol.json + "
-            "params) instead")
+        # NOT `from . import onnx` — the fromlist getattr would
+        # re-enter this hook and recurse
+        import importlib
+        mod = importlib.import_module(__name__ + ".onnx")
+        globals()["onnx"] = mod
+        return mod
     raise AttributeError(f"module 'mxtpu.contrib' has no attribute "
                          f"{name!r}")

@@ -1,0 +1,16 @@
+"""``mx.contrib.onnx`` — ONNX interchange (reference
+``python/mxnet/contrib/onnx/``†), self-contained: the protobuf wire
+format is spoken directly (``_proto``), so neither the ``onnx`` nor
+``protobuf`` package is required.
+
+``export_model(sym, params, input_shape, ...)`` writes a real
+``.onnx`` file; ``import_model(path)`` returns ``(sym, arg_params,
+aux_params)``; ``get_model_metadata(path)`` lists graph inputs/
+outputs — the reference ``onnx_mxnet`` surface.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import get_model_metadata, import_graph, import_model
+
+# reference alias: `from mxnet.contrib import onnx as onnx_mxnet`
+__all__ = ["export_model", "import_model", "import_graph",
+           "get_model_metadata"]

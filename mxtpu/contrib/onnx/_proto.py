@@ -1,0 +1,519 @@
+"""Minimal ONNX protobuf wire-format codec — no ``onnx``/``protobuf``
+dependency (neither is baked into this image as an importable onnx
+package; protobuf wire format is simple enough to speak directly).
+
+Implements exactly the subset of ``onnx/onnx.proto``† needed for model
+interchange: ModelProto / GraphProto / NodeProto / AttributeProto /
+TensorProto / ValueInfoProto / TypeProto.Tensor / TensorShapeProto /
+OperatorSetIdProto, with the official field numbers and proto3
+semantics (packed repeated scalars accepted in both packed and
+unpacked encodings on read).  The test suite cross-checks this codec
+against a protoc-compiled oracle of the same schema.
+
+Messages are represented as plain Python objects (SimpleNamespace-like
+dataclasses) — enough structure for the mx2onnx/onnx2mx converters.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+
+# TensorProto.DataType enum (onnx.proto†)
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+
+NP_TO_ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.uint8): UINT8,
+              np.dtype(np.int8): INT8, np.dtype(np.uint16): UINT16,
+              np.dtype(np.int16): INT16, np.dtype(np.int32): INT32,
+              np.dtype(np.int64): INT64, np.dtype(np.bool_): BOOL,
+              np.dtype(np.float16): FLOAT16,
+              np.dtype(np.float64): DOUBLE,
+              np.dtype(np.uint32): UINT32, np.dtype(np.uint64): UINT64}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ----------------------------------------------------------------------
+# wire primitives
+# ----------------------------------------------------------------------
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit (proto int64)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(fieldnum: int, wire: int) -> bytes:
+    return _varint((fieldnum << 3) | wire)
+
+
+def _len_delim(fieldnum: int, payload: bytes) -> bytes:
+    return _tag(fieldnum, 2) + _varint(len(payload)) + payload
+
+
+def _f_varint(fieldnum: int, n: int) -> bytes:
+    return _tag(fieldnum, 0) + _varint(n)
+
+
+def _f_string(fieldnum: int, s) -> bytes:
+    return _len_delim(fieldnum,
+                      s.encode("utf-8") if isinstance(s, str) else s)
+
+
+def _f_float(fieldnum: int, v: float) -> bytes:
+    return _tag(fieldnum, 5) + struct.pack("<f", v)
+
+
+def _packed_varints(fieldnum: int, vals) -> bytes:
+    return _len_delim(fieldnum, b"".join(_varint(v) for v in vals))
+
+
+class _Dec:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.p = 0
+
+    def varint(self) -> int:
+        r = s = 0
+        while True:
+            if self.p >= len(self.d):
+                raise MXNetError("truncated protobuf varint")
+            b = self.d[self.p]
+            self.p += 1
+            r |= (b & 0x7F) << s
+            if not b & 0x80:
+                if r >= 1 << 63:
+                    r -= 1 << 64
+                return r
+            s += 7
+
+    def bytes_(self) -> bytes:
+        ln = self.varint()
+        if ln < 0 or self.p + ln > len(self.d):
+            raise MXNetError("truncated protobuf bytes field")
+        b = self.d[self.p:self.p + ln]
+        self.p += ln
+        return b
+
+    def skip(self, wire: int) -> None:
+        if wire == 0:
+            self.varint()
+        elif wire == 1:
+            self.p += 8
+        elif wire == 2:
+            self.bytes_()
+        elif wire == 5:
+            self.p += 4
+        else:
+            raise MXNetError(f"unsupported protobuf wire type {wire}")
+
+    def fields(self):
+        while self.p < len(self.d):
+            key = self.varint()
+            yield key >> 3, key & 7
+
+    def packed_varints(self) -> List[int]:
+        sub = _Dec(self.bytes_())
+        out = []
+        while sub.p < len(sub.d):
+            out.append(sub.varint())
+        return out
+
+    def fixed32(self) -> float:
+        v = struct.unpack("<f", self.d[self.p:self.p + 4])[0]
+        self.p += 4
+        return v
+
+
+# ----------------------------------------------------------------------
+# message model
+# ----------------------------------------------------------------------
+@dataclass
+class Tensor:
+    name: str = ""
+    dims: Tuple[int, ...] = ()
+    data_type: int = FLOAT
+    raw_data: bytes = b""
+
+    def to_numpy(self) -> np.ndarray:
+        dt = ONNX_TO_NP.get(self.data_type)
+        if dt is None:
+            raise MXNetError(f"ONNX data_type {self.data_type} "
+                             f"unsupported")
+        size = int(np.prod(self.dims)) if self.dims else 1
+        if len(self.raw_data) != size * dt.itemsize:
+            raise MXNetError(
+                f"tensor {self.name!r}: payload {len(self.raw_data)}B "
+                f"does not match dims {self.dims} × {dt} (unsupported "
+                f"storage field or truncated stream)")
+        return np.frombuffer(self.raw_data,
+                             dtype=dt.newbyteorder("<")) \
+            .reshape(self.dims).astype(dt)
+
+    @staticmethod
+    def from_numpy(name: str, a: np.ndarray) -> "Tensor":
+        a = np.asarray(a)
+        dt = NP_TO_ONNX.get(np.dtype(a.dtype))
+        if dt is None:
+            raise MXNetError(f"dtype {a.dtype} unsupported in ONNX")
+        return Tensor(name=name, dims=tuple(a.shape), data_type=dt,
+                      raw_data=np.ascontiguousarray(a)
+                      .reshape(np.shape(a))
+                      .astype(np.dtype(a.dtype).newbyteorder("<"),
+                              copy=False).tobytes())
+
+    def encode(self) -> bytes:
+        out = [_packed_varints(1, self.dims) if self.dims else b"",
+               _f_varint(2, self.data_type),
+               _f_string(8, self.name),
+               _len_delim(9, self.raw_data)]
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "Tensor":
+        t = Tensor()
+        d = _Dec(data)
+        dims: List[int] = []
+        float_data: List[float] = []
+        double_data: List[float] = []
+        int_data: List[int] = []
+        for f, w in d.fields():
+            if f == 1 and w == 2:
+                dims.extend(d.packed_varints())
+            elif f == 1 and w == 0:
+                dims.append(d.varint())
+            elif f == 2:
+                t.data_type = d.varint()
+            elif f == 8:
+                t.name = d.bytes_().decode("utf-8")
+            elif f == 9:
+                t.raw_data = d.bytes_()
+            elif f == 4 and w == 2:  # packed float_data
+                sub = d.bytes_()
+                float_data.extend(
+                    struct.unpack(f"<{len(sub) // 4}f", sub))
+            elif f == 4 and w == 5:
+                float_data.append(d.fixed32())
+            elif f == 10 and w == 2:  # packed double_data
+                sub = d.bytes_()
+                double_data.extend(
+                    struct.unpack(f"<{len(sub) // 8}d", sub))
+            elif f == 10 and w == 1:
+                double_data.append(struct.unpack(
+                    "<d", d.d[d.p:d.p + 8])[0])
+                d.p += 8
+            elif f in (5, 7, 11) and w == 2:  # int32/int64/uint64_data
+                int_data.extend(d.packed_varints())
+            elif f in (5, 7, 11) and w == 0:
+                int_data.append(d.varint())
+            else:
+                d.skip(w)
+        t.dims = tuple(dims)
+        if not t.raw_data and float_data:
+            t.raw_data = struct.pack(f"<{len(float_data)}f",
+                                     *float_data)
+        elif not t.raw_data and double_data:
+            t.raw_data = struct.pack(f"<{len(double_data)}d",
+                                     *double_data)
+        elif not t.raw_data and int_data:
+            if t.data_type == FLOAT16:
+                # onnx stores f16 as uint16 BIT PATTERNS in
+                # int32_data — reinterpret, don't convert numerically
+                t.raw_data = np.asarray(int_data, np.uint16).tobytes()
+            else:
+                np_dt = ONNX_TO_NP.get(t.data_type,
+                                       np.dtype(np.int64))
+                t.raw_data = np.asarray(int_data, np_dt).tobytes()
+        return t
+
+
+@dataclass
+class Attribute:
+    name: str = ""
+    type: int = 0
+    f: float = 0.0
+    i: int = 0
+    s: bytes = b""
+    floats: Tuple[float, ...] = ()
+    ints: Tuple[int, ...] = ()
+    strings: Tuple[bytes, ...] = ()
+    t: Optional[Tensor] = None
+
+    @property
+    def value(self) -> Any:
+        return {A_FLOAT: self.f, A_INT: self.i,
+                A_STRING: self.s.decode("utf-8"),
+                A_FLOATS: tuple(self.floats), A_INTS: tuple(self.ints),
+                A_STRINGS: tuple(x.decode("utf-8")
+                                 for x in self.strings),
+                A_TENSOR: self.t}.get(self.type)
+
+    @staticmethod
+    def make(name: str, value: Any) -> "Attribute":
+        a = Attribute(name=name)
+        if isinstance(value, bool):
+            a.type, a.i = A_INT, int(value)
+        elif isinstance(value, int):
+            a.type, a.i = A_INT, value
+        elif isinstance(value, float):
+            a.type, a.f = A_FLOAT, value
+        elif isinstance(value, str):
+            a.type, a.s = A_STRING, value.encode("utf-8")
+        elif isinstance(value, Tensor):
+            a.type, a.t = A_TENSOR, value
+        elif isinstance(value, (list, tuple)):
+            if all(isinstance(v, (int, bool)) for v in value):
+                a.type, a.ints = A_INTS, tuple(int(v) for v in value)
+            elif all(isinstance(v, (int, float)) for v in value):
+                a.type = A_FLOATS
+                a.floats = tuple(float(v) for v in value)
+            elif all(isinstance(v, str) for v in value):
+                a.type = A_STRINGS
+                a.strings = tuple(v.encode("utf-8") for v in value)
+            else:
+                raise MXNetError(f"mixed attribute list {value!r}")
+        else:
+            raise MXNetError(f"unsupported attribute {name}={value!r}")
+        return a
+
+    def encode(self) -> bytes:
+        out = [_f_string(1, self.name), _f_varint(20, self.type)]
+        if self.type == A_FLOAT:
+            out.append(_f_float(2, self.f))
+        elif self.type == A_INT:
+            out.append(_f_varint(3, self.i))
+        elif self.type == A_STRING:
+            out.append(_f_string(4, self.s))
+        elif self.type == A_TENSOR:
+            out.append(_len_delim(5, self.t.encode()))
+        elif self.type == A_FLOATS:
+            out.extend(_f_float(7, v) for v in self.floats)
+        elif self.type == A_INTS:
+            out.extend(_f_varint(8, v) for v in self.ints)
+        elif self.type == A_STRINGS:
+            out.extend(_f_string(9, v) for v in self.strings)
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "Attribute":
+        a = Attribute()
+        d = _Dec(data)
+        floats: List[float] = []
+        ints: List[int] = []
+        strings: List[bytes] = []
+        for f, w in d.fields():
+            if f == 1:
+                a.name = d.bytes_().decode("utf-8")
+            elif f == 20:
+                a.type = d.varint()
+            elif f == 2:
+                a.f = d.fixed32()
+            elif f == 3:
+                a.i = d.varint()
+            elif f == 4:
+                a.s = d.bytes_()
+            elif f == 5:
+                a.t = Tensor.decode(d.bytes_())
+            elif f == 7 and w == 5:
+                floats.append(d.fixed32())
+            elif f == 7 and w == 2:
+                sub = d.bytes_()
+                floats.extend(struct.unpack(f"<{len(sub) // 4}f", sub))
+            elif f == 8 and w == 0:
+                ints.append(d.varint())
+            elif f == 8 and w == 2:
+                ints.extend(d.packed_varints())
+            elif f == 9:
+                strings.append(d.bytes_())
+            else:
+                d.skip(w)
+        a.floats, a.ints, a.strings = (tuple(floats), tuple(ints),
+                                       tuple(strings))
+        return a
+
+
+@dataclass
+class Node:
+    op_type: str = ""
+    name: str = ""
+    inputs: Tuple[str, ...] = ()
+    outputs: Tuple[str, ...] = ()
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        out = [_f_string(1, s) for s in self.inputs]
+        out += [_f_string(2, s) for s in self.outputs]
+        out.append(_f_string(3, self.name))
+        out.append(_f_string(4, self.op_type))
+        out += [_len_delim(5, Attribute.make(k, v).encode())
+                for k, v in self.attributes.items()]
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "Node":
+        n = Node()
+        d = _Dec(data)
+        ins: List[str] = []
+        outs: List[str] = []
+        for f, w in d.fields():
+            if f == 1:
+                ins.append(d.bytes_().decode("utf-8"))
+            elif f == 2:
+                outs.append(d.bytes_().decode("utf-8"))
+            elif f == 3:
+                n.name = d.bytes_().decode("utf-8")
+            elif f == 4:
+                n.op_type = d.bytes_().decode("utf-8")
+            elif f == 5:
+                a = Attribute.decode(d.bytes_())
+                n.attributes[a.name] = a.value
+            else:
+                d.skip(w)
+        n.inputs, n.outputs = tuple(ins), tuple(outs)
+        return n
+
+
+def _encode_value_info(name: str, elem_type: int,
+                       shape: Tuple[Optional[int], ...]) -> bytes:
+    dims = b"".join(
+        _len_delim(1, _f_varint(1, d) if d is not None
+                   else _f_string(2, "?"))
+        for d in shape)
+    tensor_type = (_f_varint(1, elem_type) +
+                   _len_delim(2, dims))
+    return _f_string(1, name) + _len_delim(2, _len_delim(1, tensor_type))
+
+
+def _decode_value_info(data: bytes):
+    d = _Dec(data)
+    name, elem, shape = "", FLOAT, []
+    for f, w in d.fields():
+        if f == 1:
+            name = d.bytes_().decode("utf-8")
+        elif f == 2:
+            td = _Dec(d.bytes_())
+            for f2, w2 in td.fields():
+                if f2 == 1 and w2 == 2:  # tensor_type
+                    tt = _Dec(td.bytes_())
+                    for f3, w3 in tt.fields():
+                        if f3 == 1:
+                            elem = tt.varint()
+                        elif f3 == 2:
+                            sd = _Dec(tt.bytes_())
+                            for f4, w4 in sd.fields():
+                                if f4 == 1:
+                                    dd = _Dec(sd.bytes_())
+                                    val = None
+                                    for f5, w5 in dd.fields():
+                                        if f5 == 1:
+                                            val = dd.varint()
+                                        else:
+                                            dd.skip(w5)
+                                    shape.append(val)
+                                else:
+                                    sd.skip(w4)
+                        else:
+                            tt.skip(w3)
+                else:
+                    td.skip(w2)
+        else:
+            d.skip(w)
+    return name, elem, tuple(shape)
+
+
+@dataclass
+class Graph:
+    name: str = "mxtpu"
+    nodes: List[Node] = field(default_factory=list)
+    initializers: List[Tensor] = field(default_factory=list)
+    inputs: List[Tuple[str, int, Tuple[Optional[int], ...]]] = \
+        field(default_factory=list)
+    outputs: List[Tuple[str, int, Tuple[Optional[int], ...]]] = \
+        field(default_factory=list)
+
+    def encode(self) -> bytes:
+        out = [_len_delim(1, n.encode()) for n in self.nodes]
+        out.append(_f_string(2, self.name))
+        out += [_len_delim(5, t.encode()) for t in self.initializers]
+        out += [_len_delim(11, _encode_value_info(*vi))
+                for vi in self.inputs]
+        out += [_len_delim(12, _encode_value_info(*vi))
+                for vi in self.outputs]
+        return b"".join(out)
+
+    @staticmethod
+    def decode(data: bytes) -> "Graph":
+        g = Graph()
+        d = _Dec(data)
+        for f, w in d.fields():
+            if f == 1:
+                g.nodes.append(Node.decode(d.bytes_()))
+            elif f == 2:
+                g.name = d.bytes_().decode("utf-8")
+            elif f == 5:
+                g.initializers.append(Tensor.decode(d.bytes_()))
+            elif f == 11:
+                g.inputs.append(_decode_value_info(d.bytes_()))
+            elif f == 12:
+                g.outputs.append(_decode_value_info(d.bytes_()))
+            else:
+                d.skip(w)
+        return g
+
+
+@dataclass
+class Model:
+    graph: Graph = field(default_factory=Graph)
+    ir_version: int = 8
+    opset: int = 13
+    producer_name: str = "mxtpu"
+    producer_version: str = "2.0"
+
+    def encode(self) -> bytes:
+        opset = _f_string(1, "") + _f_varint(2, self.opset)
+        return b"".join([
+            _f_varint(1, self.ir_version),
+            _f_string(2, self.producer_name),
+            _f_string(3, self.producer_version),
+            _len_delim(7, self.graph.encode()),
+            _len_delim(8, opset),
+        ])
+
+    @staticmethod
+    def decode(data: bytes) -> "Model":
+        m = Model()
+        d = _Dec(data)
+        for f, w in d.fields():
+            if f == 1:
+                m.ir_version = d.varint()
+            elif f == 2:
+                m.producer_name = d.bytes_().decode("utf-8")
+            elif f == 3:
+                m.producer_version = d.bytes_().decode("utf-8")
+            elif f == 7:
+                m.graph = Graph.decode(d.bytes_())
+            elif f == 8:
+                od = _Dec(d.bytes_())
+                for f2, w2 in od.fields():
+                    if f2 == 2:
+                        m.opset = od.varint()
+                    else:
+                        od.skip(w2)
+            else:
+                d.skip(w)
+        return m

@@ -1,0 +1,268 @@
+"""Symbol → ONNX export (reference
+``python/mxnet/contrib/onnx/mx2onnx/``†).
+
+Covers the classic image-classification/MLP op families the reference
+exporter shipped with: Convolution, FullyConnected, Activation,
+Pooling, BatchNorm, Flatten, softmax/SoftmaxOutput, element-wise
+add/mul, Concat, Dropout (inference pass-through), Reshape, transpose,
+LeakyReLU/ELU.  Ops outside the table raise with the op name, matching
+the reference's AttributeError contract.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_CONVERTERS: Dict[str, Callable] = {}
+
+
+def _register(*names):
+    def deco(fn):
+        for n in names:
+            _CONVERTERS[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    def __init__(self, params):
+        self.params = params
+        self.nodes: List[P.Node] = []
+        self.initializers: List[P.Tensor] = []
+        self.renames: Dict[str, str] = {}
+
+    def out(self, node, idx=0) -> str:
+        base = node.name if node.op is None else f"{node.name}_out{idx}"
+        return self.renames.get(base, base)
+
+    def ins(self, node) -> List[str]:
+        return [self.out(src, i) for src, i in node.inputs]
+
+    def add(self, op_type, name, inputs, outputs, **attrs):
+        self.nodes.append(P.Node(op_type=op_type, name=name,
+                                 inputs=tuple(inputs),
+                                 outputs=tuple(outputs),
+                                 attributes=attrs))
+
+    def const(self, name, array) -> str:
+        self.initializers.append(P.Tensor.from_numpy(name, array))
+        return name
+
+
+def _ints(v, n=None):
+    if v is None:
+        return None
+    if isinstance(v, str):
+        # attrs from a loaded -symbol.json are strings: "(3, 3)", "2"
+        import ast
+        v = ast.literal_eval(v)
+    t = tuple(int(x) for x in (v if isinstance(v, (tuple, list))
+                               else (v,)))
+    if n is not None and len(t) == 1:
+        t = t * n
+    return t
+
+
+def _pads2(pad, ndim):
+    p = _ints(pad or (0,) * ndim, ndim)
+    return p + p  # onnx pads = begin... + end...
+
+
+@_register("Convolution")
+def _conv(node, ctx):
+    a = node.attrs
+    kernel = _ints(a.get("kernel"))
+    nd_sp = len(kernel)
+    attrs = dict(kernel_shape=kernel,
+                 strides=_ints(a.get("stride"), nd_sp) or (1,) * nd_sp,
+                 dilations=_ints(a.get("dilate"), nd_sp) or
+                 (1,) * nd_sp,
+                 pads=_pads2(a.get("pad"), nd_sp),
+                 group=int(a.get("num_group", 1)))
+    ctx.add("Conv", node.name, ctx.ins(node), [ctx.out(node)], **attrs)
+
+
+@_register("FullyConnected")
+def _fc(node, ctx):
+    a = node.attrs
+    ins = ctx.ins(node)
+    data = ins[0]
+    if a.get("flatten", True) in (True, "True", "true", 1):
+        flat = f"{node.name}_flat"
+        ctx.add("Flatten", flat, [data], [flat], axis=1)
+        data = flat
+    gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
+    ctx.add("Gemm", node.name, gemm_in, [ctx.out(node)],
+            alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@_register("Activation")
+def _act(node, ctx):
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = node.attrs.get("act_type", "relu")
+    if act not in table:
+        raise MXNetError(f"ONNX export: Activation {act} unsupported")
+    ctx.add(table[act], node.name, ctx.ins(node), [ctx.out(node)])
+
+
+@_register("LeakyReLU")
+def _leaky(node, ctx):
+    act = node.attrs.get("act_type", "leaky")
+    slope = float(node.attrs.get("slope", 0.25))
+    if act == "leaky":
+        ctx.add("LeakyRelu", node.name, ctx.ins(node), [ctx.out(node)],
+                alpha=slope)
+    elif act == "elu":
+        ctx.add("Elu", node.name, ctx.ins(node), [ctx.out(node)],
+                alpha=slope)
+    else:
+        raise MXNetError(f"ONNX export: LeakyReLU {act} unsupported")
+
+
+@_register("Pooling")
+def _pool(node, ctx):
+    a = node.attrs
+    ptype = a.get("pool_type", "max")
+    if ptype not in ("max", "avg"):
+        raise MXNetError(f"ONNX export: pool_type {ptype} unsupported")
+    if a.get("global_pool") in (True, "True", "true", 1):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        ctx.add(op, node.name, ctx.ins(node), [ctx.out(node)])
+        return
+    kernel = _ints(a.get("kernel"))
+    nd_sp = len(kernel)
+    attrs = dict(kernel_shape=kernel,
+                 strides=_ints(a.get("stride"), nd_sp) or (1,) * nd_sp,
+                 pads=_pads2(a.get("pad"), nd_sp))
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    if ptype == "avg":
+        attrs["count_include_pad"] = \
+            1 if a.get("count_include_pad", True) in \
+            (True, "True", "true", 1) else 0
+    ctx.add(op, node.name, ctx.ins(node), [ctx.out(node)], **attrs)
+
+
+@_register("BatchNorm")
+def _bn(node, ctx):
+    a = node.attrs
+    ctx.add("BatchNormalization", node.name, ctx.ins(node),
+            [ctx.out(node)],
+            epsilon=float(a.get("eps", 1e-3)),
+            momentum=float(a.get("momentum", 0.9)))
+
+
+@_register("Flatten", "flatten")
+def _flatten(node, ctx):
+    ctx.add("Flatten", node.name, ctx.ins(node), [ctx.out(node)],
+            axis=1)
+
+
+@_register("softmax", "SoftmaxActivation")
+def _softmax(node, ctx):
+    ctx.add("Softmax", node.name, ctx.ins(node), [ctx.out(node)],
+            axis=int(node.attrs.get("axis", -1)))
+
+
+@_register("SoftmaxOutput")
+def _softmax_out(node, ctx):
+    # inference export: the label input drops, loss becomes Softmax
+    ctx.add("Softmax", node.name, ctx.ins(node)[:1], [ctx.out(node)],
+            axis=1)
+
+
+@_register("elemwise_add", "_plus", "_add", "broadcast_add")
+def _add(node, ctx):
+    ctx.add("Add", node.name, ctx.ins(node), [ctx.out(node)])
+
+
+@_register("elemwise_mul", "_mul", "broadcast_mul")
+def _mul(node, ctx):
+    ctx.add("Mul", node.name, ctx.ins(node), [ctx.out(node)])
+
+
+@_register("Concat", "concat")
+def _concat(node, ctx):
+    ctx.add("Concat", node.name, ctx.ins(node), [ctx.out(node)],
+            axis=int(node.attrs.get("dim", 1)))
+
+
+@_register("Dropout")
+def _dropout(node, ctx):
+    # inference graphs: dropout is identity — alias the output name
+    ctx.renames[f"{node.name}_out0"] = ctx.ins(node)[0]
+
+
+@_register("Reshape", "reshape")
+def _reshape(node, ctx):
+    shape = _ints(node.attrs.get("shape"))
+    shape_name = ctx.const(f"{node.name}_shape",
+                           np.asarray(shape, np.int64))
+    ctx.add("Reshape", node.name, [ctx.ins(node)[0], shape_name],
+            [ctx.out(node)])
+
+
+@_register("transpose")
+def _transpose(node, ctx):
+    ctx.add("Transpose", node.name, ctx.ins(node), [ctx.out(node)],
+            perm=_ints(node.attrs.get("axes")))
+
+
+def export_model(sym, params, input_shape=None,
+                 input_type=np.float32,
+                 onnx_file_path="model.onnx") -> str:
+    """Export (Symbol, params) to an ONNX file (reference
+    ``onnx_mxnet.export_model``†).  ``params`` may use ``arg:``/
+    ``aux:`` prefixes (checkpoint convention) or bare names; values are
+    NDArray or numpy.  ``input_shape``: shape tuple (or list of them)
+    for the graph inputs."""
+    clean: Dict[str, np.ndarray] = {}
+    for k, v in (params or {}).items():
+        name = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) \
+            else k
+        clean[name] = v.asnumpy() if hasattr(v, "asnumpy") \
+            else np.asarray(v)
+
+    nodes = sym._topo()
+    ctx = _Ctx(clean)
+    graph = P.Graph(name=sym.name)
+    shapes = list(input_shape) if isinstance(input_shape, list) \
+        else [input_shape]
+    data_idx = 0
+    for node in nodes:
+        if node.op is None:
+            if node.name in clean:
+                ctx.const(node.name, clean[node.name])
+            else:
+                shp = shapes[data_idx] if data_idx < len(shapes) \
+                    else None
+                data_idx += 1
+                graph.inputs.append(
+                    (node.name,
+                     P.NP_TO_ONNX[np.dtype(input_type)],
+                     tuple(shp) if shp else ()))
+            continue
+        conv = _CONVERTERS.get(node.op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {node.op!r} "
+                f"(node {node.name}); supported: "
+                f"{sorted(_CONVERTERS)}")
+        conv(node, ctx)
+    graph.nodes = ctx.nodes
+    graph.initializers = ctx.initializers
+    # prune inputs nothing consumes (e.g. SoftmaxOutput's dropped
+    # label var)
+    referenced = {i for n in ctx.nodes for i in n.inputs}
+    graph.inputs = [vi for vi in graph.inputs if vi[0] in referenced]
+    for head, idx in sym._heads:
+        graph.outputs.append((ctx.out(head, idx),
+                              P.NP_TO_ONNX[np.dtype(input_type)], ()))
+    model = P.Model(graph=graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.encode())
+    return onnx_file_path

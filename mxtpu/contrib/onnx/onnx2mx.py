@@ -1,0 +1,236 @@
+"""ONNX → Symbol import (reference
+``python/mxnet/contrib/onnx/onnx2mx/``†).
+
+Inverse of :mod:`.mx2onnx` for the same op families; returns the
+``(sym, arg_params, aux_params)`` triple the reference's
+``onnx_mxnet.import_model``† returns, ready for ``SymbolBlock`` or
+``Executor.bind``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_IMPORTERS: Dict[str, Callable] = {}
+
+
+def _register(*names):
+    def deco(fn):
+        for n in names:
+            _IMPORTERS[n] = fn
+        return fn
+    return deco
+
+
+def _sym():
+    from ... import symbol
+    return symbol
+
+
+def _pair(pads):
+    """onnx pads [b0,b1,...,e0,e1,...] → mx symmetric pad tuple."""
+    if not pads:
+        return None
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError(f"asymmetric ONNX pads {pads} unsupported")
+    return tuple(int(p) for p in begin)
+
+
+def _check_auto_pad(node, attrs):
+    ap = attrs.get("auto_pad", "NOTSET")
+    if ap not in ("NOTSET", b"NOTSET", ""):
+        raise MXNetError(
+            f"ONNX import: {node.op_type} auto_pad={ap!r} unsupported "
+            f"(re-export with explicit pads)")
+    if attrs.get("ceil_mode"):
+        raise MXNetError(
+            f"ONNX import: {node.op_type} ceil_mode unsupported")
+
+
+@_register("Conv")
+def _conv(node, ins, attrs):
+    _check_auto_pad(node, attrs)
+    kw = dict(kernel=tuple(attrs["kernel_shape"]),
+              num_filter=0,  # patched by caller from weight shape
+              stride=tuple(attrs.get("strides", ())) or None,
+              dilate=tuple(attrs.get("dilations", ())) or None,
+              num_group=int(attrs.get("group", 1)),
+              no_bias=len(ins) < 3)
+    pad = _pair(attrs.get("pads"))
+    if pad:
+        kw["pad"] = pad
+    kw = {k: v for k, v in kw.items() if v is not None}
+    return "Convolution", kw
+
+
+@_register("Gemm")
+def _gemm(node, ins, attrs):
+    if attrs.get("transA"):
+        raise MXNetError("ONNX import: Gemm transA unsupported")
+    if not attrs.get("transB", 0):
+        raise MXNetError("ONNX import: Gemm transB=0 unsupported "
+                         "(mx FullyConnected stores weight transposed)")
+    if float(attrs.get("alpha", 1.0)) != 1.0 or \
+            float(attrs.get("beta", 1.0)) != 1.0:
+        raise MXNetError(
+            f"ONNX import: Gemm alpha/beta scaling unsupported "
+            f"(alpha={attrs.get('alpha')}, beta={attrs.get('beta')})")
+    return "FullyConnected", {"num_hidden": 0, "flatten": False,
+                              "no_bias": len(ins) < 3}
+
+
+_ACTS = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+         "Softplus": "softrelu", "Softsign": "softsign"}
+for _o, _m in _ACTS.items():
+    _register(_o)(lambda node, ins, attrs, _m=_m:
+                  ("Activation", {"act_type": _m}))
+
+_register("LeakyRelu")(lambda node, ins, attrs: (
+    "LeakyReLU", {"act_type": "leaky",
+                  "slope": float(attrs.get("alpha", 0.01))}))
+_register("Elu")(lambda node, ins, attrs: (
+    "LeakyReLU", {"act_type": "elu",
+                  "slope": float(attrs.get("alpha", 1.0))}))
+
+
+@_register("MaxPool", "AveragePool")
+def _pool(node, ins, attrs):
+    _check_auto_pad(node, attrs)
+    kw = dict(kernel=tuple(attrs["kernel_shape"]),
+              pool_type="max" if node.op_type == "MaxPool" else "avg",
+              stride=tuple(attrs.get("strides", ())) or None)
+    pad = _pair(attrs.get("pads"))
+    if pad:
+        kw["pad"] = pad
+    if node.op_type == "AveragePool":
+        kw["count_include_pad"] = \
+            bool(attrs.get("count_include_pad", 1))
+    return "Pooling", {k: v for k, v in kw.items() if v is not None}
+
+
+_register("GlobalMaxPool")(lambda node, ins, attrs: (
+    "Pooling", {"kernel": (1, 1), "pool_type": "max",
+                "global_pool": True}))
+_register("GlobalAveragePool")(lambda node, ins, attrs: (
+    "Pooling", {"kernel": (1, 1), "pool_type": "avg",
+                "global_pool": True}))
+
+
+@_register("BatchNormalization")
+def _bn(node, ins, attrs):
+    # ONNX BatchNormalization (inference form) always normalizes with
+    # the provided mean/var inputs — mx's use_global_stats=True
+    return "BatchNorm", {"eps": float(attrs.get("epsilon", 1e-5)),
+                         "momentum":
+                             float(attrs.get("momentum", 0.9)),
+                         "fix_gamma": False,
+                         "use_global_stats": True}
+
+
+_register("Flatten")(lambda node, ins, attrs: ("Flatten", {}))
+_register("Softmax")(lambda node, ins, attrs: (
+    "softmax", {"axis": int(attrs.get("axis", -1))}))
+_register("Add")(lambda node, ins, attrs: ("elemwise_add", {}))
+_register("Mul")(lambda node, ins, attrs: ("elemwise_mul", {}))
+_register("Concat")(lambda node, ins, attrs: (
+    "Concat", {"dim": int(attrs.get("axis", 1))}))
+_register("Transpose")(lambda node, ins, attrs: (
+    "transpose", {"axes": tuple(attrs["perm"])}))
+_register("Identity")(None)
+_register("Dropout")(None)
+_register("Reshape")(None)
+
+
+def import_model(onnx_file: str):
+    """Load an ONNX file → ``(sym, arg_params, aux_params)``
+    (reference ``onnx_mxnet.import_model``†)."""
+    with open(onnx_file, "rb") as f:
+        model = P.Model.decode(f.read())
+    return import_graph(model.graph)
+
+
+def get_model_metadata(onnx_file: str) -> Dict[str, Any]:
+    """Input/output names+shapes (reference
+    ``onnx_mxnet.get_model_metadata``†)."""
+    with open(onnx_file, "rb") as f:
+        model = P.Model.decode(f.read())
+    g = model.graph
+    return {"input_tensor_data": [(n, s) for n, _, s in g.inputs],
+            "output_tensor_data": [(n, s) for n, _, s in g.outputs]}
+
+
+def import_graph(g: P.Graph):
+    sym_mod = _sym()
+    inits = {t.name: t.to_numpy() for t in g.initializers}
+    # every non-initializer referenced name becomes a var
+    env: Dict[str, Any] = {}
+    arg_params: Dict[str, Any] = {}
+    aux_params: Dict[str, Any] = {}
+
+    def get_in(name):
+        if name in env:
+            return env[name]
+        v = sym_mod.var(name)
+        env[name] = v
+        return v
+
+    for name, _, _ in g.inputs:
+        env[name] = sym_mod.var(name)
+    for t in g.initializers:
+        env[t.name] = sym_mod.var(t.name)
+
+    from ... import nd as nd_mod
+    for node in g.nodes:
+        imp = _IMPORTERS.get(node.op_type, "missing")
+        if imp == "missing":
+            raise MXNetError(
+                f"ONNX import: no importer for op {node.op_type!r} "
+                f"(node {node.name}); supported: "
+                f"{sorted(_IMPORTERS)}")
+        if imp is None:
+            # pass-through (Identity / inference Dropout) or Reshape
+            if node.op_type == "Reshape":
+                shape = inits.get(node.inputs[1])
+                if shape is None:
+                    raise MXNetError(
+                        "ONNX import: dynamic Reshape shape input "
+                        "unsupported")
+                out = sym_mod.reshape(
+                    get_in(node.inputs[0]),
+                    shape=tuple(int(s) for s in shape))
+            else:
+                out = get_in(node.inputs[0])
+            env[node.outputs[0]] = out
+            continue
+        op_name, kw = imp(node, node.inputs, node.attributes)
+        ins = [get_in(i) for i in node.inputs]
+        if op_name == "Convolution":
+            w = inits.get(node.inputs[1])
+            if w is not None:
+                kw["num_filter"] = int(w.shape[0])
+        if op_name == "FullyConnected":
+            w = inits.get(node.inputs[1])
+            if w is not None:
+                kw["num_hidden"] = int(w.shape[0])
+        fn = getattr(sym_mod, op_name)
+        out = fn(*ins, name=node.name or None, **kw)
+        for i, oname in enumerate(node.outputs):
+            # a 1-output onnx node over a multi-output mx op (e.g.
+            # BatchNorm's mean/var extras) binds the primary head
+            env[oname] = out[i] if len(out) > 1 else out
+
+    outs = [env[name] for name, _, _ in g.outputs]
+    sym = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+    aux_suffixes = ("running_mean", "running_var", "moving_mean",
+                    "moving_var")
+    for name, arr in inits.items():
+        target = aux_params if name.endswith(aux_suffixes) \
+            else arg_params
+        target[name] = nd_mod.array(arr)
+    return sym, arg_params, aux_params

@@ -1,0 +1,261 @@
+"""ONNX interchange: wire-format codec vs a protoc oracle, symbol
+round-trips through real .onnx files, metadata, error paths.
+
+Reference: ``python/mxnet/contrib/onnx/``† (mx2onnx/onnx2mx),
+``tests/python-pytest/onnx/``†.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu import symbol as sym
+from mxtpu.base import MXNetError
+from mxtpu.contrib.onnx import (export_model, get_model_metadata,
+                                import_model)
+from mxtpu.contrib.onnx import _proto as P
+from mxtpu.gluon import nn
+
+# faithful subset of onnx.proto† for the protoc oracle
+_ONNX_PROTO = """
+syntax = "proto3";
+package oracle;
+message AttributeProto {
+  string name = 1; float f = 2; int64 i = 3; bytes s = 4;
+  TensorProto t = 5; repeated float floats = 7; repeated int64 ints = 8;
+  repeated bytes strings = 9; int32 type = 20;
+}
+message ValueInfoProto { string name = 1; TypeProto type = 2; }
+message NodeProto {
+  repeated string input = 1; repeated string output = 2;
+  string name = 3; string op_type = 4;
+  repeated AttributeProto attribute = 5;
+}
+message TensorProto {
+  repeated int64 dims = 1; int32 data_type = 2;
+  repeated float float_data = 4; repeated int32 int32_data = 5;
+  repeated int64 int64_data = 7; string name = 8; bytes raw_data = 9;
+  repeated double double_data = 10; repeated uint64 uint64_data = 11;
+}
+message TensorShapeProto {
+  message Dimension { int64 dim_value = 1; string dim_param = 2; }
+  repeated Dimension dim = 1;
+}
+message TypeProto {
+  message Tensor { int32 elem_type = 1; TensorShapeProto shape = 2; }
+  Tensor tensor_type = 1;
+}
+message OperatorSetIdProto { string domain = 1; int64 version = 2; }
+message GraphProto {
+  repeated NodeProto node = 1; string name = 2;
+  repeated TensorProto initializer = 5;
+  repeated ValueInfoProto input = 11;
+  repeated ValueInfoProto output = 12;
+}
+message ModelProto {
+  int64 ir_version = 1; string producer_name = 2;
+  string producer_version = 3; GraphProto graph = 7;
+  repeated OperatorSetIdProto opset_import = 8;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    d = tmp_path_factory.mktemp("onnx_oracle")
+    (d / "oracle.proto").write_text(_ONNX_PROTO)
+    subprocess.run(["protoc", f"--python_out={d}", "oracle.proto"],
+                   cwd=d, check=True)
+    sys.path.insert(0, str(d))
+    try:
+        import oracle_pb2
+    finally:
+        sys.path.pop(0)
+    return oracle_pb2
+
+
+def _toy_model_bytes():
+    g = P.Graph(name="g")
+    g.inputs.append(("data", P.FLOAT, (1, 2)))
+    g.outputs.append(("out", P.FLOAT, ()))
+    g.initializers.append(P.Tensor.from_numpy(
+        "w", np.arange(6, dtype=np.float32).reshape(3, 2)))
+    g.nodes.append(P.Node(op_type="Gemm", name="fc",
+                          inputs=("data", "w"), outputs=("out",),
+                          attributes={"alpha": 1.0, "transB": 1,
+                                      "perm": (0, 1),
+                                      "mode": "test"}))
+    return P.Model(graph=g).encode()
+
+
+def test_codec_against_protoc_oracle(oracle):
+    m = oracle.ModelProto()
+    m.ParseFromString(_toy_model_bytes())
+    assert m.producer_name == "mxtpu"
+    assert m.opset_import[0].version == 13
+    g = m.graph
+    assert [n.name for n in g.node] == ["fc"]
+    node = g.node[0]
+    assert node.op_type == "Gemm"
+    assert list(node.input) == ["data", "w"]
+    attrs = {a.name: a for a in node.attribute}
+    assert attrs["alpha"].f == 1.0 and attrs["transB"].i == 1
+    assert list(attrs["perm"].ints) == [0, 1]
+    assert attrs["mode"].s == b"test"
+    t = g.initializer[0]
+    assert list(t.dims) == [3, 2] and t.data_type == P.FLOAT
+    np.testing.assert_array_equal(
+        np.frombuffer(t.raw_data, np.float32).reshape(3, 2),
+        np.arange(6, dtype=np.float32).reshape(3, 2))
+    assert g.input[0].name == "data"
+    dims = g.input[0].type.tensor_type.shape.dim
+    assert [d.dim_value for d in dims] == [1, 2]
+
+    # reverse direction: oracle-encoded stream decodes with our codec
+    blob = m.SerializeToString()
+    m2 = P.Model.decode(blob)
+    assert m2.graph.nodes[0].op_type == "Gemm"
+    assert m2.graph.nodes[0].attributes["perm"] == (0, 1)
+    assert m2.graph.initializers[0].to_numpy().shape == (3, 2)
+    assert m2.graph.inputs[0] == ("data", P.FLOAT, (1, 2))
+
+
+def _export_net(net, x, tmp_path, name):
+    net.initialize(init="xavier")
+    y0 = net(x).asnumpy()
+    prefix = str(tmp_path / name)
+    sym_file, param_file = net.export(prefix)
+    s = sym.load(sym_file)
+    params = nd.load(param_file)
+    onnx_file = export_model(s, params, input_shape=tuple(x.shape),
+                             onnx_file_path=str(tmp_path /
+                                                f"{name}.onnx"))
+    return y0, onnx_file
+
+
+def _eval_imported(onnx_file, x):
+    s2, args, auxs = import_model(onnx_file)
+    bindings = {"data": x}
+    bindings.update(args)
+    bindings.update(auxs)
+    names = set(s2.list_inputs())
+    bindings = {k: v for k, v in bindings.items() if k in names}
+    return s2.eval(**bindings)[0].asnumpy()
+
+
+def test_mlp_roundtrip(tmp_path):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"),
+            nn.Dropout(0.5),
+            nn.Dense(5))
+    x = nd.array(np.random.RandomState(0)
+                 .randn(3, 8).astype(np.float32))
+    y0, onnx_file = _export_net(net, x, tmp_path, "mlp")
+    y1 = _eval_imported(onnx_file, x)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+def test_convnet_roundtrip(tmp_path):
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation="relu"),
+            nn.BatchNorm(),
+            nn.MaxPool2D(2, strides=2),
+            nn.Flatten(),
+            nn.Dense(6))
+    x = nd.array(np.random.RandomState(1)
+                 .randn(2, 3, 8, 8).astype(np.float32))
+    y0, onnx_file = _export_net(net, x, tmp_path, "cnn")
+    y1 = _eval_imported(onnx_file, x)
+    np.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-4)
+
+
+def test_metadata(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    x = nd.zeros((2, 3))
+    _, onnx_file = _export_net(net, x, tmp_path, "meta")
+    meta = get_model_metadata(onnx_file)
+    assert meta["input_tensor_data"][0][0] == "data"
+    assert meta["input_tensor_data"][0][1] == (2, 3)
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_resnet18_roundtrip(tmp_path):
+    """Model-zoo coverage: ResNet-18 (residual adds, BN, global pool)
+    round-trips bit-exact through a real .onnx file."""
+    mx.random.seed(0)
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_resnet(1, 18, classes=10)
+    x = nd.array(np.random.RandomState(0)
+                 .randn(1, 3, 32, 32).astype(np.float32))
+    y0, onnx_file = _export_net(net, x, tmp_path, "r18")
+    y1 = _eval_imported(onnx_file, x)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+def test_unsupported_op_raises(tmp_path):
+    data = sym.var("data")
+    s = sym.sort(data)  # no ONNX converter registered
+    with pytest.raises(MXNetError, match="no converter"):
+        export_model(s, {}, input_shape=(2, 2),
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_external_tensor_storage_forms(oracle):
+    """Tensors from other exporters: f16 bit patterns in int32_data,
+    doubles in double_data, floats in float_data — all decode."""
+    t = oracle.TensorProto(name="h", dims=[2], data_type=P.FLOAT16)
+    t.int32_data.extend([0x3C00, 0xC000])  # bit patterns for 1.0, -2.0
+    got = P.Tensor.decode(t.SerializeToString()).to_numpy()
+    np.testing.assert_array_equal(got,
+                                  np.array([1.0, -2.0], np.float16))
+
+    t = oracle.TensorProto(name="d", dims=[2], data_type=P.DOUBLE)
+    t.double_data.extend([1.5, -2.25])
+    got = P.Tensor.decode(t.SerializeToString()).to_numpy()
+    np.testing.assert_array_equal(got, np.array([1.5, -2.25]))
+
+    t = oracle.TensorProto(name="f", dims=[3], data_type=P.FLOAT)
+    t.float_data.extend([0.5, 1.5, 2.5])
+    got = P.Tensor.decode(t.SerializeToString()).to_numpy()
+    np.testing.assert_array_equal(got,
+                                  np.array([0.5, 1.5, 2.5],
+                                           np.float32))
+
+
+def test_import_rejects_unsupported_semantics():
+    from mxtpu.contrib.onnx import import_graph
+    w = P.Tensor.from_numpy("w", np.ones((4, 3), np.float32))
+
+    def graph_with(node):
+        g = P.Graph()
+        g.inputs.append(("data", P.FLOAT, (2, 3)))
+        g.outputs.append((node.outputs[0], P.FLOAT, ()))
+        g.initializers.append(w)
+        g.nodes.append(node)
+        return g
+
+    with pytest.raises(MXNetError, match="alpha/beta"):
+        import_graph(graph_with(P.Node(
+            op_type="Gemm", name="g", inputs=("data", "w"),
+            outputs=("y",), attributes={"transB": 1, "alpha": 0.5})))
+    with pytest.raises(MXNetError, match="auto_pad"):
+        import_graph(graph_with(P.Node(
+            op_type="MaxPool", name="p", inputs=("data",),
+            outputs=("y",),
+            attributes={"kernel_shape": (2, 2),
+                        "auto_pad": "SAME_UPPER"})))
+    with pytest.raises(MXNetError, match="ceil_mode"):
+        import_graph(graph_with(P.Node(
+            op_type="MaxPool", name="p", inputs=("data",),
+            outputs=("y",),
+            attributes={"kernel_shape": (2, 2), "ceil_mode": 1})))
