@@ -150,9 +150,23 @@ def _pool(node, ctx):
 @_register("BatchNorm")
 def _bn(node, ctx):
     a = node.attrs
-    ctx.add("BatchNormalization", node.name, ctx.ins(node),
-            [ctx.out(node)],
-            epsilon=float(a.get("eps", 1e-3)),
+    ins = ctx.ins(node)
+    if a.get("fix_gamma", True) in (True, "True", "true", 1):
+        # mx computes with gamma forced to ones when fix_gamma — the
+        # stored gamma array is ignored, so export ones explicitly
+        gamma_name = node.inputs[1][0].name
+        g = ctx.params.get(gamma_name)
+        if g is None:
+            raise MXNetError(
+                f"ONNX export: BatchNorm {node.name} has "
+                f"fix_gamma=True and gamma {gamma_name!r} is not in "
+                f"params — cannot derive the ones scale shape")
+        ins[1] = ctx.const(f"{node.name}_fixed_gamma",
+                           np.ones_like(np.asarray(g)))
+    # default must mirror the op registry's eps (1e-5), not the
+    # reference symbol-API's 1e-3 — the graph evaluates with ours
+    ctx.add("BatchNormalization", node.name, ins, [ctx.out(node)],
+            epsilon=float(a.get("eps", 1e-5)),
             momentum=float(a.get("momentum", 0.9)))
 
 
@@ -208,8 +222,10 @@ def _reshape(node, ctx):
 
 @_register("transpose")
 def _transpose(node, ctx):
+    axes = _ints(node.attrs.get("axes")) or None
+    attrs = {"perm": axes} if axes else {}  # both default to reverse
     ctx.add("Transpose", node.name, ctx.ins(node), [ctx.out(node)],
-            perm=_ints(node.attrs.get("axes")))
+            **attrs)
 
 
 def export_model(sym, params, input_shape=None,

@@ -109,8 +109,9 @@ def _pool(node, ins, attrs):
     if pad:
         kw["pad"] = pad
     if node.op_type == "AveragePool":
+        # ONNX spec default is EXCLUDE pad (0)
         kw["count_include_pad"] = \
-            bool(attrs.get("count_include_pad", 1))
+            bool(attrs.get("count_include_pad", 0))
     return "Pooling", {k: v for k, v in kw.items() if v is not None}
 
 
@@ -133,7 +134,15 @@ def _bn(node, ins, attrs):
                          "use_global_stats": True}
 
 
-_register("Flatten")(lambda node, ins, attrs: ("Flatten", {}))
+def _flatten_imp(node, ins, attrs):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError(
+            f"ONNX import: Flatten axis={attrs['axis']} unsupported "
+            f"(mx Flatten has fixed axis-1 semantics)")
+    return "Flatten", {}
+
+
+_register("Flatten")(_flatten_imp)
 _register("Softmax")(lambda node, ins, attrs: (
     "softmax", {"axis": int(attrs.get("axis", -1))}))
 _register("Add")(lambda node, ins, attrs: ("elemwise_add", {}))
@@ -141,7 +150,9 @@ _register("Mul")(lambda node, ins, attrs: ("elemwise_mul", {}))
 _register("Concat")(lambda node, ins, attrs: (
     "Concat", {"dim": int(attrs.get("axis", 1))}))
 _register("Transpose")(lambda node, ins, attrs: (
-    "transpose", {"axes": tuple(attrs["perm"])}))
+    # no perm = reverse dims in BOTH onnx and mx
+    "transpose", {"axes": tuple(attrs["perm"])}
+    if "perm" in attrs else {}))
 _register("Identity")(None)
 _register("Dropout")(None)
 _register("Reshape")(None)
@@ -210,14 +221,16 @@ def import_graph(g: P.Graph):
             continue
         op_name, kw = imp(node, node.inputs, node.attributes)
         ins = [get_in(i) for i in node.inputs]
-        if op_name == "Convolution":
+        if op_name in ("Convolution", "FullyConnected"):
             w = inits.get(node.inputs[1])
-            if w is not None:
-                kw["num_filter"] = int(w.shape[0])
-        if op_name == "FullyConnected":
-            w = inits.get(node.inputs[1])
-            if w is not None:
-                kw["num_hidden"] = int(w.shape[0])
+            if w is None:
+                raise MXNetError(
+                    f"ONNX import: {node.op_type} node {node.name!r} "
+                    f"weight {node.inputs[1]!r} is a graph input, not "
+                    f"an initializer — externalized weights are "
+                    f"unsupported")
+            kw["num_filter" if op_name == "Convolution"
+               else "num_hidden"] = int(w.shape[0])
         fn = getattr(sym_mod, op_name)
         out = fn(*ins, name=node.name or None, **kw)
         for i, oname in enumerate(node.outputs):

@@ -210,6 +210,28 @@ def test_unsupported_op_raises(tmp_path):
                      onnx_file_path=str(tmp_path / "x.onnx"))
 
 
+def test_symbol_api_bn_fix_gamma_and_bare_transpose(tmp_path):
+    """Symbol-API graphs: fix_gamma=True BN (mx ignores stored gamma)
+    and axes-less transpose (reverse dims) export/import correctly."""
+    rng = np.random.RandomState(5)
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn", use_global_stats=True)
+    out = sym.transpose(bn[0], name="t")  # no axes: reverse dims
+    params = {"bn_gamma": nd.array(rng.rand(3).astype(np.float32) + 2),
+              "bn_beta": nd.array(rng.rand(3).astype(np.float32)),
+              "bn_moving_mean": nd.array(
+                  rng.rand(3).astype(np.float32)),
+              "bn_moving_var": nd.array(
+                  rng.rand(3).astype(np.float32) + 0.5)}
+    x = nd.array(rng.randn(2, 3, 4, 4).astype(np.float32))
+    y0 = out.eval(data=x, **params)[0].asnumpy()
+    f = export_model(out, params, input_shape=(2, 3, 4, 4),
+                     onnx_file_path=str(tmp_path / "bn.onnx"))
+    y1 = _eval_imported(f, x)
+    assert y1.shape == y0.shape == (4, 4, 3, 2)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
 def test_external_tensor_storage_forms(oracle):
     """Tensors from other exporters: f16 bit patterns in int32_data,
     doubles in double_data, floats in float_data — all decode."""
