@@ -1,0 +1,249 @@
+/*
+ * C predict ABI implementation (reference src/c_api/c_predict_api.cc†
+ * rebuilt over the TPU runtime): embeds CPython and drives
+ * mxtpu.c_predict.  The C side stays numpy-free — tensors cross the
+ * boundary as PyBytes, so the only link dependency is libpython.
+ *
+ * Works both embedded in a plain C program (initializes the
+ * interpreter on first use) and loaded into an existing Python
+ * process (detects the live interpreter and only takes the GIL).
+ */
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Predictor {
+  PyObject *obj = nullptr;              // mxtpu.c_predict.Predictor
+  std::vector<mx_uint> shape_buf;       // backs MXPredGetOutputShape
+};
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) g_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+std::once_flag g_init_once;
+
+bool ensure_interpreter() {
+  // once_flag: two threads creating their first predictor
+  // concurrently must not both run Py_InitializeEx (UB)
+  std::call_once(g_init_once, []() {
+    if (Py_IsInitialized()) return;
+    Py_InitializeEx(0);
+    if (Py_IsInitialized()) {
+      // the embedding thread owns the GIL after Py_Initialize;
+      // release it so every ABI call can use the uniform
+      // PyGILState path
+      PyEval_SaveThread();
+    }
+  });
+  if (!Py_IsInitialized()) {
+    g_last_error = "failed to initialize embedded Python";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data,
+                 PredictorHandle *out) {
+  if (symbol_json_str == nullptr || out == nullptr) {
+    g_last_error = "null argument";
+    return -1;
+  }
+  if (param_bytes == nullptr && param_size > 0) {
+    g_last_error = "param_bytes is null but param_size > 0";
+    return -1;
+  }
+  if (param_size < 0) {
+    g_last_error = "negative param_size";
+    return -1;
+  }
+  if (num_input_nodes > 0 &&
+      (input_keys == nullptr || input_shape_indptr == nullptr ||
+       input_shape_data == nullptr)) {
+    g_last_error = "null input key/shape arrays";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *mod = PyImport_ImportModule("mxtpu.c_predict");
+  if (mod == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyList_SET_ITEM(shape, j - lo,
+                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyList_SET_ITEM(shapes, i, shape);
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *pred = PyObject_CallMethod(
+      mod, "_create", "sOiiOO", symbol_json_str, blob, dev_type,
+      dev_id, keys, shapes);
+  Py_DECREF(blob);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  Py_DECREF(mod);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Predictor *h = new Predictor();
+  h->obj = pred;
+  *out = h;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint out_index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr) {
+    g_last_error = "null handle";
+    return -1;
+  }
+  GIL gil;
+  PyObject *shape = PyObject_CallMethod(h->obj, "get_output_shape",
+                                        "I", out_index);
+  if (shape == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+  }
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr || key == nullptr || data == nullptr) {
+    g_last_error = "null argument";
+    return -1;
+  }
+  GIL gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float));
+  PyObject *r = PyObject_CallMethod(h->obj, "set_input", "sO", key,
+                                    bytes);
+  Py_DECREF(bytes);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr) {
+    g_last_error = "null handle";
+    return -1;
+  }
+  GIL gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint out_index,
+                    mx_float *data, mx_uint size) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr || data == nullptr) {
+    g_last_error = "null argument";
+    return -1;
+  }
+  GIL gil;
+  PyObject *bytes = PyObject_CallMethod(h->obj, "get_output", "I",
+                                        out_index);
+  if (bytes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyBytes_Size(bytes);
+  if (n != static_cast<Py_ssize_t>(size) *
+               static_cast<Py_ssize_t>(sizeof(mx_float))) {
+    g_last_error = "output size mismatch: have " + std::to_string(n) +
+                   " bytes, caller asked for " +
+                   std::to_string(size * sizeof(mx_float));
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), static_cast<size_t>(n));
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr) return 0;
+  if (Py_IsInitialized()) {
+    GIL gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+/*
+ * C predict ABI — drop-in surface of the reference's
+ * include/mxnet/c_predict_api.h† over the TPU-native runtime.
+ *
+ * Implementation (c_predict_api.cc) embeds CPython and drives
+ * mxtpu.c_predict.Predictor; link with -lmxtpu_predict (build:
+ * `make -C core predict`).  All functions return 0 on success, -1 on
+ * failure with the message available via MXGetLastError().
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Last error message for this thread (empty string if none). */
+const char *MXGetLastError(void);
+
+/* Create a predictor from a symbol JSON string and the contents of a
+ * .params file (dmlc binary or MXTPU01 container).
+ *   dev_type: 1 = cpu, 2 = gpu(= the TPU device in this build)
+ *   input_keys / input_shape_indptr / input_shape_data describe the
+ *   input shapes exactly as in the reference ABI: input i has shape
+ *   input_shape_data[indptr[i] : indptr[i+1]].
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data,
+                 PredictorHandle *out);
+
+/* Shape of output out_index; *shape_data stays owned by the handle and
+ * is valid until the next call on it. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint out_index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy `size` floats into the named input. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the forward pass. */
+int MXPredForward(PredictorHandle handle);
+
+/* Copy output out_index into data (size = element count). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint out_index,
+                    mx_float *data, mx_uint size);
+
+/* Release the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_C_PREDICT_API_H_ */

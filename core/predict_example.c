@@ -1,0 +1,98 @@
+/*
+ * Minimal C consumer of the predict ABI (reference
+ * example/image-classification/predict-cpp†): loads an exported
+ * model, feeds an input read from a raw float file, prints the
+ * outputs.
+ *
+ *   gcc predict_example.c -L. -lmxtpu_predict -Wl,-rpath,'$ORIGIN'
+ *   ./a.out model-symbol.json model-0000.params 1,8 input.f32
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr,
+            "usage: %s symbol.json weights.params N,C[,H,W] in.f32\n",
+            argv[0]);
+    return 2;
+  }
+  long sym_size = 0, param_size = 0, in_size = 0;
+  char *sym_json = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  char *input = read_file(argv[4], &in_size);
+  if (!sym_json || !params || !input) {
+    fprintf(stderr, "failed to read model/input files\n");
+    return 2;
+  }
+
+  mx_uint shape[8], ndim = 0;
+  for (char *tok = strtok(argv[3], ","); tok && ndim < 8;
+       tok = strtok(NULL, ","))
+    shape[ndim++] = (mx_uint)atoi(tok);
+  mx_uint indptr[2] = {0, ndim};
+  const char *keys[1] = {"data"};
+
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(sym_json, params, (int)param_size, 1, 0, 1, keys,
+                   indptr, shape, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredSetInput(pred, "data", (const mx_float *)input,
+                     (mx_uint)(in_size / sizeof(mx_float))) != 0) {
+    fprintf(stderr, "MXPredSetInput: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredForward(pred) != 0) {
+    fprintf(stderr, "MXPredForward: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "MXPredGetOutputShape: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint total = 1;
+  printf("output shape:");
+  for (mx_uint i = 0; i < ondim; ++i) {
+    printf(" %u", oshape[i]);
+    total *= oshape[i];
+  }
+  printf("\n");
+  mx_float *out = (mx_float *)malloc(total * sizeof(mx_float));
+  if (MXPredGetOutput(pred, 0, out, total) != 0) {
+    fprintf(stderr, "MXPredGetOutput: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("output:");
+  for (mx_uint i = 0; i < total && i < 16; ++i)
+    printf(" %.6f", out[i]);
+  printf("\n");
+  free(out);
+  free(input);
+  free(params);
+  free(sym_json);
+  MXPredFree(pred);
+  return 0;
+}

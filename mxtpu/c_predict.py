@@ -1,0 +1,113 @@
+"""Python half of the C predict ABI (reference
+``include/mxnet/c_predict_api.h``† / ``src/c_api/c_predict_api.cc``†).
+
+``core/c_predict_api.cc`` embeds CPython and drives this module: a
+:class:`Predictor` wraps a symbol JSON + ``.params`` blob into a bound
+:class:`mxtpu.executor.Executor`; data crosses the ABI as raw bytes
+(the C side owns plain ``float*`` buffers, this side wraps/unwraps via
+numpy) so the C library needs no numpy C-API coupling.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from . import nd
+from . import symbol as sym_mod
+from .context import cpu, gpu
+from .ndarray import legacy_format
+from .ndarray.ndarray import NDArray
+
+
+def _params_from_bytes(blob: bytes) -> Dict[str, np.ndarray]:
+    """Parse a .params payload into name → array with arg:/aux:
+    prefixes stripped (format detection shared with ``nd.load``)."""
+    from .ndarray.ndarray import loads
+    loaded = loads(blob)
+    if not isinstance(loaded, dict):
+        raise MXNetError(
+            "c_predict: anonymous .params blob has no names to bind by")
+    out = {}
+    for name, arr in loaded.items():
+        key = name.split(":", 1)[1] if name.startswith(("arg:",
+                                                        "aux:")) \
+            else name
+        out[key] = arr.asnumpy()
+    return out
+
+
+class Predictor:
+    """One bound inference executor (reference ``MXAPIPredictor``†)."""
+
+    def __init__(self, symbol_json: str, param_blob: bytes,
+                 dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, Tuple[int, ...]]):
+        symbol = sym_mod.load_json(symbol_json)
+        params = _params_from_bytes(param_blob)
+        ctx = cpu(dev_id) if dev_type == 1 else gpu(dev_id)
+        self._input_names = list(input_shapes)
+        args = {k: nd.array(v) for k, v in params.items()}
+        for name, shape in input_shapes.items():
+            args[name] = nd.zeros(tuple(int(s) for s in shape))
+        known = set(symbol.list_inputs())
+        args = {k: v for k, v in args.items() if k in known}
+        missing = known - set(args)
+        if missing:
+            raise MXNetError(
+                f"c_predict: inputs/params missing for {sorted(missing)}")
+        self._executor = symbol.bind(ctx, args=args, grad_req="null")
+        self._outputs: List[NDArray] = []
+        # output shapes are known at bind time (reference: available
+        # right after MXPredCreate, before any forward)
+        _, out_shapes, _ = symbol.infer_shape(
+            **{k: tuple(v.shape) for k, v in args.items()})
+        self._out_shapes = [tuple(int(d) for d in s)
+                            for s in out_shapes]
+
+    # -- ABI surface ----------------------------------------------------
+    def set_input(self, key: str, data: bytes) -> None:
+        # only DECLARED inputs are writable — a typo'd key must not
+        # silently overwrite a trained weight (reference semantics)
+        if key not in self._input_names:
+            raise MXNetError(
+                f"c_predict: {key!r} is not a declared input "
+                f"(inputs: {self._input_names})")
+        cur = self._executor.arg_dict[key]
+        arr = np.frombuffer(data, np.float32)
+        if arr.size != int(np.prod(cur.shape)):
+            raise MXNetError(
+                f"c_predict: input {key!r} size {arr.size} != bound "
+                f"shape {tuple(cur.shape)}")
+        self._executor.arg_dict[key] = nd.array(
+            arr.reshape(cur.shape))
+
+    def forward(self) -> None:
+        self._outputs = self._executor.forward(is_train=False)
+
+    def num_outputs(self) -> int:
+        return len(self._out_shapes)
+
+    def get_output_shape(self, index: int) -> Tuple[int, ...]:
+        if not 0 <= index < len(self._out_shapes):
+            raise MXNetError(f"c_predict: output index {index} out of "
+                             f"range ({len(self._out_shapes)} outputs)")
+        return self._out_shapes[index]
+
+    def get_output(self, index: int) -> bytes:
+        if not self._outputs:
+            raise MXNetError("c_predict: forward() has not run")
+        if not 0 <= index < len(self._outputs):
+            raise MXNetError(f"c_predict: output index {index} out of "
+                             f"range ({len(self._outputs)} outputs)")
+        return self._outputs[index].asnumpy() \
+            .astype(np.float32).tobytes()
+
+
+def _create(symbol_json: str, param_blob: bytes, dev_type: int,
+            dev_id: int, keys: Sequence[str],
+            shapes: Sequence[Sequence[int]]) -> Predictor:
+    """Entry point the embedded-C side calls."""
+    return Predictor(symbol_json, param_blob, dev_type, dev_id,
+                     {k: tuple(s) for k, s in zip(keys, shapes)})

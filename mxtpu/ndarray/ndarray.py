@@ -545,9 +545,9 @@ def save(fname: str, data, format=None) -> None:
         f.write(buf.getvalue())
 
 
-def load(fname: str):
-    with open(fname, "rb") as f:
-        blob = f.read()
+def loads(blob: bytes):
+    """Parse a checkpoint payload from memory — same auto-detection
+    as :func:`load` (legacy dmlc magic / MXTPU01 / bare npz)."""
     from . import legacy_format
     if legacy_format.is_legacy(blob[:8]):
         arrays, names = legacy_format.loads(blob)
@@ -565,3 +565,8 @@ def load(fname: str):
         # the reference's MXNDArrayLoad contract
         return [array(npz[k]) for k in sorted(keys, key=int)]
     return {k: array(npz[k]) for k in keys}
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        return loads(f.read())
