@@ -9,8 +9,9 @@ from __future__ import annotations
 
 from ..gluon import nn
 
-__all__ = ["lenet", "mlp", "resnet50", "ssd", "transformer"]
+__all__ = ["lenet", "mlp", "resnet50", "rcnn", "ssd", "transformer"]
 
+from . import rcnn  # noqa: E402,F401  (Faster R-CNN family)
 from . import ssd  # noqa: E402,F401  (SSD detector family)
 from . import transformer  # noqa: E402,F401  (BERT/Transformer family)
 

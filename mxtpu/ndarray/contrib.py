@@ -112,11 +112,14 @@ def box_iou(lhs, rhs, format="corner"):  # noqa: A002
     return NDArray(inter / jnp.maximum(union, 1e-12), None, _placed=True)
 
 
-def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk):
+def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk,
+                ids=None):
     """Greedy NMS with static shapes: iterates topk times via fori_loop,
-    suppressing overlaps.  Returns keep mask — the padded-max-size
-    contract replacing the reference's dynamic-output NMS
-    (src/operator/contrib/bounding_box.cc†)."""
+    suppressing overlaps.  ``ids`` (optional per-box class ids) limits
+    suppression to same-class pairs (box_nms ``id_index`` semantics
+    when ``force_suppress=False``).  Returns keep mask — the
+    padded-max-size contract replacing the reference's dynamic-output
+    NMS (src/operator/contrib/bounding_box.cc†)."""
     n = scores.shape[0]
     order = jnp.argsort(-scores)
     boxes_s = boxes[order]
@@ -128,6 +131,9 @@ def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk):
     area = jnp.maximum((boxes_s[:, 2] - boxes_s[:, 0]) *
                        (boxes_s[:, 3] - boxes_s[:, 1]), 0.0)
     iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+    if ids is not None:
+        ids_s = ids[order]
+        iou = jnp.where(ids_s[:, None] == ids_s[None, :], iou, 0.0)
 
     def body(i, keep):
         # suppress j>i overlapping box i if i kept
@@ -153,8 +159,12 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
     def one(db):
         scores = db[:, score_index]
         boxes = lax.dynamic_slice_in_dim(db, coord_start, 4, axis=1)
+        # id_index restricts suppression to same-class pairs unless
+        # force_suppress (reference box_nms semantics)
+        ids = db[:, id_index] if id_index >= 0 and not force_suppress \
+            else None
         keep, order = _nms_single(scores, boxes, overlap_thresh,
-                                  valid_thresh, topk)
+                                  valid_thresh, topk, ids=ids)
         out = jnp.where(keep[:, None], db, -jnp.ones_like(db))
         return out
 

@@ -251,21 +251,18 @@ def _multibox_detection(cls_prob, loc_pred, anchors, clip=True,
         bs = boxes[order]
         ss = jnp.where(keep_score[order], score[order], 0.0)
         cs = cls_id[order]
-        iou = _iou_corner(bs, bs)
         A = bs.shape[0]
-
-        def body(i, keep):
-            same_cls = (cs == cs[i]) | force_suppress
-            sup = (iou[i] > nms_threshold) & (jnp.arange(A) > i) & \
-                keep[i] & same_cls
-            return keep & ~sup
-
+        # class-aware suppression = mask cross-class pairs out of the
+        # IoU matrix (unless force_suppress)
+        same_cls = (cs[:, None] == cs[None, :]) | force_suppress
+        iou = jnp.where(same_cls, _iou_corner(bs, bs), 0.0)
         keep0 = ss > 0.0
         if nms_topk > 0:
             # reference: only the top-k scored boxes enter NMS at all
             keep0 = keep0 & (jnp.arange(A) < nms_topk)
-        keep = lax.fori_loop(0, A if nms_topk < 0 else min(nms_topk, A),
-                             body, keep0)
+        keep = _greedy_nms_keep(
+            iou, keep0, nms_threshold,
+            A if nms_topk < 0 else min(nms_topk, A))
         out = jnp.concatenate([cs[:, None], ss[:, None], bs], axis=1)
         return jnp.where(keep[:, None], out, -jnp.ones_like(out))
 
@@ -447,3 +444,155 @@ register_op("quantize_v2", num_inputs=1, num_outputs=3,
                           enum=("uint8", "int8"))],
             aliases=("_contrib_quantize_v2",),
             differentiable=False)(_quantize_v2)
+
+
+# ----------------------------------------------------------------------
+# RPN Proposal (reference ``src/operator/contrib/proposal.cc``†)
+# ----------------------------------------------------------------------
+
+def _base_anchors(stride, scales, ratios):
+    """Anchors centered on one stride cell (reference
+    ``GenerateAnchors``†: ratio enumeration preserves area, then
+    scales)."""
+    base = float(stride)
+    cx = cy = (base - 1.0) / 2.0
+    out = []
+    area = base * base
+    for r in ratios:
+        w = np.round(np.sqrt(area / r))
+        h = np.round(w * r)
+        for s in scales:
+            ws, hs = w * s, h * s
+            out.append([cx - (ws - 1) / 2, cy - (hs - 1) / 2,
+                        cx + (ws - 1) / 2, cy + (hs - 1) / 2])
+    return np.asarray(out, np.float32)
+
+
+def _anchor_grid(height, width, feature_stride, scales, ratios):
+    """All anchors for a height×width feature map in pixel coords,
+    position-major anchor-minor — THE ordering contract shared by the
+    Proposal op and models.rcnn.rpn_anchors."""
+    base = _base_anchors(feature_stride, scales, ratios)
+    sx = np.arange(width, dtype=np.float32) * feature_stride
+    sy = np.arange(height, dtype=np.float32) * feature_stride
+    shift = np.stack([np.tile(sx, height), np.repeat(sy, width),
+                      np.tile(sx, height), np.repeat(sy, width)],
+                     axis=1)
+    return (shift[:, None, :] + base[None]).reshape(-1, 4)
+
+
+def _pixel_iou(boxes):
+    """Pairwise IoU under the reference's +1-pixel convention
+    (proposal.cc†: widths are x2-x1+1)."""
+    tl = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    br = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(br - tl + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = (boxes[:, 2] - boxes[:, 0] + 1.0) * \
+        (boxes[:, 3] - boxes[:, 1] + 1.0)
+    return inter / jnp.maximum(area[:, None] + area[None] - inter,
+                               1e-12)
+
+
+def _greedy_nms_keep(iou, keep0, threshold, n_iter):
+    """The one greedy-suppression loop (score-descending rows): row i,
+    if alive, kills every later row whose (possibly masked) IoU
+    exceeds the threshold."""
+    n = iou.shape[0]
+
+    def body(i, keep):
+        sup = (iou[i] > threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    return lax.fori_loop(0, n_iter, body, keep0)
+
+
+def _proposal(cls_prob, bbox_pred, im_info, scales=(4.0, 8.0, 16.0,
+                                                    32.0),
+              ratios=(0.5, 1.0, 2.0), feature_stride=16,
+              rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+              threshold=0.7, rpn_min_size=16, output_score=False):
+    """RPN proposals: decode anchor deltas, clip, min-size filter,
+    top-k, NMS (reference ``_contrib_Proposal``†).  cls_prob
+    (N, 2A, H, W) — background scores first; bbox_pred (N, 4A, H, W);
+    im_info (N, 3) rows [height, width, scale].  Returns rois
+    (N*post_nms, 5) rows [batch_idx, x1, y1, x2, y2] (+ scores
+    (N*post_nms, 1) when output_score); short batches pad with
+    zero-boxes."""
+    N, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    if A != len(scales) * len(ratios):
+        raise MXNetError(
+            f"Proposal: cls_prob carries {A} anchors/position but "
+            f"scales×ratios = {len(scales)}×{len(ratios)} = "
+            f"{len(scales) * len(ratios)}")
+    anchors = jnp.asarray(_anchor_grid(H, W, feature_stride, scales,
+                                       ratios))
+    M = anchors.shape[0]
+    pre_n = min(int(rpn_pre_nms_top_n), M) \
+        if rpn_pre_nms_top_n > 0 else M
+    post_n = int(rpn_post_nms_top_n)
+
+    def one(scores_hw, deltas_hw, info):
+        # (2A,H,W) → fg (H,W,A) → (M,), position-major anchor-minor
+        fg = jnp.transpose(scores_hw[A:], (1, 2, 0)).reshape(-1)
+        d = jnp.transpose(
+            deltas_hw.reshape(A, 4, H, W), (2, 3, 0, 1)).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + (aw - 1.0) / 2
+        acy = anchors[:, 1] + (ah - 1.0) / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10.0, 10.0)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10.0, 10.0)) * ah
+        boxes = jnp.stack([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                           cx + (w - 1) / 2, cy + (h - 1) / 2], axis=1)
+        # clip to image, drop boxes below min size (at image scale)
+        ih, iw, scl = info[0], info[1], info[2]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, ih - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, iw - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, ih - 1.0)], axis=1)
+        min_sz = rpn_min_size * scl
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= min_sz) & \
+            ((boxes[:, 3] - boxes[:, 1] + 1.0) >= min_sz)
+        score = jnp.where(keep_sz, fg, -jnp.inf)
+        order = jnp.argsort(-score)[:pre_n]
+        bs = boxes[order]
+        ss = score[order]
+        keep = _greedy_nms_keep(_pixel_iou(bs), ss > -jnp.inf,
+                                threshold, pre_n)
+        # compact kept rows into the first post_n slots
+        rank = jnp.cumsum(keep) - 1
+        tgt = jnp.where(keep & (rank < post_n), rank, post_n)
+        out_b = jnp.zeros((post_n + 1, 4), jnp.float32) \
+            .at[tgt].set(bs, mode="drop")[:post_n]
+        out_s = jnp.zeros((post_n + 1,), jnp.float32) \
+            .at[tgt].set(jnp.where(keep, ss, 0.0),
+                         mode="drop")[:post_n]
+        return out_b, out_s
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.float32), post_n)
+    rois = jnp.concatenate(
+        [batch_idx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+register_op("Proposal", num_inputs=3,
+            params=[Param("scales", tuple, (4.0, 8.0, 16.0, 32.0)),
+                    Param("ratios", tuple, (0.5, 1.0, 2.0)),
+                    Param("feature_stride", int, 16),
+                    Param("rpn_pre_nms_top_n", int, 6000),
+                    Param("rpn_post_nms_top_n", int, 300),
+                    Param("threshold", float, 0.7),
+                    Param("rpn_min_size", int, 16),
+                    Param("output_score", bool, False)],
+            aliases=("_contrib_Proposal", "_contrib_MultiProposal"),
+            num_outputs_fn=lambda params:
+                2 if params.get("output_score") else 1,
+            differentiable=False)(_proposal)

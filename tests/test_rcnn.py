@@ -1,0 +1,212 @@
+"""Faster R-CNN family: Proposal op semantics, model shapes, RPN
+training, end-to-end detect().
+
+Reference: ``src/operator/contrib/proposal.cc``† and
+``example/rcnn/``†.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, autograd
+from mxtpu.base import MXNetError
+from mxtpu.models.rcnn import faster_rcnn_small, rpn_anchors
+
+
+# ----------------------------------------------------------------------
+# Proposal op
+# ----------------------------------------------------------------------
+def test_proposal_shapes_and_ordering():
+    np.random.seed(0)
+    N, A, H, W = 2, 3, 4, 4
+    cls = np.random.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox = (np.random.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    info = np.array([[64, 64, 1.0]] * N, np.float32)
+    post = 8
+    rois = nd.Proposal(nd.array(cls), nd.array(bbox), nd.array(info),
+                       scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+                       feature_stride=16, rpn_pre_nms_top_n=24,
+                       rpn_post_nms_top_n=post, threshold=0.7,
+                       rpn_min_size=4)
+    r = rois.asnumpy()
+    assert r.shape == (N * post, 5)
+    # batch indices laid out block-wise
+    np.testing.assert_array_equal(r[:post, 0], np.zeros(post))
+    np.testing.assert_array_equal(r[post:, 0], np.ones(post))
+    # boxes clipped to the image
+    assert r[:, 1:].min() >= 0.0 and r[:, 1:].max() <= 63.0
+
+
+def test_proposal_picks_highest_objectness():
+    """The proposal with the clearly highest fg score must survive as
+    roi #1, with its regressed (delta=0 → anchor) box."""
+    N, A, H, W = 1, 1, 4, 4
+    cls = np.zeros((N, 2, H, W), np.float32)
+    cls[0, 1, 2, 1] = 5.0  # strong fg at cell (2,1)
+    bbox = np.zeros((N, 4, H, W), np.float32)
+    info = np.array([[64, 64, 1.0]], np.float32)
+    rois, scores = nd.Proposal(
+        nd.array(cls), nd.array(bbox), nd.array(info),
+        scales=(2.0,), ratios=(1.0,), feature_stride=16,
+        rpn_pre_nms_top_n=16, rpn_post_nms_top_n=4, threshold=0.5,
+        rpn_min_size=4, output_score=True)
+    r = rois.asnumpy()
+    s = scores.asnumpy()
+    assert s[0, 0] == s.max()
+    # anchor at cell (h=2, w=1): center ≈ (16*1+7.5, 16*2+7.5)
+    cx = (r[0, 1] + r[0, 3]) / 2
+    cy = (r[0, 2] + r[0, 4]) / 2
+    assert abs(cx - 23.5) < 1.0 and abs(cy - 39.5) < 1.0
+
+
+def test_proposal_nms_suppresses_duplicates():
+    """Two near-identical high-score anchors → only one survives."""
+    N, A, H, W = 1, 2, 2, 2
+    cls = np.zeros((N, 2 * A, H, W), np.float32)
+    cls[0, A + 0, 0, 0] = 4.0   # anchor 0 at (0,0)
+    cls[0, A + 1, 0, 0] = 3.9   # anchor 1 at (0,0) — same center
+    bbox = np.zeros((N, 4 * A, H, W), np.float32)
+    info = np.array([[64, 64, 1.0]], np.float32)
+    rois, scores = nd.Proposal(
+        nd.array(cls), nd.array(bbox), nd.array(info),
+        scales=(2.0, 2.2), ratios=(1.0,), feature_stride=16,
+        rpn_pre_nms_top_n=8, rpn_post_nms_top_n=8, threshold=0.5,
+        rpn_min_size=4, output_score=True)
+    s = scores.asnumpy().ravel()
+    # the two duplicates collapse to one strong survivor
+    assert (s > 0.9).sum() == 1
+
+
+def test_proposal_validates_anchor_count():
+    with pytest.raises(MXNetError):
+        nd.Proposal(nd.zeros((1, 6, 4, 4)), nd.zeros((1, 12, 4, 4)),
+                    nd.array(np.array([[64, 64, 1.0]], np.float32)),
+                    scales=(8.0,), ratios=(1.0,))
+
+
+# ----------------------------------------------------------------------
+# model
+# ----------------------------------------------------------------------
+def test_faster_rcnn_forward_shapes():
+    mx.random.seed(0)
+    net = faster_rcnn_small(num_classes=2)
+    net.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(0)
+                 .randn(2, 3, 64, 64).astype(np.float32))
+    info = nd.array(np.array([[64, 64, 1.0]] * 2, np.float32))
+    rois, cls_scores, deltas, rpn_raw, rpn_reg = net(x, info)
+    R = net._post_nms
+    assert rois.shape == (2 * R, 5)
+    assert cls_scores.shape == (2 * R, 3)
+    assert deltas.shape == (2 * R, 12)
+    assert rpn_raw.shape[1] == 2 * net._A
+    assert rpn_reg.shape[1] == 4 * net._A
+
+
+def test_rpn_training_improves_objectness():
+    """Train the RPN alone on a fixed synthetic scene: objectness CE
+    against MultiBoxTarget assignment on the generated anchors."""
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = faster_rcnn_small(num_classes=1)
+    net.initialize(init="xavier")
+    from mxtpu.gluon import Trainer
+    size = 64
+    x = rng.rand(2, 3, size, size).astype(np.float32) * 0.1
+    labels = np.zeros((2, 1, 5), np.float32)
+    for i in range(2):
+        w = 24
+        x0 = 8 + 16 * i
+        x[i, :, x0:x0 + w, x0:x0 + w] = 1.0
+        labels[i, 0] = [0, x0 / size, x0 / size,
+                        (x0 + w) / size, (x0 + w) / size]
+    x = nd.array(x)
+    labels = nd.array(labels)
+    info = nd.array(np.array([[size, size, 1.0]] * 2, np.float32))
+    net(x, info)  # deferred init
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 3e-3})
+    fh = fw = size // net._stride
+    anchors = rpn_anchors(fh, fw, net._stride, net._scales,
+                          net._ratios, size)
+    A = net._A
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            _, _, _, rpn_raw, rpn_reg = net(x, info)
+            # (N,2A,H,W) → logits (N, 2, M): bg/fg halves
+            bg = nd.transpose(
+                nd.slice_axis(rpn_raw, axis=1, begin=0, end=A),
+                axes=(0, 2, 3, 1)).reshape((2, -1))
+            fg = nd.transpose(
+                nd.slice_axis(rpn_raw, axis=1, begin=A, end=2 * A),
+                axes=(0, 2, 3, 1)).reshape((2, -1))
+            logits = nd.stack(bg, fg, axis=1)     # (N, 2, M)
+            cls_preds = logits  # MultiBoxTarget wants (N, C, Anum)
+            bt, bm, ct = nd.MultiBoxTarget(
+                anchors, labels, cls_preds, overlap_threshold=0.3,
+                negative_mining_ratio=3.0)
+            logp = nd.log_softmax(logits, axis=1)
+            ce = -nd.pick(logp, ct, axis=1)
+            loss = nd.mean(ce)
+        loss.backward()
+        trainer.step(batch_size=2)
+        losses.append(float(loss.asscalar()))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_proposal_symbolic_output_score():
+    """num_outputs tracks output_score through the symbol graph."""
+    from mxtpu import symbol as sym
+    cls = sym.var("cls")
+    bbox = sym.var("bbox")
+    info = sym.var("info")
+    two = sym.Proposal(cls, bbox, info, scales=(8.0,), ratios=(1.0,),
+                       rpn_post_nms_top_n=4, output_score=True)
+    assert len(two) == 2
+    one = sym.Proposal(cls, bbox, info, scales=(8.0,), ratios=(1.0,),
+                       rpn_post_nms_top_n=4)
+    assert len(one) == 1
+    np.random.seed(3)
+    c = nd.array(np.random.rand(1, 2, 4, 4).astype(np.float32))
+    b = nd.array(np.zeros((1, 4, 4, 4), np.float32))
+    i = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois, scores = two.eval(cls=c, bbox=b, info=i)
+    assert rois.shape == (4, 5) and scores.shape == (4, 1)
+
+
+def test_box_nms_id_index_class_separation():
+    """force_suppress=False + id_index: overlapping boxes of DIFFERENT
+    classes both survive; same class suppresses."""
+    rows = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 0, 0, 10, 10],   # same box, other class → survives
+        [0, 0.7, 1, 1, 10, 10],   # same class, overlaps → suppressed
+    ], np.float32)
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                             valid_thresh=0.0, coord_start=2,
+                             score_index=1, id_index=0,
+                             force_suppress=False).asnumpy()
+    assert out[0, 0] == 0 and out[1, 0] == 1
+    assert np.all(out[2] == -1)
+    out2 = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                              valid_thresh=0.0, coord_start=2,
+                              score_index=1, id_index=0,
+                              force_suppress=True).asnumpy()
+    assert np.all(out2[1] == -1) and np.all(out2[2] == -1)
+
+
+def test_detect_end_to_end():
+    mx.random.seed(1)
+    net = faster_rcnn_small(num_classes=2)
+    net.initialize(init="xavier")
+    x = nd.array(np.random.RandomState(2)
+                 .randn(1, 3, 64, 64).astype(np.float32))
+    info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    out = net.detect(x, info, score_threshold=0.01)
+    assert out.shape == (1, net._post_nms * 2, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    if len(kept):
+        assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()
+        assert kept[:, 2:].min() >= 0 and kept[:, 2:].max() <= 63
