@@ -1,0 +1,114 @@
+"""Character-level LSTM language model — the reference's classic
+``example/rnn/char-lstm``† / ``char_lstm.ipynb``† recipe.
+
+Trains on a text file (or a built-in Shakespeare-ish snippet when no
+--data is given), then samples text.  The whole unrolled step runs as
+one compiled program (Embedding → LSTM → Dense over time).
+
+  python examples/char_rnn.py --epochs 3 --seq-len 64
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn, rnn
+
+_FALLBACK = (
+    "the quick brown fox jumps over the lazy dog. "
+    "to be or not to be, that is the question: whether tis nobler "
+    "in the mind to suffer the slings and arrows of outrageous "
+    "fortune, or to take arms against a sea of troubles. "
+) * 40
+
+
+class CharLM(gluon.HybridBlock):
+    def __init__(self, vocab, embed=64, hidden=128, layers=2,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.embed = nn.Embedding(vocab, embed)
+        self.lstm = rnn.LSTM(hidden, num_layers=layers,
+                             layout="NTC")
+        self.out = nn.Dense(vocab, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return self.out(self.lstm(self.embed(x)))
+
+
+def batches(ids, batch_size, seq_len, rng):
+    n = (len(ids) - 1) // seq_len
+    starts = rng.permutation(n)[: (n // batch_size) * batch_size]
+    for i in range(0, len(starts), batch_size):
+        s = starts[i:i + batch_size]
+        x = np.stack([ids[j * seq_len:(j + 1) * seq_len] for j in s])
+        y = np.stack([ids[j * seq_len + 1:(j + 1) * seq_len + 1]
+                      for j in s])
+        yield nd.array(x.astype(np.float32)), \
+            nd.array(y.astype(np.float32))
+
+
+def sample(net, stoi, itos, seed_text, length, temperature=0.8):
+    ids = [stoi[c] for c in seed_text if c in stoi]
+    rng = np.random.RandomState(0)
+    for _ in range(length):
+        x = nd.array(np.asarray(ids, np.float32)[None])
+        logits = net(x).asnumpy()[0, -1] / temperature
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        ids.append(int(rng.choice(len(p), p=p)))
+    return "".join(itos[i] for i in ids)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", type=str, default=None,
+                    help="path to a text file")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--sample-len", type=int, default=120)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    text = open(args.data).read() if args.data else _FALLBACK
+    chars = sorted(set(text))
+    stoi = {c: i for i, c in enumerate(chars)}
+    itos = {i: c for c, i in stoi.items()}
+    ids = np.asarray([stoi[c] for c in text], np.int32)
+    logging.info("corpus: %d chars, vocab %d", len(ids), len(chars))
+
+    mx.random.seed(0)
+    net = CharLM(len(chars))
+    net.initialize(init="xavier")
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = None
+    rng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        total, n = 0.0, 0
+        for x, y in batches(ids, args.batch_size, args.seq_len, rng):
+            if trainer is None:
+                net(x)
+                trainer = gluon.Trainer(net.collect_params(), "adam",
+                                        {"learning_rate": args.lr})
+            with autograd.record():
+                logits = net(x)
+                loss = nd.mean(loss_fn(logits, y))
+            loss.backward()
+            trainer.step(batch_size=x.shape[0])
+            total += float(loss.asscalar())
+            n += 1
+        logging.info("epoch %d: perplexity %.2f", epoch,
+                     float(np.exp(total / max(n, 1))))
+    print(sample(net, stoi, itos, "the ", args.sample_len))
+
+
+if __name__ == "__main__":
+    main()

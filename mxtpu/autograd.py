@@ -15,7 +15,7 @@ executables, not per-op dispatch (the reference gets the same effect from
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,10 +99,11 @@ class TapeNode:
       ("node", TapeNode, out_idx) | ("leaf", NDArray) | None (constant)
     """
     __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "out_grads",
-                 "out_avals", "out_is_tuple", "_visited")
+                 "out_avals", "out_is_tuple", "_visited", "fn",
+                 "arrays", "input_refs")
 
     def __init__(self, name, vjp_fn, parents, n_outputs, out_avals=None,
-                 out_is_tuple=False):
+                 out_is_tuple=False, fn=None, arrays=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = parents
@@ -113,6 +114,15 @@ class TapeNode:
         # 1-element tuple primal still needs a 1-element tuple cotangent
         self.out_is_tuple = out_is_tuple
         self._visited = False
+        # primal fn + input buffers, kept for create_graph=True: the
+        # recorded backward re-derives vjp INSIDE a traced function so
+        # the gradient's dependence on the primals differentiates too.
+        # Memory note: for matmul/conv-class ops these buffers overlap
+        # the vjp residuals jax already keeps; the extra retention is
+        # the price of always-available higher-order (the reference
+        # retains its graph the same way).
+        self.fn = fn
+        self.arrays = arrays
 
 
 def _needs_grad(x) -> bool:
@@ -139,7 +149,8 @@ def record_op(name: str, fn: Callable, inputs: Sequence[Any],
     outs_t = out if isinstance(out, tuple) else (out,)
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_t]
     node = TapeNode(name, vjp_fn, parents, len(outs_t), avals,
-                    out_is_tuple=isinstance(out, tuple))
+                    out_is_tuple=isinstance(out, tuple), fn=fn,
+                    arrays=tuple(arrays))
     return out, node
 
 
@@ -274,14 +285,155 @@ def _is_float0(x) -> bool:
     return hasattr(x, "dtype") and x.dtype == jax.dtypes.float0
 
 
+def _grad_recorded(heads, variables, head_grads, train_mode):
+    """``grad(create_graph=True)``: replay the tape backward while
+    RECORDING — every vjp application and cotangent accumulation goes
+    through ``record_op``, so the returned gradients carry their own
+    tape nodes and differentiate again (arbitrary order).  jax's vjp
+    closures are themselves jax-differentiable, which is what makes
+    this a pure tape-layer feature."""
+    from .ndarray.ndarray import NDArray
+
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    else:
+        head_grads = [head_grads] if isinstance(head_grads, NDArray) \
+            else list(head_grads)
+
+    roots = []
+    seeds: Dict[Tuple[int, int], NDArray] = {}
+    for h, hg in zip(heads, head_grads):
+        if h._tape is None:
+            continue
+        node, idx = h._tape
+        roots.append(node)
+        seed = hg if hg is not None else NDArray(
+            jnp.ones(h.data.shape, h.data.dtype), None, _placed=True)
+        key = (id(node), idx)
+        seeds[key] = seed if key not in seeds else seeds[key] + seed
+    if not roots:
+        raise MXNetError("heads are not on the tape; call inside "
+                         "autograd.record()")
+
+    order = _toposort(roots)
+    # cotangents as NDArrays keyed by (node, out_idx) — NDArray `+`
+    # records accumulation nodes, chaining the second-order graph
+    cots: Dict[Tuple[int, int], NDArray] = dict(seeds)
+    leaf_cots: Dict[int, Tuple[Any, NDArray]] = {}
+    # requested intermediate variables: snapshot their cotangent at
+    # consumption time (the sweep pops cots as it goes)
+    watch_keys = {(id(v._tape[0]), v._tape[1])
+                  for v in variables if v._tape is not None}
+    watched: Dict[Tuple[int, int], NDArray] = {}
+    for node in reversed(order):
+        cts = []
+        any_seen = False
+        for i in range(node.n_outputs):
+            key = (id(node), i)
+            c = cots.pop(key, None)
+            if c is not None and key in watch_keys:
+                watched[key] = c
+            if c is None:
+                c = NDArray(jnp.zeros(node.out_avals[i].shape,
+                                      node.out_avals[i].dtype), None,
+                            _placed=True)
+            else:
+                any_seen = True
+            cts.append(c)
+        if not any_seen:
+            continue
+
+        if node.fn is None:
+            raise MXNetError(
+                f"create_graph=True through node {node.name!r} is "
+                f"unsupported: it carries no replayable primal "
+                f"(autograd.Function nodes define only a first-order "
+                f"backward)")
+        n_ct = len(cts)
+        primal_fn = node.fn
+        out_is_tuple = node.out_is_tuple
+
+        def apply_vjp(*args, _fn=primal_fn, _tup=out_is_tuple,
+                      _n=n_ct):
+            # re-derive the vjp INSIDE the traced function: the
+            # result depends differentiably on BOTH the cotangents
+            # and the primal inputs (closure-captured vjp_fn would
+            # hide the primal dependence from the second order)
+            raw_cts, prim = args[:_n], args[_n:]
+            _, vjp = jax.vjp(_fn, *prim)
+            ct = tuple(raw_cts) if _tup else raw_cts[0]
+            return tuple(vjp(ct))
+
+        # rebuild tape-connected handles for the primal inputs from
+        # the parent edges (no extra wrapper retention on the node)
+        prim_refs = []
+        for parent, arr in zip(node.parents, node.arrays):
+            if parent is None:
+                prim_refs.append(None)
+            elif parent[0] == "leaf":
+                prim_refs.append(parent[1])
+            else:
+                ref = NDArray(arr, None, _placed=True)
+                ref._tape = (parent[1], parent[2])
+                prim_refs.append(ref)
+        rec_inputs = list(cts) + prim_refs
+        rec_arrays = [c.data for c in cts] + list(node.arrays)
+        raw_out, n2 = record_op(f"{node.name}_bwd", apply_vjp,
+                                rec_inputs, rec_arrays)
+        outs = raw_out if isinstance(raw_out, tuple) else (raw_out,)
+        for j, (parent, ig) in enumerate(zip(node.parents, outs)):
+            if parent is None or ig is None or _is_float0(ig):
+                continue
+            ig_nd = NDArray(ig, None, _placed=True)
+            attach_output(ig_nd, n2, j)
+            if parent[0] == "node":
+                _, pnode, pidx = parent
+                key = (id(pnode), pidx)
+                cots[key] = ig_nd if key not in cots \
+                    else cots[key] + ig_nd
+            else:
+                leaf = parent[1]
+                k = id(leaf)
+                leaf_cots[k] = (leaf, ig_nd) if k not in leaf_cots \
+                    else (leaf, leaf_cots[k][1] + ig_nd)
+
+    outs = []
+    for v in variables:
+        g = None
+        if v._tape is not None:
+            key = (id(v._tape[0]), v._tape[1])
+            g = watched.get(key)
+            if g is None:
+                g = cots.get(key)
+        if g is None:
+            got = leaf_cots.get(id(v))
+            g = got[1] if got is not None else None
+        if g is None:
+            raise MXNetError(
+                "some variables are unreachable from the heads' graph; "
+                "mark them with attach_grad() before recording")
+        outs.append(g)
+    return outs[0] if len(outs) == 1 else outs
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Return gradients of heads w.r.t. variables without touching .grad
-    (reference ``autograd.grad``†).  create_graph (higher-order) is
-    supported through jax by re-recording — round-2 follow-up."""
+    (reference ``autograd.grad``†).  With ``create_graph=True`` the
+    backward pass itself is recorded, so the results differentiate
+    again (higher-order)."""
     from .ndarray.ndarray import NDArray
     if create_graph:
-        raise MXNetError("create_graph=True not yet supported")
+        variables = [variables] if isinstance(variables, NDArray) \
+            else list(variables)
+        # create_graph implies recording the backward (reference
+        # semantics) — force a record scope so the cotangent
+        # accumulations and vjp replays land on the tape even when
+        # called outside the user's record() block
+        with record(train_mode=train_mode):
+            return _grad_recorded(heads, variables, head_grads,
+                                  train_mode)
     variables = [variables] if isinstance(variables, NDArray) \
         else list(variables)
     # gradients flow into a side map — no .grad buffer (of the requested

@@ -20,6 +20,7 @@ from ..ops.registry import OP_REGISTRY, get_op, list_ops
 from . import ops_impl  # noqa: F401  (populates the registry)
 from . import rnn_impl  # noqa: F401  (fused RNN op)
 from . import detection_impl  # noqa: F401  (SSD/ROI/CTC/quantize ops)
+from . import spatial_impl  # noqa: F401  (grid/sampler/crop/corr ops)
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concat, stack, save, load, waitall, from_numpy,
                       linspace, eye, zeros_like as _zeros_like_fn)
@@ -159,6 +160,19 @@ def Dropout(data, p=0.5, mode=None, axes=()):  # noqa: N802
 
 setattr(_THIS_MODULE, "Dropout", Dropout)
 setattr(_THIS_MODULE, "dropout", Dropout)
+
+# shuffle convenience: auto key (reference mx.nd.shuffle draws from
+# the global RNG)
+_raw_shuffle = getattr(_THIS_MODULE, "shuffle")
+
+
+def shuffle(data):  # noqa: N802
+    from . import random as _rnd
+    return _raw_shuffle(data, _rnd._next_key_nd())
+
+
+setattr(_THIS_MODULE, "shuffle", shuffle)
+setattr(_THIS_MODULE, "_shuffle", shuffle)
 
 zeros_like = getattr(_THIS_MODULE, "zeros_like")
 ones_like = getattr(_THIS_MODULE, "ones_like")

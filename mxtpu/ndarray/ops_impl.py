@@ -87,7 +87,8 @@ for _name, _fn in _UNARY.items():
 register_op("_copy", aliases=("copy",))(lambda x: x)
 register_op("BlockGrad", aliases=("stop_gradient",))(
     lambda x: lax.stop_gradient(x))
-register_op("make_loss")(lambda x: x)
+# (MakeLoss with its defined-gradient semantics is registered with the
+# legacy output ops below; alias "make_loss")
 
 
 # ======================================================================
@@ -1231,3 +1232,325 @@ register_op("signum_update", num_inputs=3, num_outputs=2,
                     Param("clip_gradient", float, -1.0),
                     Param("wd_lh", float, 0.0)],
             differentiable=False)(_signum)
+
+
+# ----------------------------------------------------------------------
+# legacy output ops (reference ``src/operator/regression_output*.cc``†,
+# ``make_loss.cc``†, ``svm_output.cc``†): forward is (mostly) identity;
+# the op DEFINES its gradient via custom_vjp, matching the reference's
+# hand-written backward
+# ----------------------------------------------------------------------
+def _make_output_op(fwd_fn, bwd_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd_fn(data, label)
+
+    def fwd(data, label, grad_scale):
+        return fwd_fn(data, label), (data, label)
+
+    def bwd(grad_scale, res, g):
+        data, label = res
+        return bwd_fn(data, label, grad_scale, g), jnp.zeros_like(label)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+def _per_sample_outputs(d):
+    # reference regression_output-inl.h†: scale = grad_scale /
+    # (label.Size() / batch) — outputs PER SAMPLE, not batch size
+    return max(int(np.prod(d.shape[1:])), 1) if d.ndim > 1 else 1
+
+
+_linreg_core = _make_output_op(
+    lambda d, l: d,
+    lambda d, l, s, g: (d - l.reshape(d.shape)) * s /
+    _per_sample_outputs(d) * jnp.ones_like(g))
+_maereg_core = _make_output_op(
+    lambda d, l: d,
+    lambda d, l, s, g: jnp.sign(d - l.reshape(d.shape)) * s /
+    _per_sample_outputs(d) * jnp.ones_like(g))
+_logreg_core = _make_output_op(
+    lambda d, l: jax.nn.sigmoid(d),
+    lambda d, l, s, g: (jax.nn.sigmoid(d) - l.reshape(d.shape)) * s /
+    _per_sample_outputs(d) * jnp.ones_like(g))
+
+register_op("LinearRegressionOutput", num_inputs=2,
+            params=[Param("grad_scale", float, 1.0)])(
+    lambda data, label, grad_scale=1.0:
+    _linreg_core(data, label, grad_scale))
+register_op("MAERegressionOutput", num_inputs=2,
+            params=[Param("grad_scale", float, 1.0)])(
+    lambda data, label, grad_scale=1.0:
+    _maereg_core(data, label, grad_scale))
+register_op("LogisticRegressionOutput", num_inputs=2,
+            params=[Param("grad_scale", float, 1.0)])(
+    lambda data, label, grad_scale=1.0:
+    _logreg_core(data, label, grad_scale))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _make_loss_core(data, grad_scale, normalization, valid_thresh):
+    return data
+
+
+def _ml_fwd(data, grad_scale, normalization, valid_thresh):
+    return data, data
+
+
+def _ml_bwd(grad_scale, normalization, valid_thresh, data, g):
+    scale = jnp.asarray(grad_scale, g.dtype)
+    if normalization == "batch":
+        scale = scale / data.shape[0]
+    elif normalization == "valid":
+        # reference: divide by the count of elements above
+        # valid_thresh (make_loss.cc†)
+        n_valid = jnp.sum(data > valid_thresh).astype(g.dtype)
+        scale = scale / jnp.maximum(n_valid, 1.0)
+    # the reference ignores the incoming gradient: MakeLoss IS a loss
+    return (jnp.broadcast_to(scale, data.shape).astype(g.dtype),)
+
+
+_make_loss_core.defvjp(_ml_fwd, _ml_bwd)
+
+register_op("MakeLoss", num_inputs=1,
+            params=[Param("grad_scale", float, 1.0),
+                    Param("valid_thresh", float, 0.0),
+                    Param("normalization", str, "null",
+                          enum=("null", "batch", "valid"))],
+            aliases=("make_loss",))(
+    lambda data, grad_scale=1.0, valid_thresh=0.0,
+    normalization="null": _make_loss_core(data, grad_scale,
+                                          normalization,
+                                          valid_thresh))
+
+
+def _svm_core_builder():
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def core(data, label, margin, reg_coef, use_linear):
+        return data
+
+    def fwd(data, label, margin, reg_coef, use_linear):
+        return data, (data, label)
+
+    def bwd(margin, reg_coef, use_linear, res, g):
+        data, label = res
+        C = data.shape[1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), C,
+                                dtype=data.dtype)
+        # hinge: grad = -y for margin violators (y in {-1, +1})
+        y = 2.0 * onehot - 1.0
+        viol = (y * data) < margin
+        grad = jnp.where(viol, -y, 0.0) * reg_coef
+        if not use_linear:   # squared hinge
+            grad = grad * jnp.maximum(margin - y * data, 0.0) * 2.0
+        return grad * jnp.ones_like(g), jnp.zeros_like(label)
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_svm_core = _svm_core_builder()
+
+register_op("SVMOutput", num_inputs=2,
+            params=[Param("margin", float, 1.0),
+                    Param("regularization_coefficient", float, 1.0),
+                    Param("use_linear", bool, False)])(
+    lambda data, label, margin=1.0, regularization_coefficient=1.0,
+    use_linear=False: _svm_core(data, label, margin,
+                                regularization_coefficient,
+                                use_linear))
+
+
+# ----------------------------------------------------------------------
+# normalization / statistics additions
+# ----------------------------------------------------------------------
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """(N, C, ...) grouped normalization (reference ``GroupNorm``†)."""
+    N, C = data.shape[0], data.shape[1]
+    if C % num_groups:
+        raise MXNetError(f"GroupNorm: {C} channels not divisible by "
+                         f"{num_groups} groups")
+    x = data.reshape((N, num_groups, -1))
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = [1] * data.ndim
+    shape[1] = C
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+register_op("GroupNorm", num_inputs=3,
+            params=[Param("num_groups", int, 1),
+                    Param("eps", float, 1e-5)])(_group_norm)
+
+
+def _moments(data, axes=None, keepdims=False):
+    ax = tuple(axes) if axes is not None else None
+    mean_k = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean_k), axis=ax,
+                   keepdims=keepdims)
+    mean = mean_k if keepdims else jnp.squeeze(
+        mean_k, axis=ax if ax is not None
+        else tuple(range(data.ndim)))
+    return mean, var
+
+
+register_op("moments", num_outputs=2,
+            params=[Param("axes", tuple, None),
+                    Param("keepdims", bool, False)])(_moments)
+
+
+# ----------------------------------------------------------------------
+# elementwise / indexing additions
+# ----------------------------------------------------------------------
+register_op("digamma")(lambda x: jax.scipy.special.digamma(x))
+register_op("logical_xor", num_inputs=2, aliases=("_logical_xor",))(
+    lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype))
+register_op("hard_sigmoid",
+            params=[Param("alpha", float, 0.2),
+                    Param("beta", float, 0.5)])(
+    lambda x, alpha=0.2, beta=0.5: jnp.clip(alpha * x + beta, 0.0,
+                                            1.0))
+register_op("log_sigmoid")(lambda x: jax.nn.log_sigmoid(x))
+register_op("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+register_op("_eye", num_inputs=0,
+            params=[Param("N", int, 0), Param("M", int, 0),
+                    Param("k", int, 0),
+                    Param("dtype", str, "float32")])(
+    lambda N=0, M=0, k=0, dtype="float32":
+    jnp.eye(N, M if M > 0 else None, k=k, dtype=dtype))
+register_op("_linspace", num_inputs=0,
+            params=[Param("start", float, 0.0),
+                    Param("stop", float, 1.0),
+                    Param("num", int, 50),
+                    Param("endpoint", bool, True),
+                    Param("dtype", str, "float32")])(
+    lambda start=0.0, stop=1.0, num=50, endpoint=True,
+    dtype="float32": jnp.linspace(start, stop, num,
+                                  endpoint=endpoint, dtype=dtype))
+
+
+def _histogram(data, bin_cnt=10, range=None):
+    # keep lo/hi traced (no float()) so shape inference and jitted
+    # use work
+    lo, hi = (range if range is not None
+              else (jnp.min(data), jnp.max(data)))
+    counts, edges = jnp.histogram(data, bins=int(bin_cnt),
+                                  range=(lo, hi))
+    return counts, edges.astype(jnp.float32)
+
+
+register_op("histogram", num_outputs=2,
+            params=[Param("bin_cnt", int, 10),
+                    Param("range", tuple, None)],
+            aliases=("_histogram",), differentiable=False)(_histogram)
+
+register_op("batch_take", num_inputs=2)(
+    lambda a, indices: jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0])
+register_op("unravel_index", aliases=("_unravel_index",),
+            params=[Param("shape", tuple, None)],
+            differentiable=False)(
+    lambda indices, shape=None: jnp.stack(
+        jnp.unravel_index(indices.astype(jnp.int32), shape)).astype(
+        indices.dtype))
+register_op("ravel_multi_index", aliases=("_ravel_multi_index",),
+            params=[Param("shape", tuple, None)],
+            differentiable=False)(
+    lambda indices, shape=None: jnp.ravel_multi_index(
+        tuple(indices.astype(jnp.int32)), shape,
+        mode="clip").astype(indices.dtype))
+
+
+def _shuffle(data, key):
+    return jax.random.permutation(_as_prng_key(key), data, axis=0)
+
+
+register_op("shuffle", num_inputs=2, aliases=("_shuffle",),
+            differentiable=False)(_shuffle)
+
+
+def _split_v2(data, indices=(), axis=0, squeeze_axis=False,
+              sections=0):
+    if sections:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+register_op("split_v2", aliases=("_split_v2",),
+            params=[Param("indices", tuple, ()),
+                    Param("axis", int, 0),
+                    Param("squeeze_axis", bool, False),
+                    Param("sections", int, 0)],
+            num_outputs_fn=lambda p:
+                int(p["sections"]) if p.get("sections")
+                else len(tuple(p.get("indices", ()))) + 1)(_split_v2)
+
+
+# ----------------------------------------------------------------------
+# fused multi-weight optimizer updates (reference
+# ``src/operator/optimizer_op.cc``† multi_sgd family — one kernel
+# updating every weight, the AMP/horovod fast path)
+# ----------------------------------------------------------------------
+def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    n = int(num_weights)
+    if len(arrays) != 2 * n:
+        raise MXNetError(f"multi_sgd_update expects {2 * n} inputs "
+                         f"(weight, grad)×{n}, got {len(arrays)}")
+    outs = []
+    for i in range(n):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs) if n > 1 else outs[0]
+
+
+register_op("multi_sgd_update", num_inputs=-1,
+            params=[Param("lrs", tuple, ()),
+                    Param("wds", tuple, ()),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("num_weights", int, 1)],
+            num_outputs_fn=lambda p: int(p.get("num_weights", 1)),
+            differentiable=False)(_multi_sgd_update)
+
+
+def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    n = int(num_weights)
+    if len(arrays) != 3 * n:
+        raise MXNetError(f"multi_sgd_mom_update expects {3 * n} inputs "
+                         f"(weight, grad, mom)×{n}, got {len(arrays)}")
+    outs = []
+    moms = []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m2 = momentum * m - lrs[i] * (g + wds[i] * w)
+        outs.append(w + m2)
+        moms.append(m2)
+    return tuple(outs + moms) if n > 1 else (outs[0], moms[0])
+
+
+register_op("multi_sgd_mom_update", num_inputs=-1,
+            params=[Param("lrs", tuple, ()),
+                    Param("wds", tuple, ()),
+                    Param("momentum", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("num_weights", int, 1)],
+            num_outputs_fn=lambda p: 2 * int(p.get("num_weights",
+                                                    1)),
+            differentiable=False)(_multi_sgd_mom_update)

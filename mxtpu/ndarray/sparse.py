@@ -23,7 +23,8 @@ from ..context import Context, current_context
 from .ndarray import NDArray, array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
-           "csr_matrix", "zeros"]
+           "csr_matrix", "zeros", "cast_storage", "retain", "dot",
+           "elemwise_add", "add_n"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -47,7 +48,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, dense_data, indices, ctx=None):
         super().__init__(dense_data, ctx)
-        self._indices = jnp.asarray(indices, dtype=jnp.int64) \
+        self._indices = jnp.asarray(indices, dtype=jnp.int32) \
             if indices is not None else None
 
     @property
@@ -59,7 +60,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         if self._indices is None:
             nz = np.nonzero(np.any(np.asarray(self._data) != 0,
                                    axis=tuple(range(1, self._data.ndim))))[0]
-            self._indices = jnp.asarray(nz, dtype=jnp.int64)
+            self._indices = jnp.asarray(nz, dtype=jnp.int32)
         return NDArray(self._indices, self._ctx, _placed=True)
 
     @property
@@ -87,9 +88,9 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, dense_data, indptr=None, indices=None, ctx=None):
         super().__init__(dense_data, ctx)
         self._indptr = None if indptr is None else jnp.asarray(
-            indptr, jnp.int64)
+            indptr, jnp.int32)
         self._col_indices = None if indices is None else jnp.asarray(
-            indices, jnp.int64)
+            indices, jnp.int32)
 
     @property
     def stype(self):
@@ -105,8 +106,8 @@ class CSRNDArray(BaseSparseNDArray):
             cols.extend(nz.tolist())
             vals.extend(d[r, nz].tolist())
             indptr.append(len(cols))
-        self._indptr = jnp.asarray(indptr, jnp.int64)
-        self._col_indices = jnp.asarray(cols, jnp.int64)
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self._col_indices = jnp.asarray(cols, jnp.int32)
         return np.asarray(vals, d.dtype)
 
     @property
@@ -179,3 +180,69 @@ def _cast_storage(nd: NDArray, stype: str):
             raise MXNetError("csr requires 2-D")
         return CSRNDArray(nd._data, None, None, nd._ctx)
     raise MXNetError(f"unknown stype {stype}")
+
+
+# ----------------------------------------------------------------------
+# sparse operators (reference ``python/mxnet/ndarray/sparse.py``† op
+# namespace + ``src/operator/tensor/dot.cc``† storage-type table).
+# Compute is dense XLA underneath; the RESULT stype follows the
+# reference's inference table so downstream sparse-aware code (lazy
+# optimizers, kvstore row_sparse_pull) behaves identically.
+# ----------------------------------------------------------------------
+def cast_storage(arr: NDArray, stype: str):
+    """Reference ``cast_storage``†."""
+    if stype == "default":
+        return NDArray(arr._data, arr._ctx, _placed=True)
+    return _cast_storage(arr, stype)
+
+
+def retain(data: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Reference ``_sparse_retain``†: keep only the given rows."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return data.retain(indices if isinstance(indices, NDArray)
+                       else array(indices))
+
+
+def dot(lhs: NDArray, rhs: NDArray, transpose_a: bool = False,
+        transpose_b: bool = False):
+    """Sparse-aware dot (reference storage table: csr·dense → dense;
+    csrᵀ·dense → row_sparse; everything else dense)."""
+    a = lhs._data
+    b = rhs._data
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    out = jnp.matmul(a, b)
+    if isinstance(lhs, CSRNDArray) and transpose_a:
+        # output rows = csr columns touched by stored entries
+        return RowSparseNDArray(out, None, lhs._ctx)
+    return NDArray(out, lhs._ctx, _placed=True)
+
+
+def _wrap_like(out_data, template):
+    if isinstance(template, RowSparseNDArray):
+        return RowSparseNDArray(out_data, None, template._ctx)
+    if isinstance(template, CSRNDArray):
+        return CSRNDArray(out_data, None, None, template._ctx)
+    return NDArray(out_data, template._ctx, _placed=True)
+
+
+def elemwise_add(lhs: NDArray, rhs: NDArray):
+    """stype-preserving add: rsp+rsp → rsp, csr+csr → csr, any dense
+    operand densifies (the reference's fallback rule)."""
+    out = lhs._data + rhs._data
+    if type(lhs) is type(rhs) and isinstance(lhs, BaseSparseNDArray):
+        return _wrap_like(out, lhs)
+    return NDArray(out, lhs._ctx, _placed=True)
+
+
+def add_n(*arrays):
+    out = arrays[0]._data
+    for a in arrays[1:]:
+        out = out + a._data
+    if all(type(a) is type(arrays[0]) and
+           isinstance(a, BaseSparseNDArray) for a in arrays):
+        return _wrap_like(out, arrays[0])
+    return NDArray(out, arrays[0]._ctx, _placed=True)

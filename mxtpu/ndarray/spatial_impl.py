@@ -1,0 +1,214 @@
+"""Spatial transform operators: GridGenerator / BilinearSampler /
+SpatialTransformer / Crop / Correlation / UpSampling companions.
+
+Reference: ``src/operator/bilinear_sampler.cc``†,
+``grid_generator.cc``†, ``spatial_transformer.cc``†, ``crop.cc``†,
+``src/operator/correlation.cc``† (FlowNet layer).
+
+TPU-native notes: sampling is expressed as gather-free bilinear
+interpolation over clipped integer corners (differentiable through
+jax AD); Correlation enumerates the static displacement grid with
+rolled shifts — no dynamic shapes anywhere.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+
+
+# ----------------------------------------------------------------------
+# GridGenerator
+# ----------------------------------------------------------------------
+def _affine_grid(theta, H, W):
+    """theta (N, 6) → normalized sampling grid (N, 2, H, W) in
+    [-1, 1] (x, y) — the reference's affine convention."""
+    xs = jnp.linspace(-1.0, 1.0, W)
+    ys = jnp.linspace(-1.0, 1.0, H)
+    gx, gy = jnp.meshgrid(xs, ys)               # (H, W)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, HW)
+
+    def one(th):
+        m = th.reshape(2, 3)
+        out = m @ base                          # (2, HW)
+        return out.reshape(2, H, W)
+
+    return jax.vmap(one)(theta)
+
+
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        if H <= 0 or W <= 0:
+            raise MXNetError(
+                "GridGenerator(affine) needs target_shape=(H, W)")
+        return _affine_grid(data, H, W)
+    if transform_type == "warp":
+        # data: flow field (N, 2, H, W) in pixels; grid = identity+flow
+        N, _, H, W = data.shape
+        xs = jnp.arange(W, dtype=jnp.float32)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        gx, gy = jnp.meshgrid(xs, ys)
+        px = gx[None] + data[:, 0]
+        py = gy[None] + data[:, 1]
+        # normalize to [-1, 1]
+        nx = 2.0 * px / jnp.maximum(W - 1, 1) - 1.0
+        ny = 2.0 * py / jnp.maximum(H - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1)
+    raise MXNetError(f"GridGenerator transform_type {transform_type!r} "
+                     f"unsupported")
+
+
+register_op("GridGenerator", num_inputs=1,
+            params=[Param("transform_type", str, "affine",
+                          enum=("affine", "warp")),
+                    Param("target_shape", tuple, (0, 0))])(
+    _grid_generator)
+
+
+# ----------------------------------------------------------------------
+# BilinearSampler
+# ----------------------------------------------------------------------
+def _bilinear_sample(data, grid):
+    """data (N, C, H, W); grid (N, 2, Ho, Wo) normalized [-1, 1]
+    (x, y).  Zero padding outside the input (reference
+    ``BilinearSampler``†)."""
+    N, C, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0      # (N, Ho, Wo)
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def corner(xi, yi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+
+        def per_image(img, yc1, xc1, inb1):
+            # img (C, H, W); index maps (Ho, Wo)
+            vals = img[:, yc1, xc1]             # (C, Ho, Wo)
+            return jnp.where(inb1[None], vals, 0.0)
+
+        return jax.vmap(per_image)(data, yc, xc, inb)
+
+    v00 = corner(x0, y0)
+    v01 = corner(x0 + 1, y0)
+    v10 = corner(x0, y0 + 1)
+    v11 = corner(x0 + 1, y0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy) +
+            v10 * (1 - wx) * wy + v11 * wx * wy).astype(data.dtype)
+
+
+register_op("BilinearSampler", num_inputs=2)(_bilinear_sample)
+
+
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear"):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine+bilinear")
+    H, W = int(target_shape[0]), int(target_shape[1])
+    if H <= 0 or W <= 0:
+        H, W = data.shape[2], data.shape[3]
+    grid = _affine_grid(loc, H, W)
+    return _bilinear_sample(data, grid)
+
+
+register_op("SpatialTransformer", num_inputs=2,
+            params=[Param("target_shape", tuple, (0, 0)),
+                    Param("transform_type", str, "affine"),
+                    Param("sampler_type", str, "bilinear")])(
+    _spatial_transformer)
+
+
+# ----------------------------------------------------------------------
+# Crop
+# ----------------------------------------------------------------------
+def _crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+          num_args=1):
+    """Reference ``Crop``†: crop inputs[0] spatially to h_w (or to
+    inputs[1]'s spatial dims when two inputs are given)."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+        if th <= 0 or tw <= 0:
+            raise MXNetError("Crop needs h_w or a second reference "
+                             "input")
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    if oy + th > H or ox + tw > W:
+        raise MXNetError(f"Crop window ({oy}+{th}, {ox}+{tw}) exceeds "
+                         f"input ({H}, {W})")
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+register_op("Crop", num_inputs=-1,
+            params=[Param("offset", tuple, (0, 0)),
+                    Param("h_w", tuple, (0, 0)),
+                    Param("center_crop", bool, False),
+                    Param("num_args", int, 1)])(_crop)
+
+
+# ----------------------------------------------------------------------
+# Correlation (FlowNet)
+# ----------------------------------------------------------------------
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0,
+                 is_multiply=True):
+    """Patch correlation between two feature maps (reference
+    ``Correlation``†).  Output channel d enumerates the
+    (2·max_disp/stride2+1)² displacement grid."""
+    if kernel_size != 1:
+        raise MXNetError("Correlation: only kernel_size=1 is "
+                         "supported (the FlowNet configuration)")
+    if pad_size:
+        pad = ((0, 0), (0, 0), (pad_size, pad_size),
+               (pad_size, pad_size))
+        data1 = jnp.pad(data1, pad)
+        data2 = jnp.pad(data2, pad)
+    N, C, H, W = data1.shape
+    d = int(max_displacement)
+    s2 = int(stride2)
+    offsets = range(-d, d + 1, s2)
+    outs = []
+    for dy in offsets:
+        for dx in offsets:
+            shifted = jnp.roll(data2, (-dy, -dx), axis=(2, 3))
+            # zero out wrapped regions
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+            vy = (ys + dy >= 0) & (ys + dy < H)
+            vx = (xs + dx >= 0) & (xs + dx < W)
+            mask = (vy[:, None] & vx[None, :]).astype(data1.dtype)
+            if is_multiply:
+                corr = jnp.mean(data1 * shifted, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(data1 - shifted), axis=1)
+            outs.append(corr * mask[None])
+    out = jnp.stack(outs, axis=1)  # (N, D², H, W)
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out
+
+
+register_op("Correlation", num_inputs=2,
+            params=[Param("kernel_size", int, 1),
+                    Param("max_displacement", int, 1),
+                    Param("stride1", int, 1),
+                    Param("stride2", int, 1),
+                    Param("pad_size", int, 0),
+                    Param("is_multiply", bool, True)])(_correlation)

@@ -45,6 +45,29 @@ def create(name, **kwargs) -> "Optimizer":
     return cls(**kwargs)
 
 
+def _lazy_rows(grad):
+    """Row indices of a RowSparseNDArray gradient (None for dense).
+    Drives the reference's ``lazy_update`` semantics: untouched rows
+    skip BOTH the gradient step and weight decay."""
+    from ..ndarray.sparse import RowSparseNDArray
+    if isinstance(grad, RowSparseNDArray):
+        return grad.indices
+    return None
+
+
+def _lazy_blend(updated: NDArray, original: NDArray, rows):
+    """Keep ``updated`` only on ``rows`` (lazy row-sparse update);
+    pass-through when rows is None (dense path)."""
+    if rows is None:
+        return updated
+    import jax.numpy as jnp
+    mask = jnp.zeros((original.shape[0],), bool).at[
+        rows.data.astype(jnp.int32)].set(True)
+    mask = mask.reshape((-1,) + (1,) * (original.data.ndim - 1))
+    return NDArray(jnp.where(mask, updated.data, original.data),
+                   None, _placed=True)
+
+
 def _assign(dst: NDArray, src: NDArray) -> None:
     """Rebind dst's buffer to the functionally-updated value."""
     dst._data = src._data if isinstance(src, NDArray) else src
@@ -172,18 +195,20 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        lazy_rows = _lazy_rows(grad) if self.lazy_update else None
         if state is None:
-            _assign(weight, nd.sgd_update(
+            new_w = nd.sgd_update(
                 weight, grad, lr=lr, wd=wd,
                 rescale_grad=self.rescale_grad,
-                clip_gradient=self._clip()))
+                clip_gradient=self._clip())
+            _assign(weight, _lazy_blend(new_w, weight, lazy_rows))
         else:
             w, m = nd.sgd_mom_update(
                 weight, grad, state, lr=lr, momentum=self.momentum,
                 wd=wd, rescale_grad=self.rescale_grad,
                 clip_gradient=self._clip())
-            _assign(weight, w)
-            _assign(state, m)
+            _assign(weight, _lazy_blend(w, weight, lazy_rows))
+            _assign(state, _lazy_blend(m, state, lazy_rows))
 
 
 @register
@@ -225,6 +250,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         dtype = str(weight.data.dtype)
@@ -244,9 +270,10 @@ class Adam(Optimizer):
             weight, grad, mean, var, lr=lr, beta1=self.beta1,
             beta2=self.beta2, epsilon=self.epsilon, wd=wd,
             rescale_grad=self.rescale_grad, clip_gradient=self._clip())
-        _assign(weight, w)
-        _assign(mean, m)
-        _assign(var, v)
+        rows = _lazy_rows(grad) if self.lazy_update else None
+        _assign(weight, _lazy_blend(w, weight, rows))
+        _assign(mean, _lazy_blend(m, mean, rows))
+        _assign(var, _lazy_blend(v, var, rows))
 
 
 @register

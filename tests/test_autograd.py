@@ -247,3 +247,96 @@ def test_scalar_promotion_comparison():
                                   [False, True, True])
     r = (ia * 0.5).asnumpy()
     np.testing.assert_allclose(r, [0.5, 1.0, 1.5])
+
+
+# ----------------------------------------------------------------------
+# higher-order gradients (create_graph=True)
+# ----------------------------------------------------------------------
+def test_grad_create_graph_second_order():
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad(y, x, create_graph=True)   # 3x²
+        z = nd.sum(gx)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_grad_create_graph_third_order_nested():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x                              # x⁴
+        g1 = autograd.grad(y, x, create_graph=True)    # 4x³
+        g2 = autograd.grad(g1, x, create_graph=True)   # 12x²
+        g3 = autograd.grad(g2, x)                      # 24x
+    np.testing.assert_allclose(g3.asnumpy(), [48.0], rtol=1e-6)
+
+
+def test_grad_create_graph_transcendental_and_hvp():
+    x = nd.array(np.array([0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x)
+    np.testing.assert_allclose(g2.asnumpy(), [-np.sin(0.5)],
+                               rtol=1e-5)
+    # hessian-vector product through a 2-layer computation
+    w = nd.array(np.array([1.0, 2.0], np.float32))
+    w.attach_grad()
+    v = nd.array(np.array([1.0, -1.0], np.float32))
+    with autograd.record():
+        loss = nd.sum(w * w * w)      # H = diag(6w)
+        g = autograd.grad(loss, w, create_graph=True)
+        gv = nd.sum(g * v)
+    gv.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               6 * w.asnumpy() * v.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_function_node_raises():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x)
+        from mxtpu.base import MXNetError
+        with pytest.raises(MXNetError, match="create_graph"):
+            autograd.grad(y, x, create_graph=True)
+
+
+def test_grad_create_graph_intermediate_variable():
+    """Higher-order grads w.r.t. a non-leaf variable (the _watch
+    analogue of the first-order path)."""
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.sum(y * x)
+    g = autograd.grad(z, [y], create_graph=True)  # single var → array
+    np.testing.assert_allclose(g.asnumpy(), x.asnumpy(), rtol=1e-6)
+
+
+def test_grad_create_graph_outside_record_block():
+    """create_graph implies recording the backward even when called
+    after the record() block closed (reference semantics)."""
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x * x)
+    g1 = autograd.grad(y, x, create_graph=True)
+    g2 = autograd.grad(g1, x)
+    np.testing.assert_allclose(g2.asnumpy(), 6 * x.asnumpy(),
+                               rtol=1e-6)

@@ -1,0 +1,145 @@
+"""Sparse NDArray: creation round-trips, compressed views, sparse
+ops, lazy row-sparse optimizer updates.
+
+Reference: ``python/mxnet/ndarray/sparse.py``†,
+``tests/python/unittest/test_sparse_ndarray.py``† /
+``test_sparse_operator.py``†.  The TPU port stores densely (documented
+divergence); THESE tests pin the API semantics that must still hold:
+compressed views, stype propagation, and lazy-update numerics.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.base import MXNetError
+from mxtpu.ndarray import sparse
+
+
+def test_row_sparse_creation_and_views():
+    data = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    rsp = sparse.row_sparse_array((data, [1, 3]), shape=(5, 2))
+    assert rsp.stype == "row_sparse"
+    dense = np.zeros((5, 2), np.float32)
+    dense[[1, 3]] = data
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    np.testing.assert_array_equal(rsp.data.asnumpy(), data)
+    # from dense: indices inferred from nonzero rows
+    rsp2 = sparse.row_sparse_array(dense)
+    np.testing.assert_array_equal(rsp2.indices.asnumpy(), [1, 3])
+
+
+def test_csr_creation_and_views():
+    data = np.array([1.0, 2.0, 3.0], np.float32)
+    indices = np.array([1, 0, 2])
+    indptr = np.array([0, 1, 3])
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(2, 3))
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), indptr)
+    np.testing.assert_array_equal(csr.indices.asnumpy(), indices)
+    np.testing.assert_array_equal(csr.data.asnumpy(), data)
+
+
+def test_tostype_round_trip():
+    dense = nd.array(np.array([[0, 0], [5, 6], [0, 0]], np.float32))
+    rsp = dense.tostype("row_sparse") \
+        if hasattr(dense, "tostype") else sparse._cast_storage(
+            dense, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1])
+    back = rsp.tostype("default")
+    assert not isinstance(back, sparse.BaseSparseNDArray)
+    np.testing.assert_array_equal(back.asnumpy(), dense.asnumpy())
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array(
+        (np.ones((3, 2), np.float32), [0, 2, 4]), shape=(5, 2))
+    kept = sparse.retain(rsp, nd.array(np.array([2, 4], np.float32)))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [2, 4])
+    d = kept.asnumpy()
+    assert d[0].sum() == 0 and d[2].sum() == 2 and d[4].sum() == 2
+
+
+def test_sparse_dot_storage_table():
+    rng = np.random.RandomState(0)
+    a = np.zeros((4, 3), np.float32)
+    a[0, 1] = 2.0
+    a[2, 2] = 3.0
+    b = rng.randn(4, 5).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    # csr · dense → dense
+    out = sparse.dot(csr, nd.array(rng.randn(3, 5).astype(np.float32)))
+    assert not isinstance(out, sparse.BaseSparseNDArray)
+    # csrᵀ · dense → row_sparse (reference storage-type table)
+    out_t = sparse.dot(csr, nd.array(b), transpose_a=True)
+    assert isinstance(out_t, sparse.RowSparseNDArray)
+    np.testing.assert_allclose(out_t.asnumpy(), a.T @ b, rtol=1e-5)
+    # only csr columns with stored entries appear as output rows
+    np.testing.assert_array_equal(out_t.indices.asnumpy(), [1, 2])
+
+
+def test_elemwise_add_stype_propagation():
+    r1 = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [0]), shape=(3, 2))
+    r2 = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [2]), shape=(3, 2))
+    out = sparse.elemwise_add(r1, r2)
+    assert isinstance(out, sparse.RowSparseNDArray)
+    np.testing.assert_array_equal(out.indices.asnumpy(), [0, 2])
+    dense = nd.ones((3, 2))
+    out2 = sparse.elemwise_add(r1, dense)
+    assert not isinstance(out2, sparse.BaseSparseNDArray)
+    out3 = sparse.add_n(r1, r2, r1)
+    assert isinstance(out3, sparse.RowSparseNDArray)
+    assert out3.asnumpy()[0, 0] == 2.0
+
+
+def test_lazy_sgd_update_skips_untouched_rows():
+    """lazy_update: rows absent from the sparse grad skip BOTH the
+    step and weight decay (reference sgd lazy semantics)."""
+    from mxtpu import optimizer as opt
+    w = nd.array(np.ones((4, 2), np.float32))
+    g = sparse.row_sparse_array(
+        (np.full((2, 2), 0.5, np.float32), [1, 3]), shape=(4, 2))
+    sgd = opt.SGD(learning_rate=0.1, wd=0.1, lazy_update=True)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)
+    out = w.asnumpy()
+    # untouched rows 0/2: EXACTLY unchanged (no wd either)
+    np.testing.assert_array_equal(out[0], [1.0, 1.0])
+    np.testing.assert_array_equal(out[2], [1.0, 1.0])
+    # touched rows: w - lr*(g + wd*w)
+    np.testing.assert_allclose(out[1], 1.0 - 0.1 * (0.5 + 0.1),
+                               rtol=1e-6)
+    # dense-mode (lazy off): every row decays
+    w2 = nd.array(np.ones((4, 2), np.float32))
+    sgd2 = opt.SGD(learning_rate=0.1, wd=0.1, lazy_update=False)
+    sgd2.update(0, w2, g, sgd2.create_state(0, w2))
+    assert not np.allclose(w2.asnumpy()[0], [1.0, 1.0])
+
+
+def test_lazy_adam_update_state_isolation():
+    from mxtpu import optimizer as opt
+    w = nd.array(np.ones((3, 2), np.float32))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), [1]), shape=(3, 2))
+    adam = opt.Adam(learning_rate=0.1, lazy_update=True)
+    state = adam.create_state(0, w)
+    adam.update(0, w, g, state)
+    mean = state[0].asnumpy()
+    assert mean[0].sum() == 0 and mean[2].sum() == 0  # untouched
+    assert abs(mean[1][0] - 0.1) < 1e-6               # beta1 step
+    assert np.array_equal(w.asnumpy()[0], [1.0, 1.0])
+    assert not np.array_equal(w.asnumpy()[1], [1.0, 1.0])
+
+
+def test_sparse_zeros_and_cast_errors():
+    z = sparse.zeros("row_sparse", (3, 2))
+    assert z.stype == "row_sparse" and z.asnumpy().sum() == 0
+    with pytest.raises(MXNetError):
+        sparse._cast_storage(nd.zeros((2, 2, 2)), "csr")
+    with pytest.raises(MXNetError):
+        sparse.zeros("row_sparse", (3, 2)).tostype("blocked")

@@ -1,0 +1,264 @@
+"""Spatial transform ops + misc op-tranche tests.
+
+Reference: ``src/operator/bilinear_sampler.cc``†,
+``grid_generator.cc``†, ``spatial_transformer.cc``†, ``crop.cc``†,
+``correlation.cc``†, ``regression_output-inl.h``†, ``make_loss.cc``†,
+``optimizer_op.cc``† multi_sgd family.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import nd, autograd
+from mxtpu.base import MXNetError
+
+
+# ----------------------------------------------------------------------
+# spatial
+# ----------------------------------------------------------------------
+def test_grid_generator_identity_affine():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(4, 6))
+    g = grid.asnumpy()
+    assert g.shape == (1, 2, 4, 6)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 6),
+                               atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity_and_shift():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(5, 5))
+    out = nd.BilinearSampler(nd.array(x), grid)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-5, atol=1e-5)
+    # x-shift by one pixel: out[..., j] = x[..., j+1], last col zero pad
+    theta2 = nd.array(np.array([[1, 0, 2.0 / 4, 0, 1, 0]], np.float32))
+    grid2 = nd.GridGenerator(theta2, transform_type="affine",
+                             target_shape=(5, 5))
+    out2 = nd.BilinearSampler(nd.array(x), grid2).asnumpy()
+    np.testing.assert_allclose(out2[..., :4], x[..., 1:], rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(out2[..., 4], 0.0, atol=1e-6)
+
+
+def test_bilinear_sampler_grads_flow():
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(1, 1, 4, 4).astype(np.float32))
+    theta = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32))
+    x.attach_grad()
+    theta.attach_grad()
+    with autograd.record():
+        grid = nd.GridGenerator(theta, transform_type="affine",
+                                target_shape=(4, 4))
+        out = nd.BilinearSampler(x, grid)
+        loss = nd.sum(out * out)
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+def test_spatial_transformer_matches_composed():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(2, 3, 6, 6).astype(np.float32))
+    theta = nd.array(np.array([[0.8, 0.1, 0, -0.1, 0.9, 0.2]] * 2,
+                              np.float32))
+    st = nd.SpatialTransformer(x, theta, target_shape=(6, 6))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(6, 6))
+    ref = nd.BilinearSampler(x, grid)
+    np.testing.assert_allclose(st.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_crop():
+    x = nd.array(np.arange(2 * 1 * 6 * 6, dtype=np.float32)
+                 .reshape(2, 1, 6, 6))
+    out = nd.Crop(x, offset=(1, 2), h_w=(3, 3))
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, :, 1:4, 2:5])
+    ref = nd.zeros((2, 1, 4, 4))
+    out2 = nd.Crop(x, ref, center_crop=True, num_args=2)
+    np.testing.assert_array_equal(out2.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 1:5])
+
+
+def test_correlation_self_is_mean_square():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 4, 5, 5).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x),
+                         max_displacement=1).asnumpy()
+    assert out.shape == (1, 9, 5, 5)
+    # center displacement (dy=dx=0) = mean over channels of x*x
+    np.testing.assert_allclose(out[0, 4], (x[0] ** 2).mean(0),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# legacy output ops — gradient semantics
+# ----------------------------------------------------------------------
+def test_linear_regression_output_grad():
+    d = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    l = nd.array(np.array([[0.0, 1.0], [5.0, 2.0]], np.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, l)
+    out.backward()
+    # reference scale: grad_scale / outputs-per-sample (here 2)
+    np.testing.assert_allclose(d.grad.asnumpy(),
+                               (d.asnumpy() - l.asnumpy()) / 2,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(out.asnumpy(), d.asnumpy())
+    # 1-D data: one output per sample → raw difference
+    d1 = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    l1 = nd.zeros((3,))
+    d1.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d1, l1)
+    out.backward()
+    np.testing.assert_allclose(d1.grad.asnumpy(), [1.0, 2.0, 3.0],
+                               rtol=1e-6)
+
+
+def test_mae_and_logistic_regression_outputs():
+    d = nd.array(np.array([[1.0, -2.0]], np.float32))
+    l = nd.array(np.array([[0.0, 1.0]], np.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(d, l)
+    out.backward()
+    # (1, 2) data → 2 outputs per sample → sign/2
+    np.testing.assert_allclose(d.grad.asnumpy(),
+                               np.sign(d.asnumpy() - l.asnumpy()) / 2,
+                               rtol=1e-6)
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(d, l)
+    sig = 1 / (1 + np.exp(-d.asnumpy()))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    d2 = nd.array(np.array([[1.0, -2.0]], np.float32))
+    d2.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(d2, l)
+    out.backward()
+    np.testing.assert_allclose(d2.grad.asnumpy(),
+                               (sig - l.asnumpy()) / 2, rtol=1e-5)
+
+
+def test_make_loss_gradient_is_scale():
+    d = nd.array(np.ones((2, 3), np.float32) * 5)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(d, grad_scale=2.0)
+    out.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(),
+                               np.full((2, 3), 2.0), rtol=1e-6)
+    d.grad[:] = 0
+    with autograd.record():
+        out = nd.MakeLoss(d, normalization="batch")
+    out.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(),
+                               np.full((2, 3), 0.5), rtol=1e-6)
+    # valid normalization: divide by the count above valid_thresh
+    dv = nd.array(np.array([1.0, 1.0, 0.0, 0.0], np.float32))
+    dv.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(dv, normalization="valid",
+                          valid_thresh=0.5)
+    out.backward()
+    np.testing.assert_allclose(dv.grad.asnumpy(),
+                               np.full(4, 0.5), rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# norm/statistics/misc
+# ----------------------------------------------------------------------
+def test_group_norm():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 6, 4).astype(np.float32)
+    g = np.ones(6, np.float32)
+    b = np.zeros(6, np.float32)
+    out = nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b),
+                       num_groups=2).asnumpy()
+    grp = out.reshape(2, 2, -1)
+    np.testing.assert_allclose(grp.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(grp.std(-1), 1.0, atol=1e-3)
+    with pytest.raises(MXNetError):
+        nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b),
+                     num_groups=4)
+
+
+def test_moments_histogram_eye_linspace():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(0, 1))
+    assert abs(float(mean.asscalar()) - 2.5) < 1e-6
+    assert abs(float(var.asscalar()) - 1.25) < 1e-6
+    counts, edges = nd.histogram(nd.array(np.arange(10, dtype=np.float32)),
+                                 bin_cnt=5, range=(0, 10))
+    np.testing.assert_array_equal(counts.asnumpy(), [2, 2, 2, 2, 2])
+    # the PYTHON creation API keeps its positional signature; the
+    # registry op is internal (_eye/_linspace)
+    np.testing.assert_array_equal(nd.eye(3).asnumpy(), np.eye(3))
+    np.testing.assert_allclose(nd.linspace(0, 1, 5).asnumpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_array_equal(
+        nd._eye(N=3, dtype="float32").asnumpy(), np.eye(3))
+
+
+def test_misc_elementwise():
+    x = np.array([-2.0, 0.0, 2.0], np.float32)
+    np.testing.assert_allclose(
+        nd.hard_sigmoid(nd.array(x)).asnumpy(),
+        np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.mish(nd.array(x)).asnumpy(),
+        x * np.tanh(np.log1p(np.exp(x))), rtol=1e-5)
+    a = nd.array(np.array([1.0, 0.0, 1.0], np.float32))
+    b = nd.array(np.array([1.0, 1.0, 0.0], np.float32))
+    np.testing.assert_array_equal(nd.logical_xor(a, b).asnumpy(),
+                                  [0, 1, 1])
+    np.testing.assert_allclose(
+        nd.digamma(nd.array(np.array([1.0], np.float32))).asnumpy(),
+        [-0.5772157], rtol=1e-4)
+
+
+def test_batch_take_unravel_shuffle_split():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    idx = nd.array(np.array([1, 0, 1], np.float32))
+    np.testing.assert_array_equal(nd.batch_take(a, idx).asnumpy(),
+                                  [1, 2, 5])
+    u = nd.unravel_index(nd.array(np.array([5, 2], np.float32)),
+                         shape=(2, 3))
+    np.testing.assert_array_equal(u.asnumpy(), [[1, 0], [2, 2]])
+    r = nd.ravel_multi_index(nd.array(np.array([[1, 0], [2, 2]],
+                                               np.float32)),
+                             shape=(2, 3))
+    np.testing.assert_array_equal(r.asnumpy(), [5, 2])
+    mx.random.seed(7)
+    s = nd.shuffle(nd.array(np.arange(8, dtype=np.float32)))
+    assert sorted(s.asnumpy().tolist()) == list(range(8))
+    parts = nd.split_v2(nd.array(np.arange(10, dtype=np.float32)),
+                        indices=(3, 7))
+    assert [p.shape[0] for p in parts] == [3, 4, 3]
+
+
+def test_multi_sgd_updates():
+    w1, g1 = np.ones(3, np.float32), np.full(3, 0.5, np.float32)
+    w2, g2 = np.full(2, 2.0, np.float32), np.ones(2, np.float32)
+    o1, o2 = nd.multi_sgd_update(
+        nd.array(w1), nd.array(g1), nd.array(w2), nd.array(g2),
+        lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    np.testing.assert_allclose(o1.asnumpy(), w1 - 0.1 * g1, rtol=1e-6)
+    np.testing.assert_allclose(o2.asnumpy(), w2 - 0.2 * g2, rtol=1e-6)
+    m1 = np.zeros(3, np.float32)
+    nw, nm = nd.multi_sgd_mom_update(
+        nd.array(w1), nd.array(g1), nd.array(m1),
+        lrs=(0.1,), wds=(0.0,), momentum=0.9, num_weights=1)
+    np.testing.assert_allclose(nm.asnumpy(), -0.1 * g1, rtol=1e-6)
+    np.testing.assert_allclose(nw.asnumpy(), w1 - 0.1 * g1, rtol=1e-6)
