@@ -49,7 +49,11 @@ class Context:
 
     @property
     def jax_device(self) -> jax.Device:
-        devs = jax.devices(self._backend)
+        # LOCAL devices: under multi-process (jax.distributed) each
+        # worker's ctx ids index its own addressable devices, exactly
+        # like the reference's per-worker gpu(i); global devices are
+        # non-addressable from other processes
+        devs = jax.local_devices(backend=self._backend)
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"{self} out of range: only {len(devs)} "
@@ -119,9 +123,17 @@ def tpu(device_id: int = 0) -> Context:
 
 
 def device(dev: jax.Device) -> Context:
-    """Wrap a raw jax.Device in a Context."""
+    """Wrap a raw jax.Device in a Context.  Context ids are LOCAL
+    (per-process) indices, so map through jax.local_devices — a global
+    dev.id from another process would not round-trip."""
     kind = "cpu" if dev.platform == "cpu" else "tpu"
-    return Context(kind, dev.id)
+    locals_ = jax.local_devices(backend=dev.platform)
+    try:
+        return Context(kind, locals_.index(dev))
+    except ValueError:
+        # non-addressable (another process's device): keep the global
+        # id for display; using .jax_device on it raises out-of-range
+        return Context(kind, dev.id)
 
 
 def current_context() -> Context:
@@ -136,4 +148,6 @@ def num_gpus() -> int:
 def num_tpus() -> int:
     if jax.default_backend() == "cpu":
         return 0
-    return len(jax.devices())
+    # local count: the reference's per-worker `gpu(i) for i in
+    # range(num_gpus())` idiom must stay in range under multi-process
+    return len(jax.local_devices())

@@ -1,0 +1,67 @@
+"""Worker program for the local multi-process distributed test
+(reference ``tests/nightly/dist_sync_kvstore.py``† run via
+``tools/launch.py --launcher local``).  Each process = one simulated
+host; asserts cross-process kvstore semantics and writes an OK file
+the pytest parent checks."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+
+    from mxtpu import kvstore as kv_mod
+    from mxtpu import nd
+
+    kv = kv_mod.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+    assert n == int(os.environ["JAX_NUM_PROCESSES"]), (rank, n)
+
+    # 1. push/pull reduces across processes: each worker pushes
+    #    (rank+1) * ones → pulled value = sum_{r} (r+1)
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", nd.ones((4,)) * (rank + 1))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(n))
+    np.testing.assert_allclose(out.asnumpy(), expect * np.ones(4),
+                               rtol=1e-6)
+
+    # 2. barrier: all ranks reach it and proceed
+    kv.barrier()
+
+    # 3. server-side optimizer: push grads from every worker; the
+    #    stored weight steps by lr * sum(grads)
+    from mxtpu import optimizer as opt
+    kv2 = kv_mod.create("dist_sync")
+    kv2.init(3, nd.ones((2,)))
+    kv2.set_optimizer(opt.SGD(learning_rate=0.5))
+    kv2.push(3, nd.ones((2,)))
+    got = nd.zeros((2,))
+    kv2.pull(3, out=got)
+    np.testing.assert_allclose(got.asnumpy(),
+                               (1.0 - 0.5 * n) * np.ones(2),
+                               rtol=1e-6)
+    kv2.barrier()
+
+    with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+        f.write(f"rank {rank}/{n} passed\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Distributed tests without a cluster: N local processes through
+``tools/launch.py --launcher local`` (the reference's
+``tests/nightly/dist_sync_kvstore.py``† mechanism, SURVEY §4.5).
+
+Each process is one simulated host; ``jax.distributed`` forms the
+process group over localhost and the kvstore ``dist_sync`` paths are
+asserted cross-process in ``tests/dist_worker.py``.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_dist_sync_kvstore_local_processes(tmp_path, n):
+    env = dict(os.environ)
+    # children must form their own CPU-only jax runtime
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, os.path.join(_ROOT, "tests",
+                                      "dist_worker.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    for rank in range(n):
+        ok = tmp_path / f"ok.{rank}"
+        assert ok.exists(), f"rank {rank} never finished"

@@ -1,0 +1,88 @@
+"""Statistical tests for the RNG ops (reference
+``tests/python/unittest/test_random.py``†: moment and goodness-of-fit
+checks per distribution, per-seed determinism, per-context streams).
+"""
+import numpy as np
+import pytest
+from scipy import stats
+
+import mxtpu as mx
+from mxtpu import nd
+
+N = 20000
+
+
+def _draw(fn, *args, **kwargs):
+    mx.random.seed(42)
+    return fn(*args, shape=(N,), **kwargs).asnumpy()
+
+
+def test_uniform_ks_and_moments():
+    s = _draw(nd.random.uniform, 2.0, 5.0)
+    assert s.min() >= 2.0 and s.max() < 5.0
+    # KS against the exact CDF
+    d, p = stats.kstest((s - 2.0) / 3.0, "uniform")
+    assert p > 1e-3, (d, p)
+    assert abs(s.mean() - 3.5) < 0.05
+
+
+def test_normal_ks_and_moments():
+    s = _draw(nd.random.normal, 1.0, 2.0)
+    d, p = stats.kstest((s - 1.0) / 2.0, "norm")
+    assert p > 1e-3, (d, p)
+    assert abs(s.mean() - 1.0) < 0.06
+    assert abs(s.std() - 2.0) < 0.06
+
+
+def test_gamma_exponential_moments():
+    s = _draw(nd.random.gamma, 3.0, 2.0)   # shape k=3, scale θ=2
+    assert abs(s.mean() - 6.0) < 0.15      # kθ
+    assert abs(s.var() - 12.0) < 1.0       # kθ²
+    e = _draw(nd.random.exponential, 0.5)  # scale λ... reference: scale
+    assert e.min() >= 0
+    assert abs(e.mean() - 0.5) < 0.02
+
+
+def test_poisson_negative_binomial_chisq():
+    lam = 4.0
+    s = _draw(nd.random.poisson, lam)
+    ks = np.arange(0, 12)
+    obs = np.array([(s == k).sum() for k in ks], np.float64)
+    exp = stats.poisson.pmf(ks, lam) * N
+    keep = exp > 5
+    chi, p = stats.chisquare(obs[keep], exp[keep] * obs[keep].sum() /
+                             exp[keep].sum())
+    assert p > 1e-4, (chi, p)
+
+
+def test_randint_uniformity():
+    mx.random.seed(0)
+    s = nd.random.randint(0, 10, shape=(N,)).asnumpy()
+    counts = np.bincount(s.astype(np.int64), minlength=10)
+    chi, p = stats.chisquare(counts)
+    assert p > 1e-4, (counts, p)
+    assert s.min() >= 0 and s.max() <= 9
+
+
+def test_seed_determinism_and_divergence():
+    mx.random.seed(7)
+    a = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    mx.random.seed(8)
+    c = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    assert not np.array_equal(a, c)
+    # successive draws differ (stream advances)
+    mx.random.seed(7)
+    d1 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    d2 = nd.random.normal(0, 1, shape=(64,)).asnumpy()
+    assert not np.array_equal(d1, d2)
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(3)
+    probs = nd.array(np.array([0.2, 0.3, 0.5], np.float32))
+    s = nd.random.multinomial(probs, shape=(N,)).asnumpy().ravel()
+    freq = np.bincount(s.astype(np.int64), minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
