@@ -131,9 +131,12 @@ def device(dev: jax.Device) -> Context:
     try:
         return Context(kind, locals_.index(dev))
     except ValueError:
-        # non-addressable (another process's device): keep the global
-        # id for display; using .jax_device on it raises out-of-range
-        return Context(kind, dev.id)
+        # another process's device: a local Context for it would
+        # silently alias the WRONG local device — refuse loudly
+        raise MXNetError(
+            f"device {dev} belongs to process {dev.process_index}, "
+            f"not this one ({jax.process_index()}); contexts address "
+            f"local devices only")
 
 
 def current_context() -> Context:

@@ -109,7 +109,12 @@ class NDArray:
         if _is_concrete(self._data) and isinstance(self._data, jax.Array):
             from ..context import device
             try:
-                return device(list(self._data.devices())[0])
+                # prefer THIS process's shard device: global arrays
+                # also span remote devices, which have no local Context
+                devs = getattr(self._data.sharding,
+                               "addressable_devices", None) or \
+                    self._data.devices()
+                return device(sorted(devs, key=lambda d: d.id)[0])
             except Exception:
                 pass
         return current_context()

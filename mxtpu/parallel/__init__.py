@@ -54,12 +54,52 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
     return Mesh(dev_array, names)
 
 
+_MESH_MP_CACHE: Dict[int, bool] = {}
+
+
+def _mesh_is_multiprocess(mesh: Mesh) -> bool:
+    # O(devices) scan once per mesh, not per step (real multi-host
+    # meshes have thousands of devices)
+    flag = _MESH_MP_CACHE.get(id(mesh))
+    if flag is None:
+        me = jax.process_index()
+        flag = any(d.process_index != me for d in mesh.devices.flat)
+        _MESH_MP_CACHE[id(mesh)] = flag
+    return flag
+
+
+def _device_put_global(raw, mesh: Mesh, spec) -> jax.Array:
+    """Place a value onto a mesh sharding, including meshes that span
+    processes.  Host values: every process passes the SAME full value
+    (each takes only the rows its devices own), so single- and
+    multi-process code paths stay identical — `jax.device_put` alone
+    would demand cross-host transfers the CPU/gloo transport refuses.
+    Already-global jax.Arrays are passed through (or resharded
+    in-graph) rather than fetched to host."""
+    sh = NamedSharding(mesh, spec)
+    if not _mesh_is_multiprocess(mesh):
+        return jax.device_put(raw, sh)
+    if isinstance(raw, jax.Array):
+        if raw.sharding == sh:
+            return raw
+        if not raw.is_fully_addressable:
+            # global array with a different layout: reshard with an
+            # in-graph identity (XLA inserts the collectives)
+            return jax.jit(lambda a: a, out_shardings=sh)(raw)
+    host = np.asarray(raw)
+    idx_map = sh.addressable_devices_indices_map(host.shape)
+    shards = [jax.device_put(host[idx], d)
+              for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(host.shape, sh,
+                                                    shards)
+
+
 def shard_batch(mesh: Mesh, arr, axis_name: str = "dp", batch_axis: int = 0):
     """Place an array batch-sharded over a mesh axis."""
     raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
     spec = [None] * raw.ndim
     spec[batch_axis] = axis_name
-    out = jax.device_put(raw, NamedSharding(mesh, P(*spec)))
+    out = _device_put_global(raw, mesh, P(*spec))
     return NDArray(out, None, _placed=True) if isinstance(arr, NDArray) \
         else out
 
@@ -67,7 +107,7 @@ def shard_batch(mesh: Mesh, arr, axis_name: str = "dp", batch_axis: int = 0):
 def replicate(mesh: Mesh, arr):
     """Place an array fully replicated over the mesh."""
     raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
-    out = jax.device_put(raw, NamedSharding(mesh, P()))
+    out = _device_put_global(raw, mesh, P())
     return NDArray(out, None, _placed=True) if isinstance(arr, NDArray) \
         else out
 
@@ -202,18 +242,16 @@ class TrainStep:
                     spec = None
                     if self.param_spec_fn is not None:
                         spec = self.param_spec_fn(p)
-                    sh = NamedSharding(self.mesh,
-                                       spec if spec is not None else P())
-                    p._data._data = jax.device_put(p._data._data, sh)
+                    p._data._data = _device_put_global(
+                        p._data._data, self.mesh,
+                        spec if spec is not None else P())
             self._opt_state = tuple(
                 self._opt_init(self._params[i]._data._data)
                 for i in self._train_idx)
             if self.mesh is not None:
-                self._opt_state = jax.device_put(
-                    self._opt_state,
-                    jax.tree_util.tree_map(
-                        lambda _: NamedSharding(self.mesh, P()),
-                        self._opt_state))
+                self._opt_state = jax.tree_util.tree_map(
+                    lambda v: _device_put_global(v, self.mesh, P()),
+                    self._opt_state)
 
     def _build(self, key, x_raw, y_raw):
         params = self._params
@@ -293,19 +331,23 @@ class TrainStep:
 
     # -- the hot call ----------------------------------------------------
     def __call__(self, x, y):
-        x_raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
-        y_raw = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        # under a multi-process mesh, keep non-NDArray inputs as HOST
+        # buffers: _device_put_global shards them directly, avoiding a
+        # wasted H2D→D2H round trip through the default device
+        mp = self.mesh is not None and _mesh_is_multiprocess(self.mesh)
+        wrap = np.asarray if mp else jnp.asarray
+        x_raw = x.data if isinstance(x, NDArray) else wrap(x)
+        y_raw = y.data if isinstance(y, NDArray) else wrap(y)
         self._collect(x if isinstance(x, NDArray)
                       else NDArray(x_raw, None, _placed=True))
         if self.mesh is not None:
             spec = [None] * x_raw.ndim
             spec[self.batch_axis] = self.dp_axis
-            x_raw = jax.device_put(x_raw,
-                                   NamedSharding(self.mesh, P(*spec)))
+            x_raw = _device_put_global(x_raw, self.mesh, P(*spec))
             yspec = [None] * max(y_raw.ndim, 1)
             yspec[self.batch_axis] = self.dp_axis
-            y_raw = jax.device_put(
-                y_raw, NamedSharding(self.mesh, P(*yspec[:y_raw.ndim])))
+            y_raw = _device_put_global(y_raw, self.mesh,
+                                       P(*yspec[:y_raw.ndim]))
         sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
                str(y_raw.dtype))
         key = _rnd._next_key(None)
@@ -362,9 +404,9 @@ class TrainStep:
             raise MXNetError(
                 f"optimizer state structure mismatch: {got} vs {cur}")
         if self.mesh is not None:
-            loaded = jax.device_put(
-                loaded, jax.tree_util.tree_map(
-                    lambda _: NamedSharding(self.mesh, P()), loaded))
+            loaded = jax.tree_util.tree_map(
+                lambda v: _device_put_global(v, self.mesh, P()),
+                loaded)
         self._opt_state = loaded
 
     def _lrs_wds(self):

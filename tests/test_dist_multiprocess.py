@@ -30,15 +30,29 @@ def test_dist_sync_kvstore_local_processes(tmp_path, n):
     # children must form their own CPU-only jax runtime
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    r = subprocess.run(
+    # own process group: on timeout/failure kill the WHOLE tree, not
+    # just launch.py — orphaned workers would hold the coordinator
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
          "-n", str(n), "--launcher", "local",
          "--coordinator", f"127.0.0.1:{_free_port()}",
          sys.executable, os.path.join(_ROOT, "tests",
                                       "dist_worker.py"),
          str(tmp_path)],
-        capture_output=True, text=True, timeout=300)
-    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, 9)
+        out, err = proc.communicate()
+        pytest.fail(f"distributed run hung: {err[-1500:]}")
+    finally:
+        try:
+            os.killpg(proc.pid, 9)
+        except ProcessLookupError:
+            pass
+    assert proc.returncode == 0, (out[-1500:], err[-1500:])
     for rank in range(n):
         ok = tmp_path / f"ok.{rank}"
         assert ok.exists(), f"rank {rank} never finished"

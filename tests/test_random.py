@@ -43,7 +43,7 @@ def test_gamma_exponential_moments():
     assert abs(e.mean() - 0.5) < 0.02
 
 
-def test_poisson_negative_binomial_chisq():
+def test_poisson_chisq():
     lam = 4.0
     s = _draw(nd.random.poisson, lam)
     ks = np.arange(0, 12)
@@ -53,6 +53,19 @@ def test_poisson_negative_binomial_chisq():
     chi, p = stats.chisquare(obs[keep], exp[keep] * obs[keep].sum() /
                              exp[keep].sum())
     assert p > 1e-4, (chi, p)
+
+
+def test_negative_binomial_moments():
+    k, p = 5.0, 0.4
+    s = _draw(nd.random.negative_binomial, k, p)
+    # mean k(1-p)/p, var k(1-p)/p² (reference parameterization:
+    # failures before the k-th success)
+    assert abs(s.mean() - k * (1 - p) / p) < 0.25, s.mean()
+    assert abs(s.var() - k * (1 - p) / p ** 2) < 1.5, s.var()
+    g = _draw(nd.random.generalized_negative_binomial, 4.0, 0.25)
+    # GNB(mu, alpha): mean mu, var mu + alpha·mu²
+    assert abs(g.mean() - 4.0) < 0.2, g.mean()
+    assert abs(g.var() - (4.0 + 0.25 * 16.0)) < 1.0, g.var()
 
 
 def test_randint_uniformity():
