@@ -112,20 +112,48 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
     set_error_from_python();
     return -1;
   }
+  // every alloc checked: a NULL stored via PyList_SET_ITEM would
+  // crash later inside the call machinery instead of returning -1
   PyObject *keys = PyList_New(num_input_nodes);
   PyObject *shapes = PyList_New(num_input_nodes);
-  for (mx_uint i = 0; i < num_input_nodes; ++i) {
-    PyList_SET_ITEM(keys, i, PyUnicode_FromString(input_keys[i]));
+  PyObject *blob = nullptr;
+  bool build_ok = keys != nullptr && shapes != nullptr;
+  for (mx_uint i = 0; build_ok && i < num_input_nodes; ++i) {
+    PyObject *key = PyUnicode_FromString(input_keys[i]);
+    if (key == nullptr) {
+      build_ok = false;
+      break;
+    }
+    PyList_SET_ITEM(keys, i, key);
     mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
     PyObject *shape = PyList_New(hi - lo);
-    for (mx_uint j = lo; j < hi; ++j) {
-      PyList_SET_ITEM(shape, j - lo,
-                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    if (shape == nullptr) {
+      build_ok = false;
+      break;
     }
     PyList_SET_ITEM(shapes, i, shape);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyObject *dim = PyLong_FromUnsignedLong(input_shape_data[j]);
+      if (dim == nullptr) {
+        build_ok = false;
+        break;
+      }
+      PyList_SET_ITEM(shape, j - lo, dim);
+    }
   }
-  PyObject *blob = PyBytes_FromStringAndSize(
-      static_cast<const char *>(param_bytes), param_size);
+  if (build_ok) {
+    blob = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+    build_ok = blob != nullptr;
+  }
+  if (!build_ok) {
+    set_error_from_python();
+    Py_XDECREF(keys);
+    Py_XDECREF(shapes);
+    Py_XDECREF(blob);
+    Py_DECREF(mod);
+    return -1;
+  }
   PyObject *pred = PyObject_CallMethod(
       mod, "_create", "sOiiOO", symbol_json_str, blob, dev_type,
       dev_id, keys, shapes);

@@ -12,12 +12,13 @@ by the 2018-era framework load here directly and vice versa:
 
 NDArray record (dense):
 
-    uint32  magic: 0xF993FAC9 (V2, uint32 shape dims — what the
-            reference era writes) or 0xF993FACA (V3, int64 dims —
-            written by later 1.x; accepted on read)
+    uint32  magic: 0xF993FAC9 (V2 — what the reference era writes) or
+            0xF993FACA (V3, written by later 1.x; accepted on read)
     int32   storage type (0 = dense; sparse records are rejected with
             guidance — the TPU port stores row_sparse/csr densely)
-    uint32  ndim, then ndim dims (uint32 for V2, int64 for V3)
+    uint32  ndim, then ndim dims as little-endian int64 — TShape
+            serializes dim_t (int64) for BOTH V2 and V3; only the
+            pre-V1 legacy layout used uint32 dims
     int32   dev_type, int32 dev_id   (context; ignored on load — the
             array lands on the current device)
     int32   type_flag (mshadow order: 0=f32 1=f64 2=f16 3=u8 4=i32
@@ -28,7 +29,9 @@ Everything is little-endian, matching dmlc on x86/ARM.
 """
 from __future__ import annotations
 
+import math
 import struct
+import warnings
 from typing import Dict, List, Sequence, Tuple, Union
 
 import numpy as np
@@ -55,18 +58,10 @@ def _write_arr(out: List[bytes], a: np.ndarray) -> None:
         raise MXNetError(
             f"dtype {a.dtype} has no reference type_flag; cast to one "
             f"of {sorted(str(np.dtype(t)) for t in _NP_TO_TYPE_FLAG)}")
-    if any(d > 0xFFFFFFFF for d in a.shape):
-        # dims beyond uint32 need the V3 (int64-dims) record, exactly
-        # as later reference builds write them
-        out.append(struct.pack("<I", V3_MAGIC))
-        out.append(struct.pack("<i", 0))  # dense storage
-        out.append(struct.pack("<I", a.ndim))
-        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
-    else:
-        out.append(struct.pack("<I", V2_MAGIC))
-        out.append(struct.pack("<i", 0))  # dense storage
-        out.append(struct.pack("<I", a.ndim))
-        out.append(struct.pack(f"<{a.ndim}I", *a.shape))
+    out.append(struct.pack("<I", V2_MAGIC))
+    out.append(struct.pack("<i", 0))  # dense storage
+    out.append(struct.pack("<I", a.ndim))
+    out.append(struct.pack(f"<{a.ndim}q", *a.shape))
     out.append(struct.pack("<ii", 1, 0))  # cpu(0) context
     out.append(struct.pack("<i", flag))
     out.append(a.astype(a.dtype.newbyteorder("<"), copy=False).tobytes())
@@ -100,7 +95,7 @@ class _Reader:
         return struct.unpack("<Q", self.take(8))[0]
 
 
-def _read_arr(r: _Reader) -> np.ndarray:
+def _read_arr(r: _Reader, v2_dims64: bool = True) -> np.ndarray:
     magic = r.u32()
     if magic not in (V2_MAGIC, V3_MAGIC):
         raise MXNetError(
@@ -115,7 +110,10 @@ def _read_arr(r: _Reader) -> np.ndarray:
     ndim = r.u32()
     if ndim > 32:
         raise MXNetError(f"implausible ndim {ndim}; corrupt stream?")
-    if magic == V2_MAGIC:
+    if magic == V2_MAGIC and not v2_dims64:
+        # pre-2026-07-30 mxtpu builds wrote V2 dims as uint32 (a bug —
+        # the reference's dim_t is int64); this branch re-reads those
+        # self-written files when the int64 whole-stream parse failed
         shape = struct.unpack(f"<{ndim}I", r.take(4 * ndim))
     else:
         shape = struct.unpack(f"<{ndim}q", r.take(8 * ndim))
@@ -128,7 +126,7 @@ def _read_arr(r: _Reader) -> np.ndarray:
     np_dtype = _TYPE_FLAG_TO_NP.get(flag)
     if np_dtype is None:
         raise MXNetError(f"unknown type_flag {flag} in .params")
-    size = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+    size = math.prod(shape)
     dt = np.dtype(np_dtype).newbyteorder("<")
     nbytes = size * dt.itemsize
     if r.pos + nbytes > len(r.data):
@@ -165,9 +163,8 @@ def dumps(payload: Union[Dict[str, np.ndarray],
     return b"".join(out)
 
 
-def loads(data: bytes) -> Tuple[List[np.ndarray], List[str]]:
-    """Parse a reference binary stream → (arrays, names); names is
-    empty for anonymous list saves."""
+def _loads_impl(data: bytes,
+                v2_dims64: bool) -> Tuple[List[np.ndarray], List[str]]:
     r = _Reader(data)
     magic = r.u64()
     if magic != LIST_MAGIC:
@@ -178,7 +175,7 @@ def loads(data: bytes) -> Tuple[List[np.ndarray], List[str]]:
     n = r.u64()
     if n > 10 ** 7:
         raise MXNetError(f"implausible array count {n}; corrupt file?")
-    arrays = [_read_arr(r) for _ in range(n)]
+    arrays = [_read_arr(r, v2_dims64) for _ in range(n)]
     n_names = r.u64()
     if n_names not in (0, n):
         raise MXNetError(
@@ -186,8 +183,40 @@ def loads(data: bytes) -> Tuple[List[np.ndarray], List[str]]:
     names = []
     for _ in range(n_names):
         ln = r.u64()
-        names.append(r.take(ln).decode("utf-8"))
+        try:
+            names.append(r.take(ln).decode("utf-8"))
+        except UnicodeDecodeError as e:
+            raise MXNetError(f"undecodable name in .params: {e}") \
+                from None
+    if r.pos != len(data):
+        raise MXNetError(
+            f"{len(data) - r.pos} trailing bytes after .params "
+            f"payload; corrupt stream?")
     return arrays, names
+
+
+def loads(data: bytes) -> Tuple[List[np.ndarray], List[str]]:
+    """Parse a reference binary stream → (arrays, names); names is
+    empty for anonymous list saves.
+
+    Tries the correct layout first (V2/V3 dims as int64 — the
+    reference's dim_t).  If the WHOLE stream fails to parse that way,
+    retries with uint32 V2 dims, the layout mxtpu builds before
+    2026-07-30 wrote, and warns.  Whole-stream validation (record
+    tails, payload sizes, name section, exact end-of-stream) makes the
+    two layouts unambiguous in practice."""
+    try:
+        return _loads_impl(data, v2_dims64=True)
+    except MXNetError as e:
+        try:
+            out = _loads_impl(data, v2_dims64=False)
+        except MXNetError:
+            raise e from None
+        warnings.warn(
+            "loading a .params stream with uint32 V2 dims (written by "
+            "a pre-fix mxtpu build); re-save it to get the "
+            "reference-compatible int64 layout", stacklevel=2)
+        return out
 
 
 def is_legacy(head: bytes) -> bool:

@@ -16,6 +16,8 @@ module is the mechanism.
 """
 from __future__ import annotations
 
+import weakref
+
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -54,17 +56,24 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
     return Mesh(dev_array, names)
 
 
-_MESH_MP_CACHE: Dict[int, bool] = {}
+# weakref-keyed so entries die with their mesh (an id()-keyed dict
+# could hand a stale flag to a new mesh reusing the address)
+_MESH_MP_CACHE: "weakref.WeakKeyDictionary[Mesh, bool]" = \
+    weakref.WeakKeyDictionary()
 
 
 def _mesh_is_multiprocess(mesh: Mesh) -> bool:
     # O(devices) scan once per mesh, not per step (real multi-host
     # meshes have thousands of devices)
-    flag = _MESH_MP_CACHE.get(id(mesh))
+    try:
+        flag = _MESH_MP_CACHE.get(mesh)
+    except TypeError:  # unhashable/unweakrefable mesh variant
+        me = jax.process_index()
+        return any(d.process_index != me for d in mesh.devices.flat)
     if flag is None:
         me = jax.process_index()
         flag = any(d.process_index != me for d in mesh.devices.flat)
-        _MESH_MP_CACHE[id(mesh)] = flag
+        _MESH_MP_CACHE[mesh] = flag
     return flag
 
 

@@ -25,7 +25,7 @@ def test_golden_bytes_layout():
         struct.pack("<I", 0xF993FAC9),         # V2 magic
         struct.pack("<i", 0),                  # dense stype
         struct.pack("<I", 2),                  # ndim
-        struct.pack("<II", 2, 3),              # dims (uint32)
+        struct.pack("<qq", 2, 3),              # dims (int64 dim_t)
         struct.pack("<ii", 1, 0),              # cpu(0) context
         struct.pack("<i", 0),                  # type_flag f32
         a.tobytes(),                           # raw LE payload
@@ -96,6 +96,30 @@ def test_v3_int64_dims_read():
     ])
     arrays, names = lf.loads(blob)
     assert names == []
+    np.testing.assert_array_equal(arrays[0], a)
+
+
+def test_prefix_uint32_v2_fallback():
+    """Pre-2026-07-30 mxtpu builds wrote V2 dims as uint32 (a bug vs
+    the reference's int64 dim_t); those self-written files must still
+    load, with a warning."""
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    old = b"".join([
+        struct.pack("<QQ", 0x112, 0),
+        struct.pack("<Q", 1),
+        struct.pack("<I", 0xF993FAC9),         # V2 magic
+        struct.pack("<i", 0),
+        struct.pack("<I", 2),
+        struct.pack("<II", 2, 3),              # old uint32 dims
+        struct.pack("<ii", 1, 0),
+        struct.pack("<i", 0),
+        a.tobytes(),
+        struct.pack("<Q", 1),
+        struct.pack("<Q", 1), b"w",
+    ])
+    with pytest.warns(UserWarning, match="uint32 V2 dims"):
+        arrays, names = lf.loads(old)
+    assert names == ["w"]
     np.testing.assert_array_equal(arrays[0], a)
 
 
