@@ -1,14 +1,23 @@
 """Benchmark: compiled training-step throughput on the real chip.
 
-Prints ONE JSON line.  Default workload: hybridized LeNet-MNIST
-(north-star workload 1, BASELINE.md); set MXTPU_BENCH_MODEL=resnet50
-for the ImageNet-shaped north-star config.  The measured unit is the
-full compiled training
-step — forward, backward, fused optimizer (+BN aux writeback) — via
+Prints ONE JSON line whose primary metric is the **ResNet-50 ImageNet
+training throughput** (north-star #1, BASELINE.md); the BERT-Large
+(north-star #2) and LeNet numbers ride along in ``extras`` so every
+round's ``BENCH_r{N}.json`` captures the full picture.  Set
+MXTPU_BENCH_MODEL=lenet|resnet50|bert to run a single workload.
+
+The measured unit is the full compiled training step — forward,
+backward, fused optimizer (+BN aux writeback) — via
 ``mxtpu.parallel.build_train_step``, i.e. the samples/sec a
-Speedometer would report (SURVEY.md §5.5).  ``vs_baseline`` is null:
-the reference mount was empty in every round so far, so no published
-number exists to compare against (BASELINE.md).
+Speedometer would report (SURVEY.md §5.5).
+
+``mfu`` is model-FLOPs utilisation: analytic training FLOPs/sample
+(3x forward for ResNet-50 at 224x224 ~= 3 x 4.1 GFLOP; 6 x N_params
+per token for BERT-Large, N = 334M) divided by the chip's peak bf16
+FLOP/s.  ``vs_baseline`` compares against the PREVIOUS round's
+self-measured number in BASELINE_SELF.json — the reference mount has
+been empty every round (SURVEY.md provenance caveat), so the baseline
+is our own trend line; regression < 1.0 is failure.
 """
 import json
 import os
@@ -17,22 +26,44 @@ import time
 
 import numpy as np
 
+# Peak dense bf16 FLOP/s per chip, by jax device_kind prefix.
+# v5 lite (v5e) 197 TFLOP/s; v5p 459; v4 275; v3 123 (bf16).
+_PEAK_BF16 = (("TPU v5 lite", 197e12), ("TPU v5p", 459e12),
+              ("TPU v5", 459e12), ("TPU v4", 275e12), ("TPU v3", 123e12),
+              ("TPU v2", 45e12))
+
+# Analytic training FLOPs per unit (sample or token)
+_TRAIN_FLOPS = {
+    "resnet50": 3 * 4.1e9,    # 3x forward GEMM/conv FLOPs @224x224
+    "bert": 6 * 334e6,        # 6N per token (fwd 2N + bwd 4N)
+    "lenet": None,            # too small for MFU to mean anything
+}
+
+
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in _PEAK_BF16:
+        if kind.startswith(prefix):
+            return peak
+    return None
+
 
 def _measure(step, x, y, warmup, iters, batch_size, repeats=3):
-    """Best-of-N timing passes.  The axon tunnel to the chip has
-    ~100ms sync round-trips and multi-second wake-from-idle stalls;
-    repeated async passes (one sync each) isolate steady-state device
-    throughput from transport noise."""
-    last = None
-    for _ in range(warmup):
-        last = step(x, y)
-    float(last.asscalar())  # drain warmup incl. compile
+    """Best-of-N timing of BULKED execution: ``iters`` steps run as one
+    compiled ``lax.scan`` program (``TrainStep.run_steps``), the
+    TPU-native analogue of the reference's bulked graph execution.
+    Necessary for honesty here: each dispatch over the axon tunnel
+    costs ~10 ms of host RPC, which at ResNet step times would measure
+    the tunnel, not the chip (microbench: an 8192^3 bf16 matmul shows
+    61 TF/s dispatched per-call vs 130 TF/s scanned)."""
+    last = step.run_steps(x, y, max(warmup, 2), reuse_batch=True)
+    float(last.asnumpy()[-1])  # drain warmup incl. compile
     best = 0.0
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            last = step(x, y)
-        float(last.asscalar())  # sync
+        last = step.run_steps(x, y, iters, reuse_batch=True)
+        float(last.asnumpy()[-1])  # sync
         dt = time.perf_counter() - t0
         best = max(best, batch_size * iters / dt)
     return best
@@ -59,7 +90,7 @@ def bench_lenet(batch_size=512, warmup=5, iters=30):
 def bench_resnet50(batch_size=None, warmup=3, iters=20):
     """ResNet-50 ImageNet-shaped training step (north-star #1).
     Defaults to the standard TPU recipe — bf16 compute over f32 master
-    weights, batch 128 (MXTPU_BENCH_DTYPE= / MXTPU_BENCH_BATCH
+    weights, batch 256 (MXTPU_BENCH_DTYPE= / MXTPU_BENCH_BATCH
     override; set MXTPU_BENCH_DTYPE="" for pure f32)."""
     from mxtpu import nd
     from mxtpu import parallel
@@ -67,7 +98,7 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
     from mxtpu.models import resnet50
 
     batch_size = batch_size or int(
-        os.environ.get("MXTPU_BENCH_BATCH", "128"))
+        os.environ.get("MXTPU_BENCH_BATCH", "256"))
     net = resnet50(classes=1000)
     net.initialize(init="xavier")
     step = parallel.build_train_step(
@@ -111,21 +142,43 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20):
     return value, "bert_large_pretrain_throughput", "tokens/sec"
 
 
+def _mfu(model, value, peak):
+    per_unit = _TRAIN_FLOPS.get(model)
+    if per_unit is None or peak is None:
+        return None
+    return round(per_unit * value / peak, 4)
+
+
 def main():
-    model = os.environ.get("MXTPU_BENCH_MODEL", "lenet")
+    which = os.environ.get("MXTPU_BENCH_MODEL", "all")
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
              "bert": bench_bert}
-    fn = table.get(model)
-    if fn is None:
-        sys.exit(f"unknown MXTPU_BENCH_MODEL={model!r}; "
-                 f"choices: {sorted(table)}")
-    value, metric, unit = fn()
-    print(json.dumps({
-        "metric": metric,
-        "value": round(value, 1),
-        "unit": unit,
-        "vs_baseline": None,
-    }))
+    if which != "all" and which not in table:
+        sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
+                 f"choices: {sorted(table) + ['all']}")
+    peak = _peak_flops()
+    baseline = {}
+    self_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_SELF.json")
+    if os.path.exists(self_path):
+        with open(self_path) as f:
+            baseline = json.load(f).get("metrics", {})
+
+    order = [which] if which != "all" else ["resnet50", "bert", "lenet"]
+    results = {}
+    for model in order:
+        value, metric, unit = table[model]()
+        prev = baseline.get(metric)
+        results[model] = {
+            "metric": metric, "value": round(value, 1), "unit": unit,
+            "mfu": _mfu(model, value, peak),
+            "vs_baseline": (round(value / prev, 3) if prev else None),
+        }
+    primary = results[order[0]]
+    out = dict(primary)
+    if len(results) > 1:
+        out["extras"] = {m: results[m] for m in order[1:]}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,8 +1,12 @@
 """SSD detection training — the reference's ``example/ssd/train.py``†
-recipe on synthetic box data (no dataset download in this
-environment; point --rec at an im2rec RecordIO file for real data).
+recipe: det-packed RecordIO in, ``ImageDetIter`` with box-aware
+augmentation, MultiBox target assignment, VOC07 mAP evaluation out.
 
-  python examples/train_ssd.py --epochs 2 --batch-size 8
+With no dataset in this environment the script writes a synthetic
+det .rec first (colored rectangles on noise); point ``--rec`` at an
+``im2rec``-packed file for real data.
+
+  python examples/train_ssd.py --epochs 3 --batch-size 8
 """
 import argparse
 import logging
@@ -15,44 +19,85 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import mxtpu as mx
 from mxtpu import autograd, gluon, nd
+from mxtpu.image import ImageDetIter, pack_det_label
+from mxtpu.metric import VOC07MApMetric
 from mxtpu.models.ssd import SSDLoss, toy_ssd
 
 
-def synthetic_batches(batch_size, size, steps, seed=0):
+def write_synthetic_det_rec(prefix, n=64, size=64, classes=2, seed=0):
+    """Pack a synthetic detection dataset: class 0 = bright square,
+    class 1 = bright wide rectangle."""
+    from mxtpu import recordio as rio
     rng = np.random.RandomState(seed)
-    for _ in range(steps):
-        x = rng.rand(batch_size, 3, size, size).astype(np.float32) * .1
-        labels = np.zeros((batch_size, 1, 5), np.float32)
-        for i in range(batch_size):
-            w = rng.randint(size // 4, size // 2)
-            x0 = rng.randint(0, size - w)
-            y0 = rng.randint(0, size - w)
-            x[i, :, y0:y0 + w, x0:x0 + w] = 1.0
-            labels[i, 0] = [0, x0 / size, y0 / size,
-                            (x0 + w) / size, (y0 + w) / size]
-        yield nd.array(x), nd.array(labels)
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 40).astype(np.uint8)
+        cls = int(rng.randint(classes))
+        w = int(rng.randint(size // 4, size // 2))
+        h = w if cls == 0 else max(w // 2, 8)
+        x0 = int(rng.randint(0, size - w))
+        y0 = int(rng.randint(0, size - h))
+        img[y0:y0 + h, x0:x0 + w] = (220, 40 + 160 * cls, 60)
+        label = pack_det_label([[cls, x0 / size, y0 / size,
+                                 (x0 + w) / size, (y0 + h) / size]])
+        header = rio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, rio.pack_img(header, img, quality=95))
+    rec.close()
+    return prefix + ".rec", prefix + ".idx"
+
+
+def evaluate(net, it, metric):
+    metric.reset()
+    it.reset()
+    for batch in it:
+        out = net.detect(batch.data[0])
+        metric.update([batch.label[0]], [out])
+    return metric.get()
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--rec", default=None,
+                    help=".rec with det-packed labels (default: "
+                         "synthesize one)")
+    ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--image-size", type=int, default=64)
-    ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--num-classes", type=int, default=2)
+    ap.add_argument("--out", default="ssd_toy.params")
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(0)
-    net = toy_ssd(num_classes=1)
+    if args.rec is None:
+        rec, idx = write_synthetic_det_rec(
+            "/tmp/ssd_synth", n=64, size=args.image_size,
+            classes=args.num_classes)
+    else:
+        rec = args.rec
+        idx = os.path.splitext(rec)[0] + ".idx"
+
+    train_it = ImageDetIter(
+        rec, (3, args.image_size, args.image_size),
+        batch_size=args.batch_size, path_imgidx=idx, shuffle=True,
+        rand_mirror=True, scale=1.0 / 255)
+    val_it = ImageDetIter(
+        rec, (3, args.image_size, args.image_size),
+        batch_size=args.batch_size, path_imgidx=idx,
+        scale=1.0 / 255)
+
+    net = toy_ssd(num_classes=args.num_classes)
     net.initialize(init="xavier")
     loss_fn = SSDLoss()
     trainer = None
+    metric = VOC07MApMetric(iou_thresh=0.5)
     for epoch in range(args.epochs):
+        train_it.reset()
         total, n = 0.0, 0
-        for x, labels in synthetic_batches(
-                args.batch_size, args.image_size, args.steps,
-                seed=epoch):
+        for batch in train_it:
+            x = batch.data[0]
+            labels = batch.label[0]
             if trainer is None:
                 net(x)  # deferred init
                 trainer = gluon.Trainer(net.collect_params(), "adam",
@@ -66,9 +111,11 @@ def main():
             trainer.step(batch_size=x.shape[0])
             total += float(l.asscalar())
             n += 1
-        logging.info("epoch %d: loss %.4f", epoch, total / n)
-    net.save_parameters("ssd_toy.params")
-    logging.info("saved ssd_toy.params (reference dmlc binary)")
+        name, value = evaluate(net, val_it, metric)
+        logging.info("epoch %d: loss %.4f  %s %.4f", epoch, total / n,
+                     name, value)
+    net.save_parameters(args.out)
+    logging.info("saved %s (reference dmlc binary)", args.out)
 
 
 if __name__ == "__main__":

@@ -1,16 +1,26 @@
-"""Post-training quantization calibration (reference
-``python/mxnet/contrib/quantization.py``†, simplified to the min/max
-calibration mode the int8 deployment path needs)."""
+"""Post-training INT8 quantization (reference
+``python/mxnet/contrib/quantization.py``†): calibration (naive min/max
+AND entropy/KL threshold search) plus the ``quantize_model`` graph
+rewrite that replaces Convolution/FullyConnected nodes with the
+``_contrib_quantized_*`` execution tier between ``quantize_v2`` /
+``dequantize`` nodes.
+
+TPU notes: the quantized ops accumulate s8xs8 -> s32 on the MXU via
+``preferred_element_type`` (mxtpu/ndarray/nn_extra.py); the rewrite
+keeps everything static-shape so the quantized graph jits like the
+float one.
+"""
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
 
-__all__ = ["calib_minmax", "quantize_params"]
+__all__ = ["calib_minmax", "quantize_params", "collect_layer_outputs",
+           "optimal_threshold", "calib_entropy", "quantize_model"]
 
 
 def calib_minmax(data_iter, num_batches: int = 10,
@@ -35,15 +45,283 @@ def calib_minmax(data_iter, num_batches: int = 10,
 
 def quantize_params(params: Dict[str, NDArray], out_type: str = "int8"):
     """Quantize a parameter dict → (quantized arrays, ranges)
-    (the weight half of ``quantize_model``†)."""
+    (the weight half of ``quantize_model``†).  Symmetric ranges, to
+    match the int8 execution tier's convention."""
     from .. import nd
     qparams, ranges = {}, {}
     for name, arr in params.items():
         a = arr.asnumpy()
-        lo, hi = float(a.min()), float(a.max())
+        amax = float(np.abs(a).max()) or 1e-6
+        lo, hi = -amax, amax
         q, qlo, qhi = nd.quantize_v2(arr, min_calib_range=lo,
                                      max_calib_range=hi,
                                      out_type=out_type)
         qparams[name] = q
         ranges[name] = (lo, hi)
     return qparams, ranges
+
+
+# ----------------------------------------------------------------------
+# layer-output collection (reference _LayerOutputCollector†)
+# ----------------------------------------------------------------------
+
+def collect_layer_outputs(sym, arg_params, aux_params, data_iter,
+                          tensor_names: List[str],
+                          num_batches: int = 10,
+                          data_name: str = "data",
+                          label_name: str = "softmax_label"):
+    """Run the float symbol over calibration data and collect the named
+    intermediate tensors' values (one np-array list per name)."""
+    from .. import sym as sym_mod
+    from ..executor import Executor
+    internals = sym.get_internals()
+    heads = [internals[n] for n in tensor_names]
+    group = sym_mod.Group(heads)
+    collected: Dict[str, List[np.ndarray]] = {n: [] for n in
+                                              tensor_names}
+    data_iter.reset()
+    exe = None
+    for i, batch in enumerate(data_iter):
+        if i >= num_batches:
+            break
+        x = batch.data[0]
+        if exe is None:
+            args = dict(arg_params)
+            args[data_name] = x
+            if label_name in group.list_arguments() and \
+                    label_name not in args:
+                if not batch.label:
+                    raise MXNetError(
+                        f"symbol needs {label_name} but the iterator "
+                        f"provides no labels")
+                args[label_name] = batch.label[0]
+            exe = Executor(group, args=args, grad_req="null",
+                           aux_states=dict(aux_params or {}))
+        kw = {data_name: x}
+        if label_name in exe.arg_dict and batch.label:
+            kw[label_name] = batch.label[0]
+        outs = exe.forward(is_train=False, **kw)
+        for name, out in zip(tensor_names, outs):
+            collected[name].append(out.asnumpy())
+    return collected
+
+
+# ----------------------------------------------------------------------
+# entropy (KL) threshold search (reference _get_optimal_threshold†)
+# ----------------------------------------------------------------------
+
+def optimal_threshold(arr, num_bins: int = 2001,
+                      num_quantized_bins: int = 255) -> float:
+    """KL-minimizing |x| threshold for int8 quantization — the
+    reference's TensorRT-style entropy calibration."""
+    a = np.abs(np.asarray(arr, np.float64).ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax < 1e-12:
+        return 1e-6
+    hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
+    hist = hist.astype(np.float64)
+    best_div = np.inf
+    best_t = amax
+    stride = max(1, (num_bins - num_quantized_bins) // 64)
+    for i in range(num_quantized_bins, num_bins + 1, stride):
+        p = hist[:i].copy()
+        p[-1] += hist[i:].sum()  # outliers collapse into the clip bin
+        psum = p.sum()
+        if psum == 0:
+            continue
+        # quantize the first i bins to num_quantized_bins levels, then
+        # expand back uniformly over the non-empty source bins: Q
+        q = np.zeros(i)
+        factor = i / num_quantized_bins
+        for j in range(num_quantized_bins):
+            lo = int(np.floor(j * factor))
+            hi = min(int(np.ceil((j + 1) * factor)), i)
+            chunk = hist[lo:hi]
+            nz = int((chunk > 0).sum())
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        pn = p / psum
+        qn = q / qsum
+        mask = pn > 0
+        div = float(np.sum(np.where(
+            mask, pn * np.log(np.maximum(pn, 1e-30) /
+                              np.maximum(qn, 1e-30)), 0)))
+        if div < best_div:
+            best_div = div
+            best_t = float(edges[min(i, len(edges) - 1)])
+    return best_t
+
+
+def calib_entropy(collected: Dict[str, List[np.ndarray]],
+                  num_bins: int = 2001,
+                  num_quantized_bins: int = 255
+                  ) -> Dict[str, Tuple[float, float]]:
+    """Entropy calibration: per-tensor symmetric ranges from the
+    KL-optimal |x| threshold over the collected activations."""
+    out = {}
+    for name, chunks in collected.items():
+        t = optimal_threshold(np.concatenate(
+            [c.ravel() for c in chunks]), num_bins, num_quantized_bins)
+        out[name] = (-t, t)
+    return out
+
+
+# ----------------------------------------------------------------------
+# quantize_model graph rewrite (reference quantize_model†)
+# ----------------------------------------------------------------------
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+
+
+def _producer_name(node, idx):
+    """Internal-tensor name of (node, output_idx) as ``get_internals``
+    exposes it (multi-output nodes get an index suffix)."""
+    if node.op is None:
+        return node.name
+    if getattr(node, "num_outputs", 1) > 1:
+        return f"{node.name}_output{idx}"
+    return f"{node.name}_output"
+
+
+def quantize_model(sym, arg_params: Dict[str, NDArray],
+                   aux_params: Optional[Dict[str, NDArray]] = None,
+                   data_iter=None, calib_mode: str = "entropy",
+                   num_calib_batches: int = 10,
+                   quantized_dtype: str = "int8",
+                   excluded_sym_names: Tuple[str, ...] = (),
+                   data_name: str = "data",
+                   label_name: str = "softmax_label"):
+    """Rewrite Convolution/FullyConnected into the int8 execution tier
+    with calibrated ranges.  Returns (qsym, qarg_params, aux_params).
+
+    calib_mode: 'none' (activation ranges computed per batch at
+    runtime — range-exact, slower), 'naive' (abs-max over calibration
+    data), 'entropy' (KL-optimal thresholds; the reference default for
+    convnets)."""
+    from .. import sym as sym_mod
+    if quantized_dtype != "int8":
+        raise MXNetError("int8 is the supported quantized_dtype "
+                         "(the uint8 tier is not implemented)")
+    aux_params = aux_params or {}
+
+    nodes = list(sym._topo())
+    targets = [n for n in nodes
+               if n.op in _QUANTIZABLE
+               and n.name not in excluded_sym_names
+               # grouped-conv int8 tier not implemented: keep float
+               and int(n.attrs.get("num_group", 1) or 1) == 1]
+    if not targets:
+        return sym, dict(arg_params), dict(aux_params)
+    need_ranges: List[str] = []
+    for n in targets:
+        src, idx = n.inputs[0]
+        tname = _producer_name(src, idx)
+        if src.op is not None and tname not in need_ranges:
+            need_ranges.append(tname)
+
+    ranges: Dict[str, Tuple[float, float]] = {}
+    if calib_mode in ("naive", "entropy"):
+        if data_iter is None:
+            raise MXNetError(f"calib_mode={calib_mode!r} needs "
+                             f"calibration data")
+        input_ranges = calib_minmax(data_iter, num_calib_batches)
+        if need_ranges:
+            collected = collect_layer_outputs(
+                sym, arg_params, aux_params, data_iter, need_ranges,
+                num_calib_batches, data_name, label_name)
+            if calib_mode == "entropy":
+                ranges.update(calib_entropy(collected))
+            else:
+                for name, chunks in collected.items():
+                    amax = max(float(np.abs(c).max()) for c in chunks)
+                    ranges[name] = (-amax, amax)
+        for name, (lo, hi) in input_ranges.items():
+            amax = max(abs(lo), abs(hi))
+            ranges[name] = (-amax, amax)
+    elif calib_mode != "none":
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+
+    # quantize target weights offline (symmetric)
+    qarg_params = dict(arg_params)
+    wranges: Dict[str, Tuple[float, float]] = {}
+    for n in targets:
+        if len(n.inputs) < 2:
+            continue
+        wsrc, _ = n.inputs[1]
+        if wsrc.op is not None or wsrc.name not in arg_params:
+            continue
+        qp, rr = quantize_params({wsrc.name: arg_params[wsrc.name]})
+        qarg_params[wsrc.name + "_quantize"] = qp[wsrc.name]
+        wranges[wsrc.name] = rr[wsrc.name]
+
+    target_names = {n.name for n in targets
+                    if len(n.inputs) >= 2
+                    and n.inputs[1][0].name in wranges}
+    memo: Dict[int, sym_mod.Symbol] = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.op is None:
+            out = sym_mod.Variable(node.name)
+            memo[id(node)] = out
+            return out
+        ins = [rebuild(src)[idx] if src.num_outputs > 1
+               else rebuild(src) for src, idx in node.inputs]
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        if node.name in target_names:
+            out = _emit_quantized(node, ins, attrs)
+        else:
+            out = getattr(sym_mod, node.op)(
+                *ins, name=node.name, **attrs)
+        memo[id(node)] = out
+        return out
+
+    def _emit_quantized(node, ins, attrs):
+        src, idx = node.inputs[0]
+        tname = _producer_name(src, idx)
+        kw = {}
+        if tname in ranges:
+            lo, hi = ranges[tname]
+            kw = {"min_calib_range": lo, "max_calib_range": hi}
+        qd = sym_mod.quantize_v2(ins[0], out_type="int8",
+                                 name=node.name + "_quantize", **kw)
+        qdata, dmin, dmax = qd[0], qd[1], qd[2]
+        wsrc, _ = node.inputs[1]
+        wlo, whi = wranges[wsrc.name]
+        qw = sym_mod.Variable(wsrc.name + "_quantize")
+        wmin = sym_mod._full(shape=(1,), value=wlo,
+                             name=node.name + "_wmin")
+        wmax = sym_mod._full(shape=(1,), value=whi,
+                             name=node.name + "_wmax")
+        no_bias = str(attrs.get("no_bias", False)).lower() in \
+            ("true", "1")
+        op_name = "_contrib_quantized_conv" \
+            if node.op == "Convolution" \
+            else "_contrib_quantized_fully_connected"
+        qattrs = dict(attrs)
+        qattrs["no_bias"] = True  # bias re-added in float (exact)
+        q = getattr(sym_mod, op_name)(
+            qdata, qw, dmin, dmax, wmin, wmax,
+            name=node.name + "_quantized", **qattrs)
+        deq = sym_mod.dequantize(q[0], q[1], q[2],
+                                 name=node.name + "_dequantize")
+        if not no_bias and len(node.inputs) > 2:
+            bias = rebuild(node.inputs[2][0])
+            shape = (1, -1) + ((1, 1) if node.op == "Convolution"
+                               else ())
+            deq = sym_mod.broadcast_add(
+                deq, sym_mod.reshape(bias, shape=shape),
+                name=node.name + "_bias_add")
+        return deq
+
+    heads = []
+    for node, idx in sym._heads:
+        s = rebuild(node)
+        heads.append(s[idx] if node.num_outputs > 1 else s)
+    qsym = sym_mod.Group(heads) if len(heads) > 1 else heads[0]
+    return qsym, qarg_params, dict(aux_params)

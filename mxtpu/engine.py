@@ -4,9 +4,13 @@
 The dependency engine itself is subsumed by XLA/PjRt async dispatch
 (SURVEY §2.1-N5); what survives is the *debugging surface*:
 
-- ``set_bulk_size`` — the reference's bulked-execution knob; here jax
-  already batches dispatch, so the value is recorded and returned (kept
-  for API compatibility; harmless).
+- ``set_bulk_size`` — the reference's bulked-execution knob
+  (``MXNET_EXEC_BULK_EXEC_TRAIN``†).  The TPU-native bulk path is
+  ``TrainStep.run_steps`` (``mxtpu/parallel``): N optimizer steps
+  scanned inside ONE compiled program, amortizing host dispatch the
+  way the reference's engine bulked op segments.  The value set here
+  is the default ``steps`` consumers of ``bulk_size()`` use (eager
+  per-op dispatch itself is already async-batched by jax).
 - NaiveEngine mode — ``MXNET_ENGINE_TYPE=NaiveEngine`` (or
   ``set_sync_mode(True)``) makes every eager op synchronous: each
   dispatch blocks until the result is materialized, turning async
@@ -18,7 +22,8 @@ from __future__ import annotations
 import os
 from contextlib import contextmanager
 
-__all__ = ["set_bulk_size", "bulk", "set_sync_mode", "sync_enabled"]
+__all__ = ["set_bulk_size", "bulk_size", "bulk", "set_sync_mode",
+           "sync_enabled"]
 
 _BULK_SIZE = 15
 _SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine" or \
@@ -27,10 +32,16 @@ _SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine" or \
 
 def set_bulk_size(size: int) -> int:
     """Set (and return the previous) bulk execution size
-    (reference ``set_bulk_size``†)."""
+    (reference ``set_bulk_size``†).  Consumed as the default ``steps``
+    for ``TrainStep.run_steps`` by bulk-aware training loops."""
     global _BULK_SIZE
     prev, _BULK_SIZE = _BULK_SIZE, int(size)
     return prev
+
+
+def bulk_size() -> int:
+    """Current bulk size (steps per compiled multi-step program)."""
+    return _BULK_SIZE
 
 
 @contextmanager

@@ -4,16 +4,20 @@ Reference: ``python/mxnet/executor.py``† over ``GraphExecutor``
 (``src/executor/graph_executor.cc``†).
 
 TPU-native: binding keeps the reference surface (named arg arrays →
-``forward``/``backward``/``outputs``) but execution is interpretation of
-the symbol through the eager op namespace, with the autograd tape
-providing the backward pass (the reference ran an explicit NNVM grad
-graph; here jax vjps recorded per op play that role).  Memory planning,
-fusion, and scheduling belong to XLA under jit — the reference's
-``PlanMemory``/``AttachOpExecs`` passes have no analogue by design.
+``forward``/``backward``/``outputs``) and execution is COMPILED — the
+whole symbol interpretation runs under a shape-keyed ``jax.jit`` (the
+role of the reference's ``GraphExecutor``: its entire point was the
+fast bound path), with ``jax.vjp`` of the same pure interpretation as
+the backward graph.  Memory planning, fusion, and scheduling belong to
+XLA under jit — the reference's ``PlanMemory``/``AttachOpExecs``
+passes have no analogue by design.  Setting a monitor callback (which
+needs per-node host values) or ``MXTPU_EXECUTOR_JIT=0`` falls back to
+eager per-op interpretation.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -24,6 +28,8 @@ from .ndarray.ndarray import NDArray
 from .symbol import Symbol, _eval_symbol, _is_aux_name
 
 __all__ = ["Executor"]
+
+_JIT_DEFAULT = os.environ.get("MXTPU_EXECUTOR_JIT", "1") == "1"
 
 
 class Executor:
@@ -62,6 +68,9 @@ class Executor:
 
         self._outputs: Optional[List[NDArray]] = None
         self._monitor_callback = None
+        self._jit = _JIT_DEFAULT
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._last_call = None  # inputs of the last jitted forward
 
     @staticmethod
     def _name_arrays(arrays, names, what, allow_missing=False):
@@ -103,6 +112,86 @@ class Executor:
     def set_monitor_callback(self, callback, monitor_all=False) -> None:
         self._monitor_callback = callback
 
+    # -- compiled path --------------------------------------------------
+    def _pure_eval_fn(self, arg_names, aux_names, training):
+        """A pure (jit-traceable) interpretation of the bound symbol:
+        (train_vals, other_vals, aux_vals, key_data) -> tuple of raw
+        outputs.  RNG ops draw from the traced key stream (the
+        hybridize CachedOp mechanism)."""
+        import jax
+
+        from .ndarray import random as _rnd
+        sym = self._symbol
+        rec_names, other_names = arg_names
+
+        def fn(train_vals, other_vals, aux_vals, key_data):
+            bindings = {}
+            for n, v in zip(rec_names, train_vals):
+                bindings[n] = NDArray(v, None, _placed=True)
+            for n, v in zip(other_names, other_vals):
+                bindings[n] = NDArray(v, None, _placed=True)
+            for n, v in zip(aux_names, aux_vals):
+                bindings[n] = NDArray(v, None, _placed=True)
+            provider = _rnd._TraceKeyProvider(
+                jax.random.wrap_key_data(key_data))
+            _rnd._push_trace_provider(provider)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            try:
+                outs = _eval_symbol(sym, bindings)
+            finally:
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+                _rnd._pop_trace_provider()
+            return tuple(o.data for o in outs)
+
+        return fn
+
+    def _jit_entry(self, is_train, rec_names):
+        import jax
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        other_names = [n for n in arg_names if n not in set(rec_names)]
+        sig = (is_train, tuple(rec_names),
+               tuple((n, self.arg_dict[n].shape,
+                      str(self.arg_dict[n].dtype)) for n in arg_names),
+               tuple((n, self.aux_dict[n].shape) for n in aux_names))
+        entry = self._jit_cache.get(sig)
+        if entry is None:
+            raw = self._pure_eval_fn((tuple(rec_names),
+                                      tuple(other_names)),
+                                     tuple(aux_names), is_train)
+            fwd = jax.jit(raw)
+
+            def fwd_bwd(train_vals, other_vals, aux_vals, key_data,
+                        cotangents):
+                primals, vjp_fn = jax.vjp(
+                    lambda tv: raw(tv, other_vals, aux_vals, key_data),
+                    train_vals)
+                grads = vjp_fn(tuple(cotangents))[0]
+                return primals, grads
+
+            def fwd_bwd_ones(train_vals, other_vals, aux_vals,
+                             key_data):
+                # the default-cotangent (ones) step in ONE program:
+                # forward(is_train=True)+backward() costs exactly one
+                # fwd + one bwd, like the reference executor
+                import jax.numpy as jnp
+                primals, vjp_fn = jax.vjp(
+                    lambda tv: raw(tv, other_vals, aux_vals, key_data),
+                    train_vals)
+                grads = vjp_fn(tuple(jnp.ones_like(p)
+                                     for p in primals))[0]
+                return primals, grads
+
+            entry = {"fwd": fwd, "fwd_bwd": jax.jit(fwd_bwd),
+                     "fwd_bwd_ones": jax.jit(fwd_bwd_ones),
+                     "rec_names": tuple(rec_names),
+                     "other_names": tuple(other_names),
+                     "aux_names": tuple(aux_names)}
+            self._jit_cache[sig] = entry
+        return entry
+
     def forward(self, is_train: bool = False, **kwargs):
         for name, val in kwargs.items():
             val = val if isinstance(val, NDArray) else nd_mod.array(val)
@@ -113,18 +202,63 @@ class Executor:
             else:
                 raise MXNetError(f"unknown argument {name!r}")
 
+        rec_names = [n for n in self.arg_dict
+                     if self._grad_req.get(n, "null") != "null"] \
+            if is_train else []
+        if self._jit and self._monitor_callback is None:
+            try:
+                return self._forward_jit(is_train, rec_names)
+            except MXNetError:
+                raise
+            except Exception as e:  # unjittable op/graph
+                import warnings
+                warnings.warn(
+                    f"Executor jit path failed "
+                    f"({type(e).__name__}: {str(e)[:200]}); falling "
+                    f"back to eager interpretation for this executor",
+                    stacklevel=2)
+                self._jit = False
+        return self._forward_eager(is_train, rec_names)
+
+    def _forward_jit(self, is_train, rec_names):
+        import jax
+
+        from .ndarray import random as _rnd
+        entry = self._jit_entry(is_train, rec_names)
+        train_vals = tuple(self.arg_dict[n].data
+                           for n in entry["rec_names"])
+        other_vals = tuple(self.arg_dict[n].data
+                           for n in entry["other_names"])
+        aux_vals = tuple(self.aux_dict[n].data
+                         for n in entry["aux_names"])
+        key_data = jax.random.key_data(_rnd._next_key(None))
+        if is_train and entry["rec_names"]:
+            # one program computes outputs AND default-cotangent grads
+            # (the common Module loop calls backward(None))
+            raw_outs, grads = entry["fwd_bwd_ones"](
+                train_vals, other_vals, aux_vals, key_data)
+            self._pending_grads = grads
+        else:
+            raw_outs = entry["fwd"](train_vals, other_vals, aux_vals,
+                                    key_data)
+            self._pending_grads = None
+        self._last_call = (entry, train_vals, other_vals, aux_vals,
+                           key_data)
+        self._recorded = list(entry["rec_names"])
+        self._outputs = [NDArray(r, None, _placed=True)
+                         for r in raw_outs]
+        return self._outputs
+
+    def _forward_eager(self, is_train, rec_names):
         bindings: Dict[str, NDArray] = {}
         bindings.update(self.aux_dict)
         bindings.update(self.arg_dict)
-
+        self._last_call = None
         if is_train:
-            grads = []
-            for name, arr in self.arg_dict.items():
-                req = self._grad_req.get(name, "null")
-                if req != "null":
-                    arr.attach_grad(grad_req=req)
-                    grads.append(name)
-            self._recorded = grads
+            for name in rec_names:
+                self.arg_dict[name].attach_grad(
+                    grad_req=self._grad_req.get(name, "write"))
+            self._recorded = rec_names
             with autograd.record():
                 outs = _eval_symbol(self._symbol, bindings)
         else:
@@ -139,22 +273,45 @@ class Executor:
     def backward(self, out_grads=None) -> None:
         if self._outputs is None:
             raise MXNetError("forward(is_train=True) before backward()")
-        heads = self._outputs
         if out_grads is not None:
             out_grads = _as_list(out_grads)
+        if self._last_call is not None:
+            entry, train_vals, other_vals, aux_vals, key_data = \
+                self._last_call
+            if out_grads is None and self._pending_grads is not None:
+                grads = self._pending_grads  # computed with forward
+            else:
+                if out_grads is None:
+                    import jax.numpy as jnp
+                    cots = tuple(jnp.ones_like(o.data)
+                                 for o in self._outputs)
+                else:
+                    cots = tuple(
+                        (g.data if isinstance(g, NDArray)
+                         else nd_mod.array(g).data).astype(o.data.dtype)
+                        for g, o in zip(out_grads, self._outputs))
+                _, grads = entry["fwd_bwd"](train_vals, other_vals,
+                                            aux_vals, key_data, cots)
+            for name, g in zip(entry["rec_names"], grads):
+                self._store_grad(name, NDArray(g, None, _placed=True))
+            return
+        heads = self._outputs
         autograd.backward(heads, out_grads)
         for name in self._recorded:
             arr = self.arg_dict[name]
             if arr.grad is None:
                 continue
-            req = self._grad_req.get(name, "write")
-            dst = self.grad_dict.get(name)
-            if dst is None:
-                self.grad_dict[name] = arr.grad
-            elif req == "add":
-                dst._data = dst._data + arr.grad._data
-            else:
-                dst._data = arr.grad._data
+            self._store_grad(name, arr.grad)
+
+    def _store_grad(self, name, grad: NDArray) -> None:
+        req = self._grad_req.get(name, "write")
+        dst = self.grad_dict.get(name)
+        if dst is None:
+            self.grad_dict[name] = grad
+        elif req == "add":
+            dst._data = dst._data + grad._data
+        else:
+            dst._data = grad._data
 
     def copy_params_from(self, arg_params: Dict[str, NDArray],
                          aux_params: Optional[Dict[str, NDArray]] = None,

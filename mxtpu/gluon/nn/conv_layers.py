@@ -53,11 +53,19 @@ class _Conv(HybridBlock):
         self._act = activation
         self._output_padding = (_to_tuple(output_padding, n)
                                 if output_padding is not None else None)
+        in_g = in_channels // groups if in_channels else 0
+        channels_last = not layout.startswith("NC")
         if self._op == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0
-                      ) + self._kernel
+            # OI<spatial> for NC* layouts, O<spatial>I for channels-last
+            # (reference kernel-layout convention)
+            wshape = ((channels,) + self._kernel + (in_g,)
+                      if channels_last
+                      else (channels, in_g) + self._kernel)
         else:  # Deconvolution: weight is (in, out//groups, *kernel)
             wshape = (in_channels, channels // groups) + self._kernel
+            if channels_last and in_channels:
+                wshape = (in_channels,) + self._kernel \
+                    + (channels // groups,)
         self.weight = self.params.get(
             "weight", shape=wshape, init=weight_initializer,
             allow_deferred_init=True)
@@ -69,15 +77,21 @@ class _Conv(HybridBlock):
             self.bias = None
 
     def _infer_params(self, x, *args):
-        c_axis = 1 if self._layout.startswith("NC") else -1
+        channels_last = not self._layout.startswith("NC")
+        c_axis = -1 if channels_last else 1
         in_c = int(x.shape[c_axis])
         w = self.weight
         if w.shape and 0 in w.shape:
             if self._op == "Convolution":
-                w.shape = (self._channels, in_c // self._groups) \
+                w.shape = ((self._channels,) + self._kernel
+                           + (in_c // self._groups,)) if channels_last \
+                    else (self._channels, in_c // self._groups) \
                     + self._kernel
             else:
-                w.shape = (in_c, self._channels // self._groups) \
+                w.shape = ((in_c,) + self._kernel
+                           + (self._channels // self._groups,)) \
+                    if channels_last \
+                    else (in_c, self._channels // self._groups) \
                     + self._kernel
             self._in_channels = in_c
 

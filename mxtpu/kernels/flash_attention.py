@@ -391,13 +391,34 @@ def _fa_bwd(causal, sm_scale, res, do):
 _flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
 
 
+_warned_fallback = set()
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None):
     """Fused attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D).
     Pallas on TPU, lax reference elsewhere or for awkward shapes."""
+    import warnings
+
     from . import pallas_enabled
     D = q.shape[-1]
     scale = float(sm_scale) if sm_scale is not None else 1.0 / (D ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
-    if not pallas_enabled() or D > 512 or Tq % 8 or Tk % 8:
+    if not pallas_enabled():
+        # CPU / interpret-off: the reference path IS the intended path
+        return attention_reference(q, k, v, causal, scale)
+    if D > 512 or Tq % 8 or Tk % 8:
+        # warn once per shape class: the O(T^2)-memory fallback
+        # silently losing the flash memory guarantee at e.g. T=4097
+        # is exactly the failure mode a user needs to hear about
+        why = (f"head_dim {D} > 512" if D > 512
+               else "seq lens not multiples of 8")
+        sig = (why, D)
+        if sig not in _warned_fallback:
+            _warned_fallback.add(sig)
+            warnings.warn(
+                f"flash_attention falling back to the O(T^2) reference "
+                f"path ({why}, e.g. Tq={Tq}, Tk={Tk}); pad sequence "
+                f"lengths to a multiple of 8 to keep the fused "
+                f"kernel's memory bound", stacklevel=2)
         return attention_reference(q, k, v, causal, scale)
     return _flash_attention_pallas(q, k, v, bool(causal), scale)

@@ -21,6 +21,9 @@ from . import ops_impl  # noqa: F401  (populates the registry)
 from . import rnn_impl  # noqa: F401  (fused RNN op)
 from . import detection_impl  # noqa: F401  (SSD/ROI/CTC/quantize ops)
 from . import spatial_impl  # noqa: F401  (grid/sampler/crop/corr ops)
+from . import ops_extra  # noqa: F401  (init/amp/linalg/optimizer tail)
+from . import nn_extra  # noqa: F401  (deformable/psroi/quantized tier)
+from . import random_ops  # noqa: F401  (_random_*/_sample_* ops)
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concat, stack, save, load, waitall, from_numpy,
                       linspace, eye, zeros_like as _zeros_like_fn)

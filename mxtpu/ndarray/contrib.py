@@ -20,6 +20,7 @@ import numpy as np
 from jax import lax
 
 from ..base import MXNetError
+from ..ops.registry import Param, register_op
 from .ndarray import NDArray
 
 
@@ -91,10 +92,8 @@ def cond(pred: Callable, then_func: Callable, else_func: Callable):
 # ----------------------------------------------------------------------
 # detection ops — padded static-shape NMS family
 # ----------------------------------------------------------------------
-def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+def _box_iou_raw(a, b, format="corner"):  # noqa: A002
     """Pairwise IoU (reference ``contrib.box_iou``†)."""
-    a = _unwrap(lhs)
-    b = _unwrap(rhs)
     if format == "center":
         a = jnp.concatenate([a[..., :2] - a[..., 2:] / 2,
                              a[..., :2] + a[..., 2:] / 2], -1)
@@ -109,7 +108,18 @@ def box_iou(lhs, rhs, format="corner"):  # noqa: A002
     area_b = jnp.maximum((b[..., 2] - b[..., 0]) *
                          (b[..., 3] - b[..., 1]), 0.0)
     union = area_a[..., :, None] + area_b[..., None, :] - inter
-    return NDArray(inter / jnp.maximum(union, 1e-12), None, _placed=True)
+    return inter / jnp.maximum(union, 1e-12)
+
+
+register_op("_contrib_box_iou", num_inputs=2,
+            params=[Param("format", str, "corner",
+                          enum=("corner", "center"))])(_box_iou_raw)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (reference ``contrib.box_iou``†)."""
+    return NDArray(_box_iou_raw(_unwrap(lhs), _unwrap(rhs),
+                                format=format), None, _placed=True)
 
 
 def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk,
@@ -146,12 +156,12 @@ def _nms_single(scores, boxes, iou_thresh, valid_thresh, topk,
     return keep[inv], order
 
 
-def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
-            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
-            in_format="corner", out_format="corner"):
+def _box_nms_raw(d, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+                 coord_start=2, score_index=1, id_index=-1,
+                 force_suppress=False, in_format="corner",
+                 out_format="corner"):
     """``contrib.box_nms``† with the padded contract: suppressed entries
     are set to -1 instead of removed (static output shape)."""
-    d = _unwrap(data)
     batched = d.ndim == 3
     if not batched:
         d = d[None]
@@ -171,59 +181,175 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
     out = jax.vmap(one)(d)
     if not batched:
         out = out[0]
-    return NDArray(out, None, _placed=True)
+    return out
+
+
+register_op("_contrib_box_nms",
+            params=[Param("overlap_thresh", float, 0.5),
+                    Param("valid_thresh", float, 0.0),
+                    Param("topk", int, -1),
+                    Param("coord_start", int, 2),
+                    Param("score_index", int, 1),
+                    Param("id_index", int, -1),
+                    Param("force_suppress", bool, False),
+                    Param("in_format", str, "corner"),
+                    Param("out_format", str, "corner")],
+            aliases=("box_nms",), differentiable=False)(_box_nms_raw)
+
+
+def box_nms(data, **kwargs):
+    return NDArray(_box_nms_raw(_unwrap(data), **kwargs), None,
+                   _placed=True)
+
+
+def _boolean_mask_raw(d, m, axis=0):
+    m = m.astype(bool)
+    idx = jnp.argsort(~m)  # true rows first, stable
+    compacted = jnp.take(d, idx, axis=axis)
+    mask_sorted = jnp.sort(~m) == False  # noqa: E712
+    shape = [1] * d.ndim
+    shape[axis] = d.shape[axis]
+    return compacted * mask_sorted.reshape(shape).astype(d.dtype)
+
+
+register_op("_contrib_boolean_mask", num_inputs=2,
+            params=[Param("axis", int, 0)])(_boolean_mask_raw)
 
 
 def boolean_mask(data, index, axis=0):
     """``contrib.boolean_mask``† — dynamic output in the reference; here
     the padded contract: masked-out rows are zeroed and compacted to the
     front, output keeps the input's static length."""
-    d = _unwrap(data)
-    m = _unwrap(index).astype(bool)
-    idx = jnp.argsort(~m)  # true rows first, stable
-    compacted = jnp.take(d, idx, axis=axis)
-    mask_sorted = jnp.sort(~m) == False  # noqa: E712
-    shape = [1] * d.ndim
-    shape[axis] = d.shape[axis]
-    return NDArray(
-        compacted * mask_sorted.reshape(shape).astype(d.dtype),
-        None, _placed=True)
+    return NDArray(_boolean_mask_raw(_unwrap(data), _unwrap(index),
+                                     axis=axis), None, _placed=True)
+
+
+def _getnnz_raw(d, axis=None):
+    return jnp.asarray(
+        jnp.sum(d != 0) if axis is None else jnp.sum(d != 0, axis=axis)
+    ).astype(jnp.int64)
+
+
+register_op("_contrib_getnnz", params=[Param("axis", int, None)],
+            differentiable=False)(_getnnz_raw)
 
 
 def getnnz(data, axis=None):
-    d = _unwrap(data)
-    return NDArray(jnp.asarray(
-        jnp.sum(d != 0) if axis is None else jnp.sum(d != 0, axis=axis)
-    ).astype(jnp.int64), None, _placed=True)
+    return NDArray(_getnnz_raw(_unwrap(data), axis=axis), None,
+                   _placed=True)
+
+
+def _count_sketch_raw(d, hh, ss, out_dim=0):
+    """``contrib.count_sketch``† — compact bilinear pooling primitive.
+    Input order (data, h, s) matches the reference op signature."""
+    hh = hh.astype(jnp.int32)
+    out = jnp.zeros(d.shape[:-1] + (int(out_dim),), d.dtype)
+    return out.at[..., hh].add(d * ss)
+
+
+register_op("_contrib_count_sketch", num_inputs=3,
+            params=[Param("out_dim", int, 0)],
+            aliases=("_contrib_CountSketch",))(_count_sketch_raw)
 
 
 def count_sketch(data, h, s, out_dim):
-    """``contrib.count_sketch``† — compact bilinear pooling primitive."""
-    d = _unwrap(data)
-    hh = _unwrap(h).astype(jnp.int32)
-    ss = _unwrap(s)
-    out = jnp.zeros(d.shape[:-1] + (out_dim,), d.dtype)
-    out = out.at[..., hh].add(d * ss)
-    return NDArray(out, None, _placed=True)
+    return NDArray(_count_sketch_raw(_unwrap(data), _unwrap(h),
+                                     _unwrap(s), out_dim=out_dim),
+                   None, _placed=True)
+
+
+def _fft_raw(d, compute_size=128):
+    f = jnp.fft.fft(d, axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(
+        d.shape[:-1] + (2 * d.shape[-1],)).astype(d.dtype)
+
+
+register_op("_contrib_fft",
+            params=[Param("compute_size", int, 128)])(_fft_raw)
 
 
 def fft(data, compute_size=128):
-    d = _unwrap(data)
-    f = jnp.fft.fft(d, axis=-1)
-    out = jnp.stack([f.real, f.imag], axis=-1).reshape(
-        d.shape[:-1] + (2 * d.shape[-1],))
-    return NDArray(out.astype(d.dtype), None, _placed=True)
+    return NDArray(_fft_raw(_unwrap(data), compute_size=compute_size),
+                   None, _placed=True)
 
 
-def ifft(data, compute_size=128):
-    d = _unwrap(data)
+def _ifft_raw(d, compute_size=128):
     c = d.reshape(d.shape[:-1] + (d.shape[-1] // 2, 2))
     comp = c[..., 0] + 1j * c[..., 1]
     out = jnp.fft.ifft(comp, axis=-1).real * comp.shape[-1]
-    return NDArray(out.astype(d.dtype), None, _placed=True)
+    return out.astype(d.dtype)
+
+
+register_op("_contrib_ifft",
+            params=[Param("compute_size", int, 128)])(_ifft_raw)
+
+
+def ifft(data, compute_size=128):
+    return NDArray(_ifft_raw(_unwrap(data), compute_size=compute_size),
+                   None, _placed=True)
+
+
+def _quadratic_raw(d, a=0.0, b=0.0, c=0.0):
+    """The reference's tutorial op (``src/operator/contrib/quadratic_op``†)."""
+    return a * d * d + b * d + c
+
+
+register_op("_contrib_quadratic",
+            params=[Param("a", float, 0.0), Param("b", float, 0.0),
+                    Param("c", float, 0.0)])(_quadratic_raw)
 
 
 def quadratic(data, a=0.0, b=0.0, c=0.0):
-    """The reference's tutorial op (``src/operator/contrib/quadratic_op``†)."""
-    d = _unwrap(data)
-    return NDArray(a * d * d + b * d + c, None, _placed=True)
+    return NDArray(_quadratic_raw(_unwrap(data), a=a, b=b, c=c), None,
+                   _placed=True)
+
+
+def _bipartite_matching_raw(data, is_ascend=False, threshold=0.0,
+                            topk=-1):
+    """``contrib.bipartite_matching``†: greedy bipartite matching over a
+    (R, C) score matrix.  Returns (row_match, col_match) with -1 for
+    unmatched; static shapes via a fori_loop of min(R, C) greedy picks.
+    """
+    batched = data.ndim == 3
+    d = data if batched else data[None]
+
+    def one(s):
+        R, C = s.shape
+        worst = jnp.inf if is_ascend else -jnp.inf
+
+        def body(_, state):
+            s_cur, rm, cm = state
+            flat = jnp.argmin(s_cur) if is_ascend else jnp.argmax(s_cur)
+            r, c = flat // C, flat % C
+            v = s_cur[r, c]
+            ok = (v < threshold) if is_ascend else (v > threshold)
+            rm = jnp.where(ok, rm.at[r].set(c.astype(rm.dtype)), rm)
+            cm = jnp.where(ok, cm.at[c].set(r.astype(cm.dtype)), cm)
+            s_cur = jnp.where(ok, s_cur.at[r, :].set(worst)
+                              .at[:, c].set(worst), s_cur)
+            return s_cur, rm, cm
+
+        n = min(R, C) if topk < 0 else min(topk, R, C)
+        init = (s.astype(jnp.float32),
+                -jnp.ones((R,), jnp.float32),
+                -jnp.ones((C,), jnp.float32))
+        _, rm, cm = lax.fori_loop(0, n, body, init)
+        return rm, cm
+
+    rm, cm = jax.vmap(one)(d)
+    if not batched:
+        rm, cm = rm[0], cm[0]
+    return rm, cm
+
+
+register_op("_contrib_bipartite_matching", num_outputs=2,
+            params=[Param("is_ascend", bool, False),
+                    Param("threshold", float, 0.0),
+                    Param("topk", int, -1)],
+            differentiable=False)(_bipartite_matching_raw)
+
+
+def bipartite_matching(data, **kwargs):
+    rm, cm = _bipartite_matching_raw(_unwrap(data), **kwargs)
+    return (NDArray(rm, None, _placed=True),
+            NDArray(cm, None, _placed=True))

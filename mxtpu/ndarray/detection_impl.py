@@ -415,6 +415,9 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
     hi = max_range.reshape(())
     if data.dtype == jnp.uint8:
         qmin, qmax = 0.0, 255.0
+    elif data.dtype == jnp.int32:
+        # int32 accumulators from the quantized conv/fc tier
+        qmin, qmax = -2147483647.0, 2147483647.0
     else:
         qmin, qmax = -127.0, 127.0
     scale = jnp.maximum(hi - lo, 1e-12) / (qmax - qmin)

@@ -1,0 +1,621 @@
+"""Operator-breadth tail: init / elemwise / AMP / slice-assign /
+storage / linalg / optimizer ops closing the gap against the
+reference's inventory (``src/operator/``†, OPS_MANIFEST.md).
+
+Everything here is a pure XLA lowering rule like ``ops_impl.py`` —
+the file split is only to keep modules reviewable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+from .ops_impl import _rescale_clip
+
+# ---------------------------------------------------------------------------
+# init ops (tensor/init_op.cc†) — nullary, shape from params
+# ---------------------------------------------------------------------------
+
+
+def _np_dtype(dtype, default="float32"):
+    return jnp.dtype(dtype or default)
+
+
+register_op("_zeros", num_inputs=0, differentiable=False,
+            params=[Param("shape", tuple, ()),
+                    Param("dtype", str, None)])(
+    lambda shape=(), dtype=None: jnp.zeros(shape, _np_dtype(dtype)))
+
+register_op("_ones", num_inputs=0, differentiable=False,
+            params=[Param("shape", tuple, ()),
+                    Param("dtype", str, None)])(
+    lambda shape=(), dtype=None: jnp.ones(shape, _np_dtype(dtype)))
+
+register_op("_full", num_inputs=0, differentiable=False,
+            params=[Param("shape", tuple, ()),
+                    Param("value", float, 0.0),
+                    Param("dtype", str, None)])(
+    lambda shape=(), value=0.0, dtype=None: jnp.full(
+        shape, value, _np_dtype(dtype)))
+
+# uninitialised memory has no XLA analogue; zeros is the defined choice
+register_op("_empty", num_inputs=0, differentiable=False,
+            params=[Param("shape", tuple, ()),
+                    Param("dtype", str, None)])(
+    lambda shape=(), dtype=None: jnp.zeros(shape, _np_dtype(dtype)))
+
+
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            dtype=None):
+    a = jnp.arange(start, stop, step, _np_dtype(dtype))
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return a
+
+
+register_op("_arange", num_inputs=0, differentiable=False,
+            params=[Param("start", float, 0.0),
+                    Param("stop", float, None),
+                    Param("step", float, 1.0),
+                    Param("repeat", int, 1),
+                    Param("infer_range", bool, False),
+                    Param("dtype", str, None)])(_arange)
+
+# ---------------------------------------------------------------------------
+# elemwise logical tail (elemwise_binary_op_logic.cc†)
+# ---------------------------------------------------------------------------
+
+register_op("_logical_and", num_inputs=2, differentiable=False)(
+    lambda a, b: jnp.logical_and(a != 0, b != 0).astype(a.dtype))
+register_op("_logical_or", num_inputs=2, differentiable=False)(
+    lambda a, b: jnp.logical_or(a != 0, b != 0).astype(a.dtype))
+register_op("_logical_and_scalar", differentiable=False,
+            params=[Param("scalar", float, 0.0)])(
+    lambda a, scalar=0.0: jnp.logical_and(a != 0, scalar != 0)
+    .astype(a.dtype))
+register_op("_logical_or_scalar", differentiable=False,
+            params=[Param("scalar", float, 0.0)])(
+    lambda a, scalar=0.0: jnp.logical_or(a != 0, scalar != 0)
+    .astype(a.dtype))
+register_op("_logical_xor_scalar", differentiable=False,
+            params=[Param("scalar", float, 0.0)])(
+    lambda a, scalar=0.0: jnp.logical_xor(a != 0, scalar != 0)
+    .astype(a.dtype))
+
+# ---------------------------------------------------------------------------
+# AMP ops (tensor/amp_cast.cc†) — used by automatic mixed precision
+# ---------------------------------------------------------------------------
+
+register_op("amp_cast", params=[Param("dtype", str, "float16")])(
+    lambda x, dtype="float16": x.astype(jnp.dtype(dtype)))
+
+
+def _amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
+    """Cast the FLOAT inputs to their widest (or narrowest) common
+    float type; non-float inputs pass through untouched (reference
+    amp_multicast semantics — ints never vote or get cast)."""
+    if not arrays:
+        raise MXNetError("amp_multicast needs at least one input")
+    widths = [(jnp.finfo(a.dtype).bits, i)
+              for i, a in enumerate(arrays)
+              if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not widths:
+        return tuple(arrays)
+    pick = min(widths)[1] if cast_narrow else max(widths)[1]
+    target = arrays[pick].dtype
+    return tuple(a.astype(target)
+                 if jnp.issubdtype(a.dtype, jnp.floating) else a
+                 for a in arrays)
+
+
+register_op("amp_multicast", num_inputs=-1,
+            params=[Param("num_outputs", int, 0),
+                    Param("cast_narrow", bool, False)],
+            num_outputs_fn=lambda attrs: int(attrs.get("num_outputs"))
+            )(_amp_multicast)
+
+
+def _all_finite(data, init_output=True):
+    return jnp.isfinite(data.astype(jnp.float32)).all().reshape(
+        (1,)).astype(jnp.float32)
+
+
+register_op("all_finite", differentiable=False,
+            params=[Param("init_output", bool, True)])(_all_finite)
+
+
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(
+            a.astype(jnp.float32)).all())
+    return ok.reshape((1,)).astype(jnp.float32)
+
+
+register_op("multi_all_finite", num_inputs=-1, differentiable=False,
+            params=[Param("num_arrays", int, 1),
+                    Param("init_output", bool, True)])(_multi_all_finite)
+
+# ---------------------------------------------------------------------------
+# slice-assign family (tensor/matrix_op.cc† _slice_assign /
+# _slice_assign_scalar / _crop_assign aliases) — functional: returns the
+# updated copy (NDArray __setitem__ rebinds, matching engine semantics)
+# ---------------------------------------------------------------------------
+
+
+def _slices(shape, begin, end, step):
+    step = step or ()
+    out = []
+    for i in range(len(shape)):
+        b = begin[i] if i < len(begin) and begin[i] is not None else 0
+        e = end[i] if i < len(end) and end[i] is not None else shape[i]
+        s = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+        out.append(slice(b, e, s))
+    return tuple(out)
+
+
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    return lhs.at[_slices(lhs.shape, begin, end, step)].set(rhs)
+
+
+register_op("_slice_assign", num_inputs=2,
+            params=[Param("begin", tuple, ()),
+                    Param("end", tuple, ()),
+                    Param("step", tuple, ())],
+            aliases=("_crop_assign",))(_slice_assign)
+
+
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    return data.at[_slices(data.shape, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+register_op("_slice_assign_scalar",
+            params=[Param("scalar", float, 0.0),
+                    Param("begin", tuple, ()),
+                    Param("end", tuple, ()),
+                    Param("step", tuple, ())],
+            aliases=("_crop_assign_scalar",))(_slice_assign_scalar)
+
+
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+register_op("_scatter_set_nd", num_inputs=3,
+            params=[Param("shape", tuple, ())])(_scatter_set_nd)
+
+# ---------------------------------------------------------------------------
+# reduce/pick tail
+# ---------------------------------------------------------------------------
+
+register_op("argmax_channel", differentiable=False)(
+    lambda x: jnp.argmax(x, axis=1).astype(x.dtype))
+
+
+def _fill_element_0index(lhs, mhs, rhs):
+    """``fill_element_0index``†: out[i, rhs[i]] = mhs[i] (the
+    3-operand companion of choose_element_0index/pick)."""
+    idx = rhs.astype(jnp.int32)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.astype(lhs.dtype))
+
+
+register_op("fill_element_0index", num_inputs=3)(_fill_element_0index)
+
+# ---------------------------------------------------------------------------
+# storage ops — dense-backed (SURVEY §7 hard-part 3: the TPU build keeps
+# sparse the API, dense the storage; COVERAGE.md documents divergence)
+# ---------------------------------------------------------------------------
+
+register_op("cast_storage", params=[Param("stype", str, "default")],
+            doc="dense-backed: storage casts are identity at the "
+                "buffer level; mxtpu.ndarray.sparse tracks the "
+                "compressed-view semantics")(
+    lambda x, stype="default": x)
+
+
+def _sparse_retain(data, indices):
+    """Keep only the listed rows of a row_sparse array (zero the rest;
+    dense-backed semantics of ``sparse_retain``†)."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, 0)
+
+
+register_op("sparse_retain", num_inputs=2)(_sparse_retain)
+
+# ---------------------------------------------------------------------------
+# linalg tail (tensor/la_op.cc†)
+# ---------------------------------------------------------------------------
+
+
+def _potri(a):
+    """inv(A) from its Cholesky factor L (A = L L^T) — linalg_potri†."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = lax.linalg.triangular_solve(a, eye, lower=True,
+                                       left_side=True)
+    return jnp.swapaxes(linv, -1, -2) @ linv
+
+
+register_op("linalg_potri")(_potri)
+
+
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (b @ tri if rightside else tri @ b)
+
+
+register_op("linalg_trmm", num_inputs=2,
+            params=[Param("transpose", bool, False),
+                    Param("rightside", bool, False),
+                    Param("lower", bool, True),
+                    Param("alpha", float, 1.0)])(_trmm)
+
+
+def _gelqf(a):
+    """LQ factorization A = L Q with Q row-orthonormal (linalg_gelqf†),
+    via QR of A^T: A^T = Q' R  =>  A = R^T Q'^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+register_op("linalg_gelqf", num_outputs=2)(_gelqf)
+
+
+def _syevd(a):
+    w, v = jnp.linalg.eigh(a)
+    # reference returns (U, lambda) with rows of U the eigenvectors
+    return jnp.swapaxes(v, -1, -2), w
+
+
+register_op("linalg_syevd", num_outputs=2)(_syevd)
+
+
+def _slogdet(a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+register_op("linalg_slogdet", num_outputs=2)(_slogdet)
+
+register_op("linalg_makediag", params=[Param("offset", int, 0)])(
+    lambda a, offset=0: jnp.vectorize(
+        lambda v: jnp.diag(v, k=offset),
+        signature="(n)->(m,m)")(a))
+
+
+def _extracttrian(a, offset=0, lower=True):
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+register_op("linalg_extracttrian",
+            params=[Param("offset", int, 0),
+                    Param("lower", bool, True)])(_extracttrian)
+
+
+def _maketrian(a, offset=0, lower=True):
+    # infer n from the packed length k = n(n+1)/2 (+/- offset rows)
+    k = a.shape[-1]
+    n = int((math.isqrt(8 * k + 1) - 1) // 2) + abs(int(offset))
+    rows, cols = (np.tril_indices(n, k=offset) if lower
+                  else np.triu_indices(n, k=offset))
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+register_op("linalg_maketrian",
+            params=[Param("offset", int, 0),
+                    Param("lower", bool, True)])(_maketrian)
+
+# ---------------------------------------------------------------------------
+# optimizer tail (optimizer_op.cc†): NAG, multi-precision (fp16 weights
+# with fp32 master copies), adagrad, adadelta
+# ---------------------------------------------------------------------------
+
+
+def _nag_mom(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+             rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+register_op("nag_mom_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float),
+                    Param("momentum", float, 0.0),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_nag_mom)
+
+
+def _mp_sgd(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+            clip_gradient=-1.0):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+register_op("mp_sgd_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float), Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_mp_sgd)
+
+
+def _mp_sgd_mom(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight32)
+    mom_new = momentum * mom - lr * g
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+register_op("mp_sgd_mom_update", num_inputs=4, num_outputs=3,
+            params=[Param("lr", float),
+                    Param("momentum", float, 0.0),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_mp_sgd_mom)
+
+
+def _mp_nag_mom(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight32)
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+register_op("mp_nag_mom_update", num_inputs=4, num_outputs=3,
+            params=[Param("lr", float),
+                    Param("momentum", float, 0.0),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_mp_nag_mom)
+
+
+def _multi_mp_sgd(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                  clip_gradient=-1.0, num_weights=0):
+    n = len(arrays) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = arrays[i * 3], arrays[i * 3 + 1], arrays[i * 3 + 2]
+        w16, w32n = _mp_sgd(w, g, w32, lr=lrs[i], wd=wds[i],
+                            rescale_grad=rescale_grad,
+                            clip_gradient=clip_gradient)
+        outs.append(w16)
+        outs.append(w32n)
+    return tuple(outs)
+
+
+register_op("multi_mp_sgd_update", num_inputs=-1,
+            params=[Param("lrs", tuple, ()), Param("wds", tuple, ()),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("num_weights", int, 0)],
+            num_outputs_fn=lambda attrs: 2 * int(attrs["num_weights"]),
+            differentiable=False)(_multi_mp_sgd)
+
+
+def _multi_mp_sgd_mom(*arrays, lrs=(), wds=(), momentum=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      num_weights=0):
+    n = len(arrays) // 4
+    outs = []
+    for i in range(n):
+        w, g, mom, w32 = arrays[i * 4:(i + 1) * 4]
+        w16, mom_new, w32n = _mp_sgd_mom(
+            w, g, mom, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs += [w16, mom_new, w32n]
+    return tuple(outs)
+
+
+register_op("multi_mp_sgd_mom_update", num_inputs=-1,
+            params=[Param("lrs", tuple, ()), Param("wds", tuple, ()),
+                    Param("momentum", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("num_weights", int, 0)],
+            num_outputs_fn=lambda attrs: 3 * int(attrs["num_weights"]),
+            differentiable=False)(_multi_mp_sgd_mom)
+
+
+def _adagrad(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+             rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    hist_new = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(hist_new) + epsilon), hist_new
+
+
+register_op("adagrad_update", num_inputs=3, num_outputs=2,
+            params=[Param("lr", float),
+                    Param("epsilon", float, 1e-7),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False, aliases=("_sparse_adagrad_update",))(
+    _adagrad)
+
+
+def _adadelta(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+              wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None, wd,
+                      weight)
+    acc_g_new = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / \
+        jnp.sqrt(acc_g_new + epsilon) * g
+    acc_delta_new = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, acc_g_new, acc_delta_new
+
+
+register_op("adadelta_update", num_inputs=4, num_outputs=3,
+            params=[Param("rho", float, 0.9),
+                    Param("epsilon", float, 1e-5),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0)],
+            differentiable=False)(_adadelta)
+
+
+# ---------------------------------------------------------------------------
+# legacy-surface tail: SoftmaxActivation (deprecated op kept for old
+# symbols), *_v1 aliases, IdentityAttachKLSparseReg
+# ---------------------------------------------------------------------------
+
+
+def _softmax_activation(data, mode="instance"):
+    """Deprecated ``SoftmaxActivation``†: instance mode = softmax over
+    the flattened non-batch dims; channel mode = softmax over axis 1
+    per spatial position."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+register_op("SoftmaxActivation",
+            params=[Param("mode", str, "instance",
+                          enum=("instance", "channel"))],
+            aliases=("softmax_activation",))(_softmax_activation)
+
+
+@jax.custom_vjp
+def _id_kl_sparse(data, penalty_grad):
+    return data
+
+
+def _id_kl_fwd(data, penalty_grad):
+    return data, penalty_grad
+
+
+def _id_kl_bwd(penalty_grad, g):
+    return g + penalty_grad, jnp.zeros_like(penalty_grad)
+
+
+_id_kl_sparse.defvjp(_id_kl_fwd, _id_kl_bwd)
+
+
+def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9):
+    """``IdentityAttachKLSparseReg``†: forward identity; backward adds
+    the gradient of the KL sparsity penalty between the target rate and
+    the mean activation (sigmoid-activation convention).  Functional
+    form: the penalty gradient is computed from the CURRENT batch mean
+    (the reference's moving average needs mutable aux state)."""
+    rho_hat = jnp.clip(jnp.mean(data, axis=0), 1e-6, 1.0 - 1e-6)
+    rho = sparseness_target
+    dkl = penalty * (-rho / rho_hat + (1.0 - rho) / (1.0 - rho_hat))
+    pg = jnp.broadcast_to(dkl / data.shape[0], data.shape)
+    return _id_kl_sparse(data, pg.astype(data.dtype))
+
+
+register_op("IdentityAttachKLSparseReg",
+            params=[Param("sparseness_target", float, 0.1),
+                    Param("penalty", float, 0.001),
+                    Param("momentum", float, 0.9)])(_identity_attach_kl)
+
+
+# ---------------------------------------------------------------------------
+# image ops (src/operator/image/image_random.cc† — the mx.nd.image.*
+# namespace backing gluon vision transforms)
+# ---------------------------------------------------------------------------
+
+
+def _image_to_tensor(x):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (image.to_tensor†);
+    batched NHWC -> NCHW."""
+    xf = x.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(xf, (2, 0, 1))
+    return jnp.transpose(xf, (0, 3, 1, 2))
+
+
+register_op("_image_to_tensor", aliases=("image_to_tensor",))(
+    _image_to_tensor)
+
+
+def _image_normalize(x, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW/NCHW floats
+    (image.normalize†)."""
+    m = jnp.asarray(mean, x.dtype).reshape(-1, 1, 1)
+    s = jnp.asarray(std, x.dtype).reshape(-1, 1, 1)
+    return (x - m) / s
+
+
+register_op("_image_normalize", aliases=("image_normalize",),
+            params=[Param("mean", tuple, (0.0,)),
+                    Param("std", tuple, (1.0,))])(_image_normalize)
+
+
+def _image_flip_lr(x):
+    """Flip the width axis of HWC (or NHWC) images
+    (image.flip_left_right†)."""
+    return x[..., :, ::-1, :]
+
+
+register_op("_image_flip_left_right",
+            aliases=("image_flip_left_right",))(_image_flip_lr)
+
+
+def _image_flip_tb(x):
+    """Flip the height axis (image.flip_top_bottom†)."""
+    return x[..., ::-1, :, :]
+
+
+register_op("_image_flip_top_bottom",
+            aliases=("image_flip_top_bottom",))(_image_flip_tb)
+
+
+def _image_random_flip_lr(x, key):
+    flip = jax.random.bernoulli(_img_key(key))
+    return jnp.where(flip, x[..., :, ::-1, :], x)
+
+
+def _img_key(key):
+    from .ops_impl import _as_prng_key
+    return _as_prng_key(key)
+
+
+register_op("_image_random_flip_left_right", num_inputs=2,
+            aliases=("image_random_flip_left_right",))(
+    _image_random_flip_lr)
+
+
+def _image_random_flip_tb(x, key):
+    flip = jax.random.bernoulli(_img_key(key))
+    return jnp.where(flip, x[..., ::-1, :, :], x)
+
+
+register_op("_image_random_flip_top_bottom", num_inputs=2,
+            aliases=("image_random_flip_top_bottom",))(
+    _image_random_flip_tb)

@@ -103,7 +103,8 @@ _BINARY = {
     "broadcast_add": (jnp.add, True, ("elemwise_add", "_plus")),
     "broadcast_sub": (jnp.subtract, True, ("elemwise_sub", "_minus")),
     "broadcast_mul": (jnp.multiply, True, ("elemwise_mul", "_mul")),
-    "broadcast_div": (jnp.divide, True, ("elemwise_div", "_div")),
+    "broadcast_div": (jnp.divide, True, ("elemwise_div", "_div",
+                                         "_scatter_elemwise_div")),
     "broadcast_mod": (jnp.mod, True, ("_mod",)),
     "broadcast_power": (jnp.power, True, ("_power", "pow")),
     "broadcast_maximum": (jnp.maximum, True, ("maximum", "_maximum")),
@@ -133,8 +134,10 @@ for _name, (_fn, _diff, _aliases) in _BINARY.items():
 # serialize scalar arithmetic the way the reference does
 # ======================================================================
 _SCALAR_OPS = {
-    "_plus_scalar": (lambda x, s: x + s, True, ("_PlusScalar",)),
-    "_minus_scalar": (lambda x, s: x - s, True, ("_MinusScalar",)),
+    "_plus_scalar": (lambda x, s: x + s, True,
+                     ("_PlusScalar", "_scatter_plus_scalar")),
+    "_minus_scalar": (lambda x, s: x - s, True,
+                      ("_MinusScalar", "_scatter_minus_scalar")),
     "_rminus_scalar": (lambda x, s: s - x, True, ("_RMinusScalar",)),
     "_mul_scalar": (lambda x, s: x * s, True, ("_MulScalar",)),
     "_div_scalar": (lambda x, s: x / s, True, ("_DivScalar",)),
@@ -662,12 +665,16 @@ def _fully_connected(x, w, b, no_bias, flatten):
 
 
 _CONV_DN = {  # layout string -> (lhs, rhs, out) dimension numbers
+    # weight follows the reference's convention: kernel dims take the
+    # data layout's spatial order, so channels-last layouts store
+    # weights O<spatial>I (e.g. NHWC -> OHWI), matching
+    # src/operator/nn/convolution.cc† kernel layouts
     "NCHW": ("NCHW", "OIHW", "NCHW"),
-    "NHWC": ("NHWC", "HWIO", "NHWC"),
+    "NHWC": ("NHWC", "OHWI", "NHWC"),
     "NCW": ("NCH", "OIH", "NCH"),
-    "NWC": ("NHC", "HIO", "NHC"),
+    "NWC": ("NHC", "OHI", "NHC"),
     "NCDHW": ("NCDHW", "OIDHW", "NCDHW"),
-    "NDHWC": ("NDHWC", "DHWIO", "NDHWC"),
+    "NDHWC": ("NDHWC", "ODHWI", "NDHWC"),
 }
 
 
@@ -706,7 +713,7 @@ register_op("Convolution", num_inputs=-1,
                     Param("num_group", int, 1),
                     Param("no_bias", bool, False),
                     Param("layout", str, None)],
-            aliases=("convolution",))(
+            aliases=("convolution", "Convolution_v1"))(
     lambda data, weight, *b, **kw: _convolution(
         data, weight, b[0] if b else None, **kw))
 
@@ -801,7 +808,7 @@ register_op("Pooling",
                     Param("pad", tuple, None),
                     Param("count_include_pad", bool, True),
                     Param("layout", str, None)],
-            aliases=("pooling",))(_pooling)
+            aliases=("pooling", "Pooling_v1"))(_pooling)
 
 
 def _activation(x, act_type="relu"):
@@ -1002,7 +1009,7 @@ register_op("BatchNorm", num_inputs=5, num_outputs=3,
                     Param("use_global_stats", bool, False),
                     Param("output_mean_var", bool, False),
                     Param("axis", int, 1)],
-            aliases=("batch_norm",))(_batch_norm)
+            aliases=("batch_norm", "BatchNorm_v1"))(_batch_norm)
 
 
 def _as_prng_key(key):

@@ -1,0 +1,250 @@
+"""Registered sampling ops (``src/operator/random/*``†).
+
+The reference's samplers are graph ops drawing from per-context RNG
+resources; the TPU-native form is counter-based — every op takes an
+explicit PRNG ``key`` tensor as its FIRST input (the pattern Dropout
+and shuffle already use), so the same rule is pure under jit and usable
+from symbols.  The stateful ``mx.nd.random.*`` convenience surface
+(``mxtpu/ndarray/random.py``) remains the user-facing API that feeds
+keys from the per-context stream.
+
+``_random_*`` draw i.i.d. samples of a given static shape from scalar
+distribution params; ``_sample_*`` take per-row param tensors and draw
+``shape`` samples per row (reference semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops.registry import Param, register_op
+from .ops_impl import _as_prng_key
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype or "float32")
+
+
+def _shape(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape or (1,))
+
+
+# -- _random_* : scalar params, static shape ---------------------------
+
+def _r(name, fn, params, differentiable=False):
+    register_op(name, num_inputs=1, differentiable=differentiable,
+                params=[Param("shape", tuple, (1,)),
+                        Param("dtype", str, None)] + params)(fn)
+
+
+_r("_random_uniform",
+   lambda key, shape=(1,), dtype=None, low=0.0, high=1.0:
+   jax.random.uniform(_as_prng_key(key), _shape(shape), _dt(dtype),
+                      low, high),
+   [Param("low", float, 0.0), Param("high", float, 1.0)])
+
+_r("_random_normal",
+   lambda key, shape=(1,), dtype=None, loc=0.0, scale=1.0:
+   loc + scale * jax.random.normal(_as_prng_key(key), _shape(shape),
+                                   _dt(dtype)),
+   [Param("loc", float, 0.0), Param("scale", float, 1.0)])
+
+_r("_random_gamma",
+   lambda key, shape=(1,), dtype=None, alpha=1.0, beta=1.0:
+   jax.random.gamma(_as_prng_key(key), alpha, _shape(shape),
+                    _dt(dtype)) * beta,
+   [Param("alpha", float, 1.0), Param("beta", float, 1.0)])
+
+_r("_random_exponential",
+   lambda key, shape=(1,), dtype=None, lam=1.0:
+   jax.random.exponential(_as_prng_key(key), _shape(shape),
+                          _dt(dtype)) / lam,
+   [Param("lam", float, 1.0)])
+
+_r("_random_poisson",
+   lambda key, shape=(1,), dtype=None, lam=1.0:
+   jax.random.poisson(_as_prng_key(key), lam, _shape(shape)).astype(
+       _dt(dtype)),
+   [Param("lam", float, 1.0)])
+
+
+def _neg_binomial(key, shape=(1,), dtype=None, k=1, p=1.0):
+    key1, key2 = jax.random.split(_as_prng_key(key))
+    lam = jax.random.gamma(key1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, lam, _shape(shape)).astype(
+        _dt(dtype))
+
+
+_r("_random_negative_binomial", _neg_binomial,
+   [Param("k", int, 1), Param("p", float, 1.0)])
+
+
+def _gen_neg_binomial(key, shape=(1,), dtype=None, mu=1.0, alpha=1.0):
+    key1, key2 = jax.random.split(_as_prng_key(key))
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(key1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, lam, _shape(shape)).astype(
+        _dt(dtype))
+
+
+_r("_random_generalized_negative_binomial", _gen_neg_binomial,
+   [Param("mu", float, 1.0), Param("alpha", float, 1.0)])
+
+_r("_random_randint",
+   lambda key, shape=(1,), dtype=None, low=0, high=1:
+   jax.random.randint(_as_prng_key(key), _shape(shape), low, high,
+                      _dt(dtype or "int32")),
+   [Param("low", int, 0), Param("high", int, 1)])
+
+
+# -- _sample_* : per-row param tensors ---------------------------------
+# output shape = params.shape + shape (reference convention)
+
+def _s(name, fn, num_inputs):
+    register_op(name, num_inputs=num_inputs, differentiable=False,
+                params=[Param("shape", tuple, ()),
+                        Param("dtype", str, None)])(fn)
+
+
+def _draw_shape(param, shape):
+    return tuple(param.shape) + _shape(shape) if shape else \
+        tuple(param.shape)
+
+
+_s("_sample_uniform",
+   lambda key, low, high, shape=(), dtype=None:
+   jax.random.uniform(_as_prng_key(key), _draw_shape(low, shape),
+                      _dt(dtype))
+   * (high - low).reshape(low.shape + (1,) * len(_shape(shape))
+                          if shape else low.shape)
+   + low.reshape(low.shape + (1,) * len(_shape(shape))
+                 if shape else low.shape), 3)
+
+_s("_sample_normal",
+   lambda key, mu, sigma, shape=(), dtype=None:
+   mu.reshape(_bshape(mu, shape)) + sigma.reshape(_bshape(sigma, shape))
+   * jax.random.normal(_as_prng_key(key), _draw_shape(mu, shape),
+                       _dt(dtype)), 3)
+
+
+def _bshape(param, shape):
+    return tuple(param.shape) + (1,) * (len(_shape(shape)) if shape
+                                        else 0)
+
+
+def _sample_gamma(key, alpha, beta, shape=(), dtype=None):
+    a = jnp.broadcast_to(alpha.reshape(_bshape(alpha, shape)),
+                         _draw_shape(alpha, shape))
+    return jax.random.gamma(_as_prng_key(key), a, dtype=_dt(dtype)) \
+        * beta.reshape(_bshape(beta, shape))
+
+
+_s("_sample_gamma", _sample_gamma, 3)
+
+_s("_sample_exponential",
+   lambda key, lam, shape=(), dtype=None:
+   jax.random.exponential(_as_prng_key(key), _draw_shape(lam, shape),
+                          _dt(dtype)) / lam.reshape(_bshape(lam, shape)),
+   2)
+
+
+def _sample_poisson(key, lam, shape=(), dtype=None):
+    lam_b = jnp.broadcast_to(lam.reshape(_bshape(lam, shape)),
+                             _draw_shape(lam, shape))
+    return jax.random.poisson(_as_prng_key(key), lam_b).astype(
+        _dt(dtype))
+
+
+_s("_sample_poisson", _sample_poisson, 2)
+
+
+def _sample_negative_binomial(key, k, p, shape=(), dtype=None):
+    key1, key2 = jax.random.split(_as_prng_key(key))
+    kk = jnp.broadcast_to(k.reshape(_bshape(k, shape)),
+                          _draw_shape(k, shape)).astype(jnp.float32)
+    pp = jnp.broadcast_to(p.reshape(_bshape(p, shape)),
+                          _draw_shape(p, shape))
+    lam = jax.random.gamma(key1, kk) * (1 - pp) / pp
+    return jax.random.poisson(key2, lam).astype(_dt(dtype))
+
+
+_s("_sample_negative_binomial", _sample_negative_binomial, 3)
+
+
+def _sample_gen_neg_binomial(key, mu, alpha, shape=(), dtype=None):
+    key1, key2 = jax.random.split(_as_prng_key(key))
+    mm = jnp.broadcast_to(mu.reshape(_bshape(mu, shape)),
+                          _draw_shape(mu, shape))
+    aa = jnp.broadcast_to(alpha.reshape(_bshape(alpha, shape)),
+                          _draw_shape(alpha, shape))
+    r = 1.0 / aa
+    p = r / (r + mm)
+    lam = jax.random.gamma(key1, r) * (1 - p) / p
+    return jax.random.poisson(key2, lam).astype(_dt(dtype))
+
+
+_s("_sample_generalized_negative_binomial", _sample_gen_neg_binomial, 3)
+
+
+def _sample_multinomial(key, data, shape=(), get_prob=False,
+                        dtype="int32"):
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    n = 1
+    for s in _shape(shape) if shape else ():
+        n *= s
+    if data.ndim == 1:
+        draw = jax.random.categorical(_as_prng_key(key), logits,
+                                      shape=(n,) if shape else ())
+    else:
+        draw = jax.random.categorical(
+            _as_prng_key(key), logits[:, None, :] if shape else logits,
+            axis=-1,
+            shape=(data.shape[0], n) if shape else (data.shape[0],))
+    draw = draw.astype(jnp.dtype(dtype))
+    if get_prob:
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        idx = draw.astype(jnp.int32)
+        if data.ndim == 1:
+            lp = lsm[idx]
+        else:
+            lp = jnp.take_along_axis(
+                lsm, idx.reshape(data.shape[0], -1), axis=-1
+            ).reshape(draw.shape)
+        return draw, lp
+    return draw
+
+
+register_op("_sample_multinomial", num_inputs=2, differentiable=False,
+            params=[Param("shape", tuple, ()),
+                    Param("get_prob", bool, False),
+                    Param("dtype", str, "int32")],
+            num_outputs_fn=lambda attrs:
+            2 if attrs.get("get_prob") else 1)(_sample_multinomial)
+
+
+def _sample_unique_zipfian(key, range_max=0, shape=()):
+    """Log-uniform (zipfian) candidate sampler
+    (``_sample_unique_zipfian``†).  DIVERGENCE: sampled WITH
+    replacement (static shapes — true rejection sampling is
+    data-dependent); returns (samples, expected_counts) like the
+    reference."""
+    sh = _shape(shape)
+    u = jax.random.uniform(_as_prng_key(key), sh)
+    k = jnp.floor(jnp.exp(u * jnp.log(float(range_max) + 1.0))) - 1.0
+    k = jnp.clip(k, 0, range_max - 1).astype(jnp.int64)
+    # P(k) = log((k+2)/(k+1)) / log(range_max + 1)
+    prob = jnp.log((k + 2.0) / (k + 1.0)) / jnp.log(
+        float(range_max) + 1.0)
+    n_draws = 1
+    for s in sh:
+        n_draws *= s
+    expected = prob * n_draws
+    return k, expected
+
+
+register_op("_sample_unique_zipfian", num_inputs=1, num_outputs=2,
+            differentiable=False,
+            params=[Param("range_max", int, 0),
+                    Param("shape", tuple, ())])(_sample_unique_zipfian)

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -335,8 +336,14 @@ class TrainStep:
                        x_raw, y_raw)
         donate = (0, 2) if self.donate else ()
         fitted = jax.jit(step, donate_argnums=donate)
-        return {"fn": fitted, "aux_params": aux_box["aux_params"],
-                "frozen_idx": frozen_idx}
+        # aux (BN running stats) positions inside the frozen tuple, in
+        # aux_params order, for the scanned multi-step path to thread
+        # them through the carry (None if an aux is somehow trainable)
+        id2pos = {id(params[i]): j for j, i in enumerate(frozen_idx)}
+        aux_pos = [id2pos.get(id(p)) for p in aux_box["aux_params"]]
+        return {"fn": fitted, "raw_step": step,
+                "aux_params": aux_box["aux_params"],
+                "frozen_idx": frozen_idx, "aux_pos": aux_pos}
 
     # -- the hot call ----------------------------------------------------
     def __call__(self, x, y):
@@ -379,6 +386,109 @@ class TrainStep:
         for p, v in zip(entry["aux_params"], raw_aux):
             p._data._data = v
         return NDArray(loss, None, _placed=True)
+
+    # -- bulked execution -------------------------------------------------
+    def run_steps(self, x, y, steps: int, reuse_batch: bool = False):
+        """Run ``steps`` optimizer steps in ONE compiled program via
+        ``lax.scan`` over microbatches — the TPU-native form of the
+        reference's bulked graph execution (``MXNET_EXEC_BULK_EXEC_
+        TRAIN``†, ``src/executor/graph_executor.cc`` bulking): host
+        dispatch cost is paid once per ``steps`` instead of per step.
+
+        ``x``/``y`` carry ``steps`` microbatches stacked on the batch
+        axis (leading dim ``steps * B``), or — with
+        ``reuse_batch=True`` — ONE batch stepped ``steps`` times
+        (benchmarking / steady-state measurement, where stacking real
+        microbatches would waste HBM).  lr/wd schedules are sampled
+        once per call (per-``steps`` granularity).  Returns the
+        per-step losses as a ``(steps,)`` NDArray."""
+        if steps <= 0:
+            raise MXNetError("run_steps needs steps >= 1")
+        x_raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        y_raw = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self.batch_axis != 0:
+            raise MXNetError("run_steps supports batch_axis=0")
+        if reuse_batch:
+            B = x_raw.shape[0]
+            xs, ys = x_raw, y_raw
+        else:
+            if x_raw.shape[0] % steps:
+                raise MXNetError(
+                    f"leading dim {x_raw.shape[0]} not divisible into "
+                    f"{steps} microbatches")
+            B = x_raw.shape[0] // steps
+            xs = x_raw.reshape((steps, B) + x_raw.shape[1:])
+            ys = y_raw.reshape((steps, B) + y_raw.shape[1:]) \
+                if y_raw.ndim else y_raw
+        self._collect(NDArray(x_raw[:B], None, _placed=True))
+        batch_dim = 0 if reuse_batch else 1
+        if self.mesh is not None:
+            spec = [None] * xs.ndim
+            spec[batch_dim] = self.dp_axis
+            xs = _device_put_global(xs, self.mesh, P(*spec))
+            yspec = [None] * max(ys.ndim, 1)
+            if ys.ndim > batch_dim:
+                yspec[batch_dim] = self.dp_axis
+            ys = _device_put_global(ys, self.mesh, P(*yspec[:ys.ndim]))
+        key = _rnd._next_key(None)
+        one_shape = xs.shape[batch_dim:] if not reuse_batch else xs.shape
+        y_one = ys.shape[batch_dim:] if not reuse_batch else ys.shape
+        sig = (one_shape, str(xs.dtype), y_one, str(ys.dtype))
+        entry = self._compiled.get(sig)
+        if entry is None:
+            xb0 = xs if reuse_batch else xs[0]
+            yb0 = ys if reuse_batch else (ys[0] if ys.ndim else ys)
+            entry = self._build(key, xb0, yb0)
+            self._compiled[sig] = entry
+        msig = ("multi", steps, reuse_batch) + sig
+        multi = self._compiled.get(msig)
+        if multi is None:
+            raw_step = entry["raw_step"]
+            aux_pos = entry["aux_pos"]
+
+            def multi_fn(train_vals, frozen_vals, opt_state, key_data,
+                         lrs, wds, xs, ys):
+                def body(carry, inp):
+                    tv, frozen, st = carry
+                    if reuse_batch:
+                        (kd,) = inp
+                        xb, yb = xs, ys
+                    else:
+                        xb, yb, kd = inp
+                    loss, tv2, st2, raw_aux = raw_step(
+                        tv, frozen, st, kd, lrs, wds, xb, yb)
+                    frozen2 = list(frozen)
+                    for pos, v in zip(aux_pos, raw_aux):
+                        if pos is not None:
+                            frozen2[pos] = v
+                    return (tv2, tuple(frozen2), st2), loss
+                scanned = (key_data,) if reuse_batch else \
+                    (xs, ys, key_data)
+                (tv, frozen, st), losses = lax.scan(
+                    body, (train_vals, frozen_vals, opt_state), scanned)
+                return losses, tv, frozen, st
+
+            donate = (0, 1, 2) if self.donate else ()
+            multi = jax.jit(multi_fn, donate_argnums=donate)
+            self._compiled[msig] = multi
+        self._t += steps
+        lrs, wds = self._lrs_wds()
+        params = self._params
+        train_vals = tuple(params[i]._data._data
+                           for i in self._train_idx)
+        frozen_vals = tuple(params[i]._data._data
+                            for i in entry["frozen_idx"])
+        keys = jax.vmap(jax.random.key_data)(
+            jax.random.split(key, steps))
+        losses, tv, frozen, st = multi(
+            train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
+            xs, ys)
+        for i, v in zip(self._train_idx, tv):
+            params[i]._data._data = v
+        for j, i in enumerate(entry["frozen_idx"]):
+            params[i]._data._data = frozen[j]
+        self._opt_state = st
+        return NDArray(losses, None, _placed=True)
 
     # -- checkpoint/resume (SURVEY §5.4: preemption-safe from day one) --
     def save_states(self, fname: str) -> None:

@@ -1,0 +1,182 @@
+"""Detection data path end-to-end (VERDICT r2 item 4): det-record
+packing, ImageDetIter with box-aware augmentation, VOC07 mAP, and SSD
+training from a .rec reaching a mAP threshold.
+
+References: src/io/iter_image_det_recordio.cc†,
+python/mxnet/image/detection.py†, example/ssd/evaluate/eval_metric.py†.
+"""
+import numpy as np
+import pytest
+
+from mxtpu import nd
+from mxtpu import recordio as rio
+from mxtpu.image import (DetHorizontalFlipAug, DetRandomCropAug,
+                         ImageDetIter, pack_det_label)
+from mxtpu.metric import MApMetric, VOC07MApMetric
+
+
+def _write_rec(prefix, n=16, size=32, seed=0):
+    rng = np.random.RandomState(seed)
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    truths = []
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 40).astype(np.uint8)
+        cls = int(rng.randint(2))
+        w = int(rng.randint(size // 4, size // 2))
+        x0 = int(rng.randint(0, size - w))
+        y0 = int(rng.randint(0, size - w))
+        # class-coded color so the class head has signal to learn
+        img[y0:y0 + w, x0:x0 + w] = (220, 40, 60) if cls == 0 \
+            else (40, 220, 60)
+        box = [cls, x0 / size, y0 / size, (x0 + w) / size,
+               (y0 + w) / size]
+        truths.append(box)
+        rec.write_idx(i, rio.pack_img(
+            rio.IRHeader(0, pack_det_label([box]), i, 0), img,
+            quality=95))
+    rec.close()
+    return prefix + ".rec", prefix + ".idx", truths
+
+
+def test_pack_det_label_layout():
+    lab = pack_det_label([[1, 0.1, 0.2, 0.3, 0.4],
+                          [0, 0.5, 0.5, 0.9, 0.9]])
+    assert lab[0] == 2 and lab[1] == 5 and lab.size == 12
+    hdr, rest = int(lab[0]), lab[2:]
+    objs = lab[hdr:].reshape(-1, 5)
+    np.testing.assert_allclose(objs[0], [1, 0.1, 0.2, 0.3, 0.4])
+
+
+def test_imagedetiter_reads_and_pads(tmp_path):
+    rec, idx, truths = _write_rec(str(tmp_path / "det"), n=10)
+    it = ImageDetIter(rec, (3, 32, 32), batch_size=4, path_imgidx=idx,
+                      scale=1.0 / 255)
+    assert it.max_objs == 1
+    batch = next(it)
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (4, 3, 32, 32) and label.shape == (4, 1, 5)
+    # labels round-trip through the wire format
+    np.testing.assert_allclose(label[0, 0], truths[0], atol=1e-6)
+    assert 0.0 <= data.min() and data.max() <= 1.0
+    # padding on the tail batch
+    batches = [batch] + list(it)
+    assert batches[-1].pad == 2  # 10 % 4
+
+
+def test_det_flip_aug_moves_boxes():
+    rng = np.random.RandomState(0)
+    img = rng.rand(16, 16, 3)
+    label = np.asarray([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+
+    class AlwaysFlip(DetHorizontalFlipAug):
+        def __init__(self):
+            super().__init__(p=1.1)
+
+    img2, lab2 = AlwaysFlip()(img.copy(), label.copy())
+    np.testing.assert_allclose(lab2[0], [0, 0.6, 0.2, 0.9, 0.6],
+                               atol=1e-6)
+    np.testing.assert_allclose(img2, img[:, ::-1])
+    # flip twice = identity
+    _, lab3 = AlwaysFlip()(img2, lab2.copy())
+    np.testing.assert_allclose(lab3, label, atol=1e-6)
+
+
+def test_det_random_crop_keeps_covered_boxes():
+    rng = np.random.RandomState(3)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 0.9),
+                           rng=rng)
+    img = rng.rand(64, 64, 3)
+    label = np.asarray([[0, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    kept = 0
+    for _ in range(10):
+        _, lab2 = aug(img, label.copy())
+        if lab2[0, 0] >= 0:
+            kept += 1
+            assert 0 <= lab2[0, 1] <= lab2[0, 3] <= 1
+            assert 0 <= lab2[0, 2] <= lab2[0, 4] <= 1
+    assert kept >= 5  # central box survives most crops
+
+
+def test_voc07_map_known_values():
+    m = VOC07MApMetric()
+    label = np.array([[[0, .1, .1, .5, .5], [1, .6, .6, .9, .9],
+                       [-1] * 5]])
+    pred = np.array([[[0, .95, .1, .1, .5, .5],
+                      [1, .9, .6, .6, .9, .9], [-1] * 6]])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    # duplicate detection of a matched gt counts as false positive
+    m.reset()
+    pred_dup = np.array([[[0, .95, .1, .1, .5, .5],
+                          [0, .90, .1, .1, .5, .5], [-1] * 6]])
+    label_one = np.array([[[0, .1, .1, .5, .5]]])
+    m.update([label_one], [pred_dup])
+    # full recall happens at the top-scored det, so the 11-point AP
+    # stays 1.0 — the fp only lowers later precision
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    m2 = MApMetric()
+    m2.update([label_one], [pred_dup])
+    assert abs(m2.get()[1] - 1.0) < 1e-6
+
+
+def test_ssd_trains_from_rec_and_reaches_map(tmp_path):
+    """The reference's SSD recipe end-to-end on a tiny synthetic set:
+    pack rec → ImageDetIter → MultiBoxTarget training → detect →
+    VOC07 mAP above threshold."""
+    import mxtpu as mx
+    from mxtpu import autograd, gluon
+    from mxtpu.models.ssd import SSDLoss, toy_ssd
+
+    mx.random.seed(0)
+    rec, idx, _ = _write_rec(str(tmp_path / "train"), n=24, size=32,
+                             seed=1)
+    it = ImageDetIter(rec, (3, 32, 32), batch_size=8, path_imgidx=idx,
+                      shuffle=True, rand_mirror=True, scale=1.0 / 255)
+    net = toy_ssd(num_classes=2)
+    net.initialize(init="xavier")
+    loss_fn = SSDLoss()
+    trainer = None
+    losses = []
+    for _ in range(10):
+        it.reset()
+        for batch in it:
+            x, labels = batch.data[0], batch.label[0]
+            if trainer is None:
+                net(x)
+                trainer = gluon.Trainer(net.collect_params(), "adam",
+                                        {"learning_rate": 5e-3})
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                bt, bm, ct = nd.MultiBoxTarget(anchors, labels,
+                                               cls_preds)
+                loss = nd.mean(loss_fn(cls_preds, box_preds, ct, bt,
+                                       bm))
+            loss.backward()
+            trainer.step(batch_size=x.shape[0])
+            losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    metric = VOC07MApMetric(iou_thresh=0.3)
+    it.reset()
+    for batch in it:
+        out = net.detect(batch.data[0])
+        metric.update([batch.label[0]], [out])
+    name, value = metric.get()
+    # tiny net + tiny data: the bar proves the pipeline learns signal
+    # (top detections localize and classify; pooled low-score false
+    # positives cap toy mAP well below 1), not detection SOTA
+    assert value > 0.15, value
+
+
+def test_voc07_map_difficult_neutral():
+    """VOC protocol: difficult gts excluded from npos; matches to them
+    are neutral (neither tp nor fp)."""
+    m = VOC07MApMetric()
+    # one easy gt (matched) + one difficult gt (matched by a 2nd det)
+    label = np.array([[[0, .1, .1, .5, .5, 0],
+                       [0, .6, .6, .9, .9, 1]]])
+    pred = np.array([[[0, .95, .1, .1, .5, .5],
+                      [0, .90, .6, .6, .9, .9]]])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6  # difficult det is neutral

@@ -1,0 +1,120 @@
+"""INT8 quantization depth (VERDICT r2 item 6): entropy/KL
+calibration, the quantize_model graph rewrite, and int8 conv/fc
+execution vs float within tolerance.
+
+Reference: python/mxnet/contrib/quantization.py†,
+src/operator/quantization/*†.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import io as mio
+from mxtpu import nd
+from mxtpu.contrib import quantization as q
+from mxtpu.executor import Executor
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    f1 = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(f1, num_hidden=10, name="fc1")
+    return mx.sym.softmax(fc, axis=-1)
+
+
+def _setup(seed=0):
+    rng = np.random.RandomState(seed)
+    sym = _convnet()
+    arg_shapes, _, _ = sym.infer_shape(data=(4, 3, 16, 16))
+    args = {n: nd.array(rng.randn(*s).astype(np.float32) * 0.2)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n != "data"}
+    X = rng.randn(32, 3, 16, 16).astype(np.float32)
+    return sym, args, X
+
+
+def test_optimal_threshold_clips_outliers():
+    rng = np.random.RandomState(0)
+    # bulk mass in [-1, 1] plus a few extreme outliers: the KL
+    # threshold should land well below the abs-max
+    a = np.concatenate([rng.randn(100000),
+                        np.asarray([40.0, -35.0, 30.0])])
+    t = q.optimal_threshold(a)
+    assert t < 15.0, t
+    assert t > 1.0, t
+    # pure gaussian: threshold within the support
+    t2 = q.optimal_threshold(rng.randn(50000))
+    assert 1.0 < t2 < 6.0
+
+
+def test_calib_entropy_symmetric_ranges():
+    rng = np.random.RandomState(1)
+    out = q.calib_entropy({"x": [rng.randn(1000).astype(np.float32)]})
+    lo, hi = out["x"]
+    assert lo == -hi and hi > 0
+
+
+def test_collect_layer_outputs():
+    sym, args, X = _setup()
+    it = mio.NDArrayIter(X, None, batch_size=4)
+    names = ["conv1_output"]
+    got = q.collect_layer_outputs(sym, args, {}, it, names,
+                                  num_batches=3)
+    assert len(got["conv1_output"]) == 3
+    assert got["conv1_output"][0].shape == (4, 8, 16, 16)
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy"])
+def test_quantize_model_matches_float(mode):
+    sym, args, X = _setup()
+    it = mio.NDArrayIter(X, None, batch_size=4)
+    qsym, qargs, _ = q.quantize_model(sym, args, {}, data_iter=it,
+                                      calib_mode=mode,
+                                      num_calib_batches=4)
+    # the rewrite actually int8-ized the compute ops
+    ops = [n.op for n in qsym._topo() if n.op]
+    assert "_contrib_quantized_conv" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "Convolution" not in ops and "FullyConnected" not in ops
+
+    fa = dict(args)
+    fa["data"] = nd.array(X[:4])
+    fout = Executor(sym, args=fa,
+                    grad_req="null").forward()[0].asnumpy()
+    qa = {k: v for k, v in dict(qargs, data=nd.array(X[:4])).items()
+          if k in qsym.list_arguments()}
+    qout = Executor(qsym, args=qa,
+                    grad_req="null").forward()[0].asnumpy()
+    assert np.abs(qout - fout).max() < 0.05
+    # int8 model still ranks classes like the float one (argmax parity
+    # on most samples)
+    agree = (qout.argmax(1) == fout.argmax(1)).mean()
+    assert agree >= 0.75, agree
+
+
+def test_quantize_model_excluded_names_stay_float():
+    sym, args, X = _setup()
+    it = mio.NDArrayIter(X, None, batch_size=4)
+    qsym, _, _ = q.quantize_model(sym, args, {}, data_iter=it,
+                                  calib_mode="naive",
+                                  excluded_sym_names=("conv1",))
+    ops = [n.op for n in qsym._topo() if n.op]
+    assert "Convolution" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+
+
+def test_quantize_model_roundtrips_json():
+    sym, args, X = _setup()
+    it = mio.NDArrayIter(X, None, batch_size=4)
+    qsym, qargs, _ = q.quantize_model(sym, args, {}, data_iter=it,
+                                      calib_mode="naive")
+    back = mx.sym.fromjson(qsym.tojson())
+    qa = {k: v for k, v in dict(qargs, data=nd.array(X[:4])).items()
+          if k in back.list_arguments()}
+    out = Executor(back, args=qa, grad_req="null").forward()[0]
+    assert out.shape == (4, 10)
