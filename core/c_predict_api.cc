@@ -263,6 +263,63 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint out_index,
   return 0;
 }
 
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  PredictorHandle handle, PredictorHandle *out) {
+  Predictor *h = static_cast<Predictor *>(handle);
+  if (h == nullptr || out == nullptr) {
+    g_last_error = "null argument";
+    return -1;
+  }
+  if (num_input_nodes > 0 &&
+      (input_keys == nullptr || input_shape_indptr == nullptr ||
+       input_shape_data == nullptr)) {
+    g_last_error = "null input key/shape arrays";
+    return -1;
+  }
+  GIL gil;
+  // build {key: shape} dict with checked allocations
+  PyObject *shapes = PyDict_New();
+  bool build_ok = shapes != nullptr;
+  for (mx_uint i = 0; build_ok && i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    if (shape == nullptr) {
+      build_ok = false;
+      break;
+    }
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyObject *dim = PyLong_FromUnsignedLong(input_shape_data[j]);
+      if (dim == nullptr) {
+        build_ok = false;
+        break;
+      }
+      PyList_SET_ITEM(shape, j - lo, dim);
+    }
+    if (build_ok &&
+        PyDict_SetItemString(shapes, input_keys[i], shape) != 0) {
+      build_ok = false;
+    }
+    Py_DECREF(shape);
+  }
+  if (!build_ok) {
+    set_error_from_python();
+    Py_XDECREF(shapes);
+    return -1;
+  }
+  PyObject *pred = PyObject_CallMethod(h->obj, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Predictor *nh = new Predictor();
+  nh->obj = pred;
+  *out = nh;
+  return 0;
+}
+
 int MXPredFree(PredictorHandle handle) {
   Predictor *h = static_cast<Predictor *>(handle);
   if (h == nullptr) return 0;

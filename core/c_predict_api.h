@@ -51,6 +51,14 @@ int MXPredForward(PredictorHandle handle);
 int MXPredGetOutput(PredictorHandle handle, mx_uint out_index,
                     mx_float *data, mx_uint size);
 
+/* New predictor for different input shapes, sharing the weights of
+ * `handle` (reference MXPredReshape†).  The original handle stays
+ * valid; free both. */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  PredictorHandle handle, PredictorHandle *out);
+
 /* Release the predictor. */
 int MXPredFree(PredictorHandle handle);
 

@@ -1,0 +1,152 @@
+/*
+ * C++ inference frontend over the C predict ABI (the role of the
+ * reference's cpp-package† generated op.h / predictor surface, scoped
+ * to deployment: RAII + std::vector in, std::vector out).
+ *
+ * Header-only; link with -lmxtpu_predict (build: `make -C core
+ * predict`).  Throws mxtpu::Error on any ABI failure, carrying
+ * MXGetLastError().
+ */
+#ifndef MXTPU_CPP_PREDICTOR_HPP_
+#define MXTPU_CPP_PREDICTOR_HPP_
+
+#include <cstddef>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../core/c_predict_api.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void check(int rc, const char *call) {
+  if (rc != 0) {
+    throw Error(std::string(call) + ": " + MXGetLastError());
+  }
+}
+
+inline std::string read_file(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw Error("cannot open " + path);
+  return std::string((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+}
+
+using Shape = std::vector<mx_uint>;
+
+/* RAII predictor: symbol JSON + params blob + named input shapes. */
+class Predictor {
+ public:
+  Predictor(const std::string &symbol_json, const std::string &params,
+            const std::map<std::string, Shape> &input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    check(MXPredCreate(symbol_json.c_str(), params.data(),
+                       static_cast<int>(params.size()), dev_type,
+                       dev_id,
+                       static_cast<mx_uint>(keys.size()), keys.data(),
+                       indptr.data(), data.data(), &handle_),
+          "MXPredCreate");
+  }
+
+  /* Load from exported files: prefix-symbol.json + prefix-0000.params
+   * (HybridBlock.export / Module.save_checkpoint layout). */
+  static Predictor FromFiles(
+      const std::string &symbol_file, const std::string &param_file,
+      const std::map<std::string, Shape> &input_shapes,
+      int dev_type = 1, int dev_id = 0) {
+    return Predictor(read_file(symbol_file), read_file(param_file),
+                     input_shapes, dev_type, dev_id);
+  }
+
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor &operator=(Predictor &&other) noexcept {
+    if (this != &other) {
+      if (handle_ != nullptr) MXPredFree(handle_);
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  void SetInput(const std::string &key,
+                const std::vector<mx_float> &values) {
+    check(MXPredSetInput(handle_, key.c_str(), values.data(),
+                         static_cast<mx_uint>(values.size())),
+          "MXPredSetInput");
+  }
+
+  void Forward() { check(MXPredForward(handle_), "MXPredForward"); }
+
+  Shape GetOutputShape(mx_uint index = 0) const {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    check(MXPredGetOutputShape(handle_, index, &shape, &ndim),
+          "MXPredGetOutputShape");
+    return Shape(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index = 0) const {
+    Shape shape = GetOutputShape(index);
+    std::size_t size = std::accumulate(shape.begin(), shape.end(),
+                                       std::size_t{1},
+                                       std::multiplies<std::size_t>());
+    std::vector<mx_float> out(size);
+    check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(size)),
+          "MXPredGetOutput");
+    return out;
+  }
+
+  /* New predictor for other input shapes, sharing weights
+   * (MXPredReshape). */
+  Predictor Reshape(
+      const std::map<std::string, Shape> &input_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    PredictorHandle out = nullptr;
+    check(MXPredReshape(static_cast<mx_uint>(keys.size()), keys.data(),
+                        indptr.data(), data.data(), handle_, &out),
+          "MXPredReshape");
+    return Predictor(out);
+  }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_PREDICTOR_HPP_

@@ -1,0 +1,71 @@
+"""Inference benchmark over the model zoo (reference
+``example/image-classification/benchmark_score.py``†): images/sec per
+(network, batch size) on the current device.
+
+  python examples/benchmark_score.py --networks resnet18_v1 resnet50_v1
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu import nd
+from mxtpu.gluon.model_zoo import vision
+
+
+def score(network, batch_size, image_size=224, dtype="float32",
+          warmup=3, iters=10):
+    net = getattr(vision, network)()
+    net.initialize(init="xavier")
+    net.hybridize()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch_size, 3, image_size, image_size)
+                 .astype(np.float32))
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+        x = x.astype("bfloat16")
+    for _ in range(warmup):
+        out = net(x)
+    float(out.asnumpy().ravel()[0])  # sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    float(out.asnumpy().ravel()[0])
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--networks", nargs="+",
+                   default=["alexnet", "resnet18_v1", "resnet50_v1",
+                            "vgg11", "mobilenet1_0", "squeezenet1_0"])
+    p.add_argument("--batch-sizes", nargs="+", type=int,
+                   default=[1, 32])
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--dtype", default="float32",
+                   choices=("float32", "bfloat16"))
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    for network in args.networks:
+        if not hasattr(vision, network):
+            logging.warning("skipping unknown network %s", network)
+            continue
+        for bs in args.batch_sizes:
+            try:
+                ips = score(network, bs, args.image_size, args.dtype)
+                logging.info("network: %s, batch: %d, dtype: %s, "
+                             "images/sec: %.1f", network, bs,
+                             args.dtype, ips)
+            except Exception as e:  # keep scoring the rest
+                logging.error("%s batch %d failed: %s", network, bs,
+                              str(e)[:200])
+
+
+if __name__ == "__main__":
+    main()

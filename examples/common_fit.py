@@ -1,0 +1,115 @@
+"""The canonical Module training recipe — a faithful rebuild of
+``example/image-classification/common/fit.py``†: argparse flags for
+network/optimizer/kvstore/lr-schedule/checkpointing, then
+``mod.fit`` with Speedometer + checkpoint callbacks.
+
+Import ``add_fit_args``/``fit`` from training scripts
+(train_cifar10.py does), exactly how the reference's image-
+classification examples share one loop.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+
+
+def add_fit_args(parser: argparse.ArgumentParser):
+    """Reference ``common.fit.add_fit_args``† flag surface (the subset
+    meaningful on TPU — dtype/kvstore/monitor kept, GPU toggles
+    dropped)."""
+    train = parser.add_argument_group("fit", "training recipe")
+    train.add_argument("--network", type=str, default="resnet18_v1")
+    train.add_argument("--num-classes", type=int, default=10)
+    train.add_argument("--num-epochs", type=int, default=3)
+    train.add_argument("--lr", type=float, default=0.05)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="",
+                       help="comma-separated epochs to decay lr at")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--kv-store", type=str, default="local")
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str, default=None,
+                       help="checkpoint prefix (enables per-epoch "
+                            "checkpoints)")
+    train.add_argument("--load-epoch", type=int, default=None,
+                       help="resume from this checkpoint epoch")
+    train.add_argument("--dtype", type=str, default="float32",
+                       choices=("float32", "bfloat16"))
+    train.add_argument("--top-k", type=int, default=0)
+    return train
+
+
+def _lr_scheduler(args, epoch_size):
+    if not args.lr_step_epochs:
+        return None
+    steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    begin = args.load_epoch or 0
+    steps = [epoch_size * (s - begin) for s in steps
+             if s - begin > 0]
+    if not steps:
+        return None
+    from mxtpu.optimizer.lr_scheduler import MultiFactorScheduler
+    return MultiFactorScheduler(step=steps, factor=args.lr_factor)
+
+
+def fit(args, network, train_iter, val_iter=None, **kwargs):
+    """The reference ``common.fit.fit``† loop: bind/init via Module,
+    kvstore-driven updates, lr schedule, Speedometer, checkpoints."""
+    logging.basicConfig(level=logging.INFO)
+    kv = mx.kvstore.create(args.kv_store)
+
+    epoch_size = max(len(train_iter) if hasattr(train_iter, "__len__")
+                     else 0, 1)
+    arg_params = aux_params = None
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        logging.info("resumed from %s-%04d", args.model_prefix,
+                     args.load_epoch)
+
+    mod = mx.mod.Module(network, data_names=["data"],
+                        label_names=["softmax_label"])
+    optimizer_params = {
+        "learning_rate": args.lr,
+        "wd": args.wd,
+    }
+    if args.optimizer in ("sgd", "nag", "signum"):
+        optimizer_params["momentum"] = args.mom
+    optimizer_params["rescale_grad"] = 1.0 / args.batch_size
+    sched = _lr_scheduler(args, epoch_size)
+    if sched is not None:
+        optimizer_params["lr_scheduler"] = sched
+
+    eval_metrics = [mx.metric.Accuracy()]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.TopKAccuracy(top_k=args.top_k))
+
+    callbacks = [mx.callback.Speedometer(args.batch_size,
+                                         args.disp_batches)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+
+    mod.fit(train_iter,
+            eval_data=val_iter,
+            eval_metric=mx.metric.CompositeEvalMetric(eval_metrics)
+            if len(eval_metrics) > 1 else eval_metrics[0],
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            kvstore=kv,
+            batch_end_callback=callbacks,
+            epoch_end_callback=epoch_cbs,
+            **kwargs)
+    return mod

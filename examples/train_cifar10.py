@@ -1,0 +1,148 @@
+"""CIFAR-10 training through the canonical recipe
+(reference ``example/image-classification/train_cifar10.py``† over
+``common/fit.py``†).
+
+Reads CIFAR-10 python-pickle batches under --data-dir when present,
+else synthesizes CIFAR-shaped data (no network access here).
+
+  python examples/train_cifar10.py --num-epochs 2 --network cifar_cnn
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxtpu as mx
+from common_fit import add_fit_args, fit
+from mxtpu.io import NDArrayIter
+
+
+def residual_unit(data, num_filter, stride, dim_match, name):
+    """Symbol-level ResNet v2 unit (reference
+    ``symbols/resnet.py``† residual_unit)."""
+    bn1 = mx.sym.BatchNorm(data, fix_gamma=False, name=name + "_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu")
+    conv1 = mx.sym.Convolution(act1, num_filter=num_filter,
+                               kernel=(3, 3), stride=(stride, stride),
+                               pad=(1, 1), no_bias=True,
+                               name=name + "_conv1")
+    bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, name=name + "_bn2")
+    act2 = mx.sym.Activation(bn2, act_type="relu")
+    conv2 = mx.sym.Convolution(act2, num_filter=num_filter,
+                               kernel=(3, 3), stride=(1, 1),
+                               pad=(1, 1), no_bias=True,
+                               name=name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(act1, num_filter=num_filter,
+                                      kernel=(1, 1),
+                                      stride=(stride, stride),
+                                      no_bias=True, name=name + "_sc")
+    return conv2 + shortcut
+
+
+def resnet_cifar(num_classes=10, num_layers=8):
+    """resnet-(6n+2) for 32x32 inputs (reference cifar resnet)."""
+    assert (num_layers - 2) % 6 == 0
+    n = (num_layers - 2) // 6
+    data = mx.sym.Variable("data")
+    body = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                              stride=(1, 1), pad=(1, 1), no_bias=True,
+                              name="conv0")
+    for stage, filters in enumerate((16, 32, 64)):
+        for unit in range(n):
+            stride = 2 if stage > 0 and unit == 0 else 1
+            body = residual_unit(body, filters, stride,
+                                 dim_match=(stage == 0 or unit > 0),
+                                 name=f"stage{stage}_unit{unit}")
+    bn = mx.sym.BatchNorm(body, fix_gamma=False, name="bn_final")
+    act = mx.sym.Activation(bn, act_type="relu")
+    pool = mx.sym.Pooling(act, global_pool=True, pool_type="avg",
+                          kernel=(8, 8))
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def cifar_cnn(num_classes=10):
+    """Small convnet for smoke runs."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=32, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=64, kernel=(3, 3),
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def load_cifar(data_dir, batch_size, n_synth=2048):
+    import pickle
+    train_files = [os.path.join(data_dir, f"data_batch_{i}")
+                   for i in range(1, 6)]
+    if all(os.path.exists(f) for f in train_files):
+        xs, ys = [], []
+        for f in train_files:
+            with open(f, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.float32)
+                      .reshape(-1, 3, 32, 32) / 255.0)
+            ys.append(np.asarray(d[b"labels"], np.float32))
+        X = np.concatenate(xs)
+        y = np.concatenate(ys)
+    else:
+        logging.warning("CIFAR-10 batches not found under %s — "
+                        "synthetic data", data_dir)
+        rng = np.random.RandomState(0)
+        X = rng.rand(n_synth, 3, 32, 32).astype(np.float32)
+        # learnable synthetic signal: class shifts channel 0 brightness
+        y = rng.randint(0, 2, n_synth).astype(np.float32)
+        X[:, 0] += y[:, None, None] * 0.3
+    split = int(0.9 * len(X))
+    train = NDArrayIter(X[:split], y[:split], batch_size=batch_size,
+                        shuffle=True, last_batch_handle="discard")
+    val = NDArrayIter(X[split:], y[split:], batch_size=batch_size,
+                      last_batch_handle="discard")
+    return train, val
+
+
+NETWORKS = {"cifar_cnn": cifar_cnn,
+            "resnet8": lambda num_classes: resnet_cifar(num_classes, 8),
+            "resnet20": lambda num_classes: resnet_cifar(num_classes,
+                                                         20)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", default=os.path.expanduser(
+        "~/.mxnet/datasets/cifar10/cifar-10-batches-py"))
+    add_fit_args(parser)
+    parser.set_defaults(network="cifar_cnn", num_classes=10,
+                        num_epochs=3, batch_size=128, lr=0.01)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    net_fn = NETWORKS.get(args.network)
+    if net_fn is None:
+        sys.exit(f"unknown --network {args.network}; "
+                 f"choices {sorted(NETWORKS)}")
+    sym = net_fn(num_classes=args.num_classes)
+    train, val = load_cifar(args.data_dir, args.batch_size)
+    fit(args, sym, train, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -46,25 +46,8 @@ class Predictor:
                  input_shapes: Dict[str, Tuple[int, ...]]):
         symbol = sym_mod.load_json(symbol_json)
         params = _params_from_bytes(param_blob)
-        ctx = cpu(dev_id) if dev_type == 1 else gpu(dev_id)
-        self._input_names = list(input_shapes)
-        args = {k: nd.array(v) for k, v in params.items()}
-        for name, shape in input_shapes.items():
-            args[name] = nd.zeros(tuple(int(s) for s in shape))
-        known = set(symbol.list_inputs())
-        args = {k: v for k, v in args.items() if k in known}
-        missing = known - set(args)
-        if missing:
-            raise MXNetError(
-                f"c_predict: inputs/params missing for {sorted(missing)}")
-        self._executor = symbol.bind(ctx, args=args, grad_req="null")
-        self._outputs: List[NDArray] = []
-        # output shapes are known at bind time (reference: available
-        # right after MXPredCreate, before any forward)
-        _, out_shapes, _ = symbol.infer_shape(
-            **{k: tuple(v.shape) for k, v in args.items()})
-        self._out_shapes = [tuple(int(d) for d in s)
-                            for s in out_shapes]
+        self._init_from_parts(symbol, params, dev_type, dev_id,
+                              input_shapes)
 
     # -- ABI surface ----------------------------------------------------
     def set_input(self, key: str, data: bytes) -> None:
@@ -103,6 +86,47 @@ class Predictor:
                              f"range ({len(self._outputs)} outputs)")
         return self._outputs[index].asnumpy() \
             .astype(np.float32).tobytes()
+
+
+    def reshape(self, input_shapes: Dict[str, Tuple[int, ...]]
+                ) -> "Predictor":
+        """New predictor for different input shapes sharing this one's
+        weights (``MXPredReshape``†).  With XLA there is no memory pool
+        to re-plan: a rebind (compile-cache hit per shape) is the whole
+        story."""
+        symbol, params, dev_type, dev_id = self._parts
+        clone = Predictor.__new__(Predictor)
+        clone._init_from_parts(symbol, params, dev_type, dev_id,
+                               {k: tuple(int(d) for d in v)
+                                for k, v in input_shapes.items()})
+        return clone
+
+    def _init_from_parts(self, symbol, params,
+                         dev_type, dev_id, input_shapes):
+        # params may be host numpy (first create) or NDArray (reshape
+        # clones): device buffers upload once and are SHARED across
+        # reshapes — the reference MXPredReshape's zero-copy contract
+        params = {k: v if isinstance(v, NDArray) else nd.array(v)
+                  for k, v in params.items()}
+        self._parts = (symbol, params, dev_type, dev_id)
+        ctx = cpu(dev_id) if dev_type == 1 else gpu(dev_id)
+        self._input_names = list(input_shapes)
+        args = dict(params)
+        for name, shape in input_shapes.items():
+            args[name] = nd.zeros(tuple(int(s) for s in shape))
+        known = set(symbol.list_inputs())
+        args = {k: v for k, v in args.items() if k in known}
+        missing = known - set(args)
+        if missing:
+            raise MXNetError(
+                f"c_predict: inputs/params missing for "
+                f"{sorted(missing)}")
+        self._executor = symbol.bind(ctx, args=args, grad_req="null")
+        self._outputs = []
+        _, out_shapes, _ = symbol.infer_shape(
+            **{k: tuple(v.shape) for k, v in args.items()})
+        self._out_shapes = [tuple(int(d) for d in s)
+                            for s in out_shapes]
 
 
 def _create(symbol_json: str, param_blob: bytes, dev_type: int,

@@ -1,19 +1,31 @@
 """DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``†).
 
-TPU-native divergence from the reference: the reference forks
-multiprocessing workers that write batches into POSIX-shm NDArrays
-(``cpu_shared_storage_manager.h``†).  Forking a process that holds a
-live TPU/PjRt client is unsafe (and jax state is not fork-inheritable),
-so ``num_workers > 0`` here means a **thread pool** — batchify runs in
-numpy (releasing the GIL for decode/copy) and the device transfer stays
-on the consumer thread.  The C++ pipeline in ``core/`` supplies true
-parallel decode underneath when built.
+Worker model: the reference forks multiprocessing workers that write
+batches into POSIX-shm NDArrays (``cpu_shared_storage_manager.h``†).
+Forking a process that holds a live TPU/PjRt client is unsafe (and jax
+state is not fork-inheritable), so two worker types exist here:
+
+- ``worker_type='thread'`` (default): a thread pool — batchify runs in
+  numpy (cv2/numpy release the GIL for decode/copy) and the device
+  transfer stays on the consumer thread.
+- ``worker_type='process'``: SPAWNED process workers for pure-python
+  transforms that would serialize on the GIL.  Workers never touch
+  jax (children force ``JAX_PLATFORMS=cpu`` defensively); the dataset
+  is pickled once to each worker and batches come back as numpy,
+  converted to NDArray on the consumer.  Datasets/transforms must be
+  picklable and numpy-level (NDArray-returning datasets need the
+  thread mode).
+
+The C++ pipeline in ``core/`` supplies true parallel decode underneath
+when built.
 """
 from __future__ import annotations
 
+import os
+import pickle
 import queue as _queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, Optional
 
 import numpy as np
@@ -24,6 +36,30 @@ from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+# -- process-worker plumbing (module-level: must be picklable) ---------
+_WORKER_DATASET = None
+
+
+def _proc_worker_init(dataset_blob: bytes) -> None:
+    global _WORKER_DATASET
+    # never let a child spin up a TPU client
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _WORKER_DATASET = pickle.loads(dataset_blob)
+
+
+def _np_batchify(samples):
+    """Numpy-only batchify for process workers (NDArray construction
+    happens on the consumer side)."""
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(_np_batchify([s[i] for s in samples])
+                     for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+def _proc_worker_load(indices):
+    return _np_batchify([_WORKER_DATASET[i] for i in indices])
 
 
 def default_batchify_fn(data):
@@ -45,8 +81,17 @@ class DataLoader:
                  last_batch: Optional[str] = None,
                  batch_sampler: Optional[BatchSampler] = None,
                  batchify_fn: Optional[Callable] = None,
-                 num_workers: int = 0, prefetch: Optional[int] = None):
+                 num_workers: int = 0, prefetch: Optional[int] = None,
+                 worker_type: str = "thread"):
         self._dataset = dataset
+        if worker_type not in ("thread", "process"):
+            raise MXNetError(f"worker_type {worker_type!r}: choose "
+                             f"'thread' or 'process'")
+        self._worker_type = worker_type
+        if worker_type == "process" and batchify_fn is not None:
+            raise MXNetError("custom batchify_fn runs on the consumer "
+                             "only in thread mode; process workers use "
+                             "the numpy batchifier")
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("need batch_size unless batch_sampler "
@@ -67,6 +112,8 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        self._proc_pool = None
+        self._thread_pool = None
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -74,31 +121,73 @@ class DataLoader:
     def _load_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    @staticmethod
+    def _to_nd(batch):
+        if isinstance(batch, tuple):
+            return tuple(DataLoader._to_nd(b) for b in batch)
+        return array(batch)
+
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
             return
 
-        # Thread-pool pipeline with bounded in-flight futures — the
-        # prefetcher's double buffering generalized.
-        with ThreadPoolExecutor(self._num_workers) as pool:
-            batches = iter(self._batch_sampler)
-            inflight: _queue.Queue = _queue.Queue()
-            depth = max(1, self._prefetch)
+        if self._worker_type == "process":
+            # persistent workers (the reference's worker pool outlives
+            # epochs): spawn + dataset pickle happen ONCE, not per
+            # __iter__
+            if self._proc_pool is None:
+                import multiprocessing as mp
+                blob = pickle.dumps(self._dataset)
+                self._proc_pool = ProcessPoolExecutor(
+                    self._num_workers,
+                    mp_context=mp.get_context("spawn"),
+                    initializer=_proc_worker_init, initargs=(blob,))
+            pool = self._proc_pool
+            load = _proc_worker_load
+            wrap = self._to_nd
+        else:
+            if self._thread_pool is None:
+                self._thread_pool = ThreadPoolExecutor(
+                    self._num_workers)
+            pool = self._thread_pool
+            load = self._load_batch
+            wrap = lambda b: b  # noqa: E731
 
-            def submit_next():
-                try:
-                    indices = next(batches)
-                except StopIteration:
-                    return False
-                inflight.put(pool.submit(self._load_batch, indices))
-                return True
+        # Bounded in-flight futures — the prefetcher's double
+        # buffering generalized.
+        batches = iter(self._batch_sampler)
+        inflight: _queue.Queue = _queue.Queue()
+        depth = max(1, self._prefetch)
 
-            for _ in range(depth):
-                if not submit_next():
-                    break
-            while not inflight.empty():
-                fut = inflight.get()
-                submit_next()
-                yield fut.result()
+        def submit_next():
+            try:
+                indices = next(batches)
+            except StopIteration:
+                return False
+            inflight.put(pool.submit(load, list(indices)))
+            return True
+
+        for _ in range(depth):
+            if not submit_next():
+                break
+        while not inflight.empty():
+            fut = inflight.get()
+            submit_next()
+            yield wrap(fut.result())
+
+    def close(self) -> None:
+        """Shut the persistent worker pools down."""
+        if self._proc_pool is not None:
+            self._proc_pool.shutdown(wait=False)
+            self._proc_pool = None
+        if self._thread_pool is not None:
+            self._thread_pool.shutdown(wait=False)
+            self._thread_pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

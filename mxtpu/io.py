@@ -454,6 +454,11 @@ class ImageRecordIter(DataIter):
         self.label_width = label_width
         self.shuffle = shuffle
         self._rng = np.random.RandomState(seed)
+        # decode pool: cv2.imdecode/resize release the GIL, so N
+        # threads give ~N× decode throughput (the role of the
+        # reference's N decode threads in iter_image_recordio_2.cc†)
+        self._threads = max(1, int(preprocess_threads))
+        self._pool = None
         if path_imgidx and os.path.exists(path_imgidx):
             self._rec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec,
                                               "r")
@@ -495,19 +500,35 @@ class ImageRecordIter(DataIter):
             return raw
         return self._rec.read()
 
-    def _decode_one(self, raw: bytes):
+    def close(self) -> None:
+        """Release the decode pool (also runs at GC — the reference
+        iterator had no explicit close either)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _decode_one(self, raw: bytes, aug_u=(0.0, 0.0, 0.0)):
+        """``aug_u``: three pre-drawn uniforms (crop-y, crop-x, mirror)
+        — drawn serially on the consumer thread so seeded runs are
+        reproducible regardless of decode-pool scheduling."""
         from . import recordio as rio
         header, img = rio.unpack_img(raw, iscolor=1)
         c, h, w = self.data_shape
         ih, iw = img.shape[:2]
         if self.rand_crop and ih >= h and iw >= w:
-            y0 = self._rng.randint(0, ih - h + 1)
-            x0 = self._rng.randint(0, iw - w + 1)
+            y0 = int(aug_u[0] * (ih - h + 1))
+            x0 = int(aug_u[1] * (iw - w + 1))
             img = img[y0:y0 + h, x0:x0 + w]
         elif (ih, iw) != (h, w):
             import cv2
             img = cv2.resize(img, (w, h))
-        if self.rand_mirror and self._rng.rand() < 0.5:
+        if self.rand_mirror and aug_u[2] < 0.5:
             img = img[:, ::-1]
         img = img[:, :, ::-1].astype(np.float32)  # BGR→RGB
         # reference order (iter_image_recordio_2.cc†): mean subtraction
@@ -524,15 +545,30 @@ class ImageRecordIter(DataIter):
         c, h, w = self.data_shape
         data = np.zeros((self.batch_size, c, h, w), np.float32)
         labels = np.zeros((self.batch_size, self.label_width), np.float32)
-        n = 0
-        while n < self.batch_size:
+        raws = []
+        while len(raws) < self.batch_size:
             raw = self._read_raw()
             if raw is None:
                 break
-            img, label = self._decode_one(raw)
-            data[n] = img
-            labels[n] = label
-            n += 1
+            raws.append(raw)
+        n = len(raws)
+        # augmentation uniforms drawn serially from the seeded stream:
+        # identical seeds give identical augmentations no matter how
+        # the decode pool schedules
+        aug = self._rng.rand(n, 3) if n else None
+        if n and self._threads > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(self._threads)
+            for i, (img, label) in enumerate(
+                    self._pool.map(self._decode_one, raws, aug)):
+                data[i] = img
+                labels[i] = label
+        else:
+            for i, raw in enumerate(raws):
+                img, label = self._decode_one(raw, aug[i])
+                data[i] = img
+                labels[i] = label
         if n == 0:
             self._exhausted = True
             raise StopIteration

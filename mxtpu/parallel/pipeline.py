@@ -103,6 +103,22 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x: jax.Array,
         in_specs=(p_specs, x_spec, P()),
         out_specs=out_spec, check_vma=False)
     key_data = key if key is not None else jnp.zeros((), jnp.uint32)
+    from . import _device_put_global, _mesh_is_multiprocess
+    if _mesh_is_multiprocess(mesh):
+        # cross-process mesh: place host values as global arrays per
+        # spec (every process passes the same full value)
+        stage_params = jax.tree_util.tree_map(
+            lambda leaf, s: _device_put_global(leaf, mesh, s),
+            stage_params, p_specs)
+        x_mb = _device_put_global(x_mb, mesh, x_spec)
+        key_data = _device_put_global(key_data, mesh, P())
+        y_mb = fn(stage_params, x_mb, key_data)
+        # reshape stays in-graph: eager ops on non-addressable global
+        # arrays are rejected by jax.  Output replicated (the merged
+        # batch axis has no single-axis sharding after the collapse).
+        return jax.jit(
+            lambda a: a.reshape((B,) + a.shape[2:]),
+            out_shardings=jax.NamedSharding(mesh, P()))(y_mb)
     y_mb = fn(stage_params, x_mb, key_data)
     return y_mb.reshape((B,) + y_mb.shape[2:])
 

@@ -100,6 +100,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         return (acc / safe).astype(q_loc.dtype)
 
     spec = P(None, None, axis, None)
+    from . import _device_put_global, _mesh_is_multiprocess
+    if _mesh_is_multiprocess(mesh):
+        # cross-process mesh: host inputs must be placed as global
+        # arrays (every process passes the same full value; jit cannot
+        # implicitly device_put onto non-addressable shardings)
+        q, k, v = (_device_put_global(a, mesh, spec)
+                   for a in (q, k, v))
     fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

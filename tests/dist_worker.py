@@ -90,8 +90,61 @@ def main():
     assert np.allclose(np.asarray(all_last), losses[-1], rtol=1e-6), \
         all_last
 
+    # 5. ring attention (sequence parallelism) ACROSS PROCESSES: the
+    #    ppermute ring rides the cross-process transport; result must
+    #    match the local single-device reference (VERDICT r2 item 7 —
+    #    the dryrun only proves single-process virtual devices)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from mxtpu.kernels.flash_attention import attention_reference
+    from mxtpu.parallel.ring_attention import ring_attention
+    n_dev = len(jax.devices())
+    sp_mesh = parallel.make_mesh({"sp": n_dev}, devices=jax.devices())
+    B, H, T, D = 1, 2, 8 * n_dev, 8
+    rng2 = np.random.RandomState(7)  # same tensors on every rank
+    q = rng2.randn(B, H, T, D).astype(np.float32) * 0.4
+    k = rng2.randn(B, H, T, D).astype(np.float32) * 0.4
+    v = rng2.randn(B, H, T, D).astype(np.float32)
+    ring = ring_attention(q, k, v, sp_mesh, causal=True)
+    ring_full = np.asarray(multihost_utils.process_allgather(
+        ring, tiled=True)).reshape(B, H, T, D)
+    ref = np.asarray(attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    np.testing.assert_allclose(ring_full, ref, rtol=1e-4, atol=1e-4)
+
+    # 6. GPipe pipeline ACROSS PROCESSES: stage-to-stage ppermute over
+    #    the process boundary; parity with sequential layer application
+    from mxtpu.parallel.pipeline import (spmd_pipeline,
+                                         stack_stage_params)
+    pp_mesh = parallel.make_mesh({"pp": n_dev}, devices=jax.devices())
+    L, C, Bp = 2 * n_dev, 8, 4
+    ws = [rng2.randn(C, C).astype(np.float32) * 0.3 for _ in range(L)]
+    bs = [rng2.randn(C).astype(np.float32) * 0.1 for _ in range(L)]
+
+    def stage_fn(params_loc, h):
+        def layer(carry, lp):
+            w, b = lp
+            return carry + jnp.tanh(carry @ w + b), None
+        h, _ = jax.lax.scan(layer, h, tuple(params_loc))
+        return h
+
+    xp = rng2.randn(Bp, C).astype(np.float32)
+    got = spmd_pipeline(
+        stage_fn,
+        stack_stage_params([[jnp.asarray(w), jnp.asarray(b)]
+                            for w, b in zip(ws, bs)]),
+        xp, mesh=pp_mesh, axis="pp", n_microbatches=2)
+    got_full = np.asarray(multihost_utils.process_allgather(
+        got, tiled=True)).reshape(Bp, C)
+    want = xp
+    for w, b in zip(ws, bs):
+        want = want + np.tanh(want @ w + b)
+    np.testing.assert_allclose(got_full, want, rtol=1e-4, atol=1e-4)
+
     with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
-        f.write(f"rank {rank}/{n} passed; spmd losses {losses}\n")
+        f.write(f"rank {rank}/{n} passed; spmd losses {losses}; "
+                f"ring sp{n_dev} ok; pipeline pp{n_dev} ok\n")
 
 
 if __name__ == "__main__":

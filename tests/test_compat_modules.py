@@ -171,3 +171,92 @@ def test_bucket_sentence_iter_with_bucketing_module():
         mod.update()
         if i >= 5:
             break
+
+
+def test_sequential_module_trains():
+    """SequentialModule chains two Modules; outputs of the feature
+    module feed the classifier (reference sequential_module.py†)."""
+    import mxtpu as mx
+    from mxtpu.io import NDArrayIter
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=32)
+
+    feat_sym = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                              name="feat_fc"), act_type="relu")
+    cls_in = mx.sym.Variable("feat")
+    cls_sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(cls_in, num_hidden=2, name="cls_fc"),
+        name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat_sym, data_names=["data"],
+                          label_names=[]))
+    seq.add(mx.mod.Module(cls_sym, data_names=["feat"],
+                          label_names=["softmax_label"]),
+            take_labels=True)
+    seq.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    seq.init_params(initializer=mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "rescale_grad": 1.0 / 32})
+    metric = mx.metric.Accuracy()
+    for _ in range(12):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    it.reset()
+    metric.reset()
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+def test_python_loss_module_chain():
+    """PythonLossModule closes a SequentialModule with a hand-written
+    gradient (reference python_module.py†)."""
+    import mxtpu as mx
+    from mxtpu.io import NDArrayIter
+    rng = np.random.RandomState(1)
+    X = rng.randn(128, 4).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=32)
+
+    body = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                 num_hidden=2, name="fc")
+
+    def softmax_grad(scores, labels):
+        s = scores.asnumpy()
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        lab = labels.asnumpy().astype(int)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return p / len(lab)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(body, data_names=["data"], label_names=[]))
+    seq.add(mx.mod.PythonLossModule(grad_func=softmax_grad),
+            take_labels=True)
+    seq.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    seq.init_params(initializer=mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(8):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+    metric = mx.metric.Accuracy()
+    it.reset()
+    for batch in it:
+        seq.forward(batch, is_train=False)
+        seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.85, metric.get()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Kill stray distributed workers (reference ``tools/kill-mxnet.py``†):
+after a crashed multi-process run, orphaned workers can hold the
+coordinator port.  Matches processes whose command line contains the
+given pattern (default: dist_worker / launch.py children).
+
+  python tools/kill-mxnet.py [pattern]
+"""
+import os
+import signal
+import sys
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else "launch.py"
+    me = os.getpid()
+    killed = []
+    for pid in filter(str.isdigit, os.listdir("/proc")):
+        if int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace")
+        except OSError:
+            continue
+        if pattern in cmd and "kill-mxnet" not in cmd:
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+                killed.append((pid, cmd[:80]))
+            except OSError:
+                pass
+    for pid, cmd in killed:
+        print(f"killed {pid}: {cmd}")
+    if not killed:
+        print(f"no processes matching {pattern!r}")
+
+
+if __name__ == "__main__":
+    main()

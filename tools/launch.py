@@ -32,8 +32,12 @@ def main():
     p.add_argument("--coordinator", default="127.0.0.1:49375",
                    help="coordinator address host:port")
     p.add_argument("--host-rank", type=int, default=None)
-    p.add_argument("--launcher", choices=("local", "env"),
+    p.add_argument("--launcher", choices=("local", "env", "ssh"),
                    default="env")
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="one host per line (ssh launcher); rank = "
+                        "line order, coordinator = first host")
+    p.add_argument("--ssh-user", default=None)
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
     if not args.command:
@@ -60,10 +64,50 @@ def main():
             rc |= proc.wait()
         sys.exit(rc)
 
+    if args.launcher == "ssh":
+        # dmlc_tracker's ssh launcher†, SPMD-shaped: ssh to every host
+        # in the hostfile, export the coordination env, run the SAME
+        # command; rank = hostfile order, coordinator = host 0
+        if not args.hostfile:
+            p.error("--hostfile required with --launcher ssh")
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f
+                     if h.strip() and not h.strip().startswith("#")]
+        if len(hosts) < args.num_processes:
+            p.error(f"hostfile has {len(hosts)} hosts, need "
+                    f"{args.num_processes}")
+        hosts = hosts[:args.num_processes]
+        coord = args.coordinator
+        if coord.startswith("127.0.0.1"):
+            coord = hosts[0] + ":" + coord.split(":")[1]
+        import shlex
+        procs = []
+        for rank, host in enumerate(hosts):
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in (
+                    ("JAX_COORDINATOR_ADDRESS", coord),
+                    ("JAX_NUM_PROCESSES", str(args.num_processes)),
+                    ("JAX_PROCESS_ID", str(rank)),
+                    ("MXTPU_COORDINATOR", coord),
+                    ("MXTPU_NUM_PROCESSES", str(args.num_processes)),
+                    ("MXTPU_PROCESS_ID", str(rank))))
+            remote = f"cd {shlex.quote(os.getcwd())} && env " \
+                f"{exports} " + " ".join(
+                    shlex.quote(c) for c in args.command)
+            target = host if args.ssh_user is None else \
+                f"{args.ssh_user}@{host}"
+            procs.append(subprocess.Popen(
+                ["ssh", "-o", "StrictHostKeyChecking=no", target,
+                 remote]))
+        rc = 0
+        for proc in procs:
+            rc |= proc.wait()
+        sys.exit(rc)
+
     rank = args.host_rank
     if rank is None:
         p.error("--host-rank required with --launcher env (or use "
-                "--launcher local)")
+                "--launcher local / ssh)")
     base_env["JAX_PROCESS_ID"] = str(rank)
     base_env["MXTPU_PROCESS_ID"] = str(rank)
     os.execvpe(args.command[0], args.command, base_env)
