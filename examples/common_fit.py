@@ -43,6 +43,12 @@ def add_fit_args(parser: argparse.ArgumentParser):
     train.add_argument("--dtype", type=str, default="float32",
                        choices=("float32", "bfloat16"))
     train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--num-examples", type=int, default=None,
+                       help="dataset size; sets the updates-per-epoch "
+                            "the lr schedule counts (reference "
+                            "common/fit.py flag).  Defaults to "
+                            "len(train_iter) when the iterator "
+                            "knows it")
     return train
 
 
@@ -65,8 +71,17 @@ def fit(args, network, train_iter, val_iter=None, **kwargs):
     logging.basicConfig(level=logging.INFO)
     kv = mx.kvstore.create(args.kv_store)
 
-    epoch_size = max(len(train_iter) if hasattr(train_iter, "__len__")
-                     else 0, 1)
+    if getattr(args, "num_examples", None):
+        epoch_size = max(args.num_examples // args.batch_size, 1)
+    elif hasattr(train_iter, "__len__"):
+        epoch_size = max(len(train_iter), 1)
+    else:
+        epoch_size = None  # schedule in epochs impossible — see below
+    if epoch_size is None and args.lr_step_epochs:
+        raise SystemExit(
+            "--lr-step-epochs needs the epoch size: pass "
+            "--num-examples or use an iterator with __len__")
+    epoch_size = epoch_size or 1
     arg_params = aux_params = None
     if args.model_prefix and args.load_epoch is not None:
         _, arg_params, aux_params = mx.model.load_checkpoint(

@@ -1,0 +1,116 @@
+"""Policy-gradient RL recipe (the reference ships DQN/A3C under
+``example/reinforcement-learning/``†; no game emulator exists in this
+environment, so the environment is a built-in numpy gridworld — the
+recipe shape is what carries over: rollout → returns → REINFORCE
+update through autograd).
+
+  python examples/reinforce.py --episodes 150
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn
+
+
+class GridWorld:
+    """5x5 grid; start random, goal fixed; actions URDL; reward 1 at
+    the goal, -0.01 per step; episode cap 20 steps."""
+
+    SIZE = 5
+    GOAL = (4, 4)
+    MOVES = ((-1, 0), (0, 1), (1, 0), (0, -1))
+
+    def __init__(self, rng):
+        self.rng = rng
+
+    def reset(self):
+        self.pos = (int(self.rng.randint(self.SIZE)),
+                    int(self.rng.randint(self.SIZE)))
+        self.t = 0
+        return self._obs()
+
+    def _obs(self):
+        o = np.zeros((self.SIZE, self.SIZE), np.float32)
+        o[self.pos] = 1.0
+        o[self.GOAL] += 0.5
+        return o.ravel()
+
+    def step(self, action):
+        dy, dx = self.MOVES[action]
+        y = min(max(self.pos[0] + dy, 0), self.SIZE - 1)
+        x = min(max(self.pos[1] + dx, 0), self.SIZE - 1)
+        self.pos = (y, x)
+        self.t += 1
+        done = self.pos == self.GOAL or self.t >= 20
+        reward = 1.0 if self.pos == self.GOAL else -0.01
+        return self._obs(), reward, done
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    env = GridWorld(rng)
+
+    policy = nn.Sequential()
+    policy.add(nn.Dense(64, activation="relu"), nn.Dense(4))
+    policy.initialize(init="xavier")
+    policy(nd.array(np.zeros((1, 25), np.float32)))
+    trainer = gluon.Trainer(policy.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    recent = []
+    for ep in range(args.episodes):
+        obs = env.reset()
+        states, actions, rewards = [], [], []
+        done = False
+        while not done:
+            logits = policy(nd.array(obs[None])).asnumpy()[0]
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = int(rng.choice(4, p=p))
+            states.append(obs)
+            actions.append(a)
+            obs, r, done = env.step(a)
+            rewards.append(r)
+        # discounted returns, normalized (the standard REINFORCE
+        # baseline-free recipe)
+        G = np.zeros(len(rewards), np.float32)
+        acc = 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            acc = rewards[t] + args.gamma * acc
+            G[t] = acc
+        if len(G) > 1:
+            G = (G - G.mean()) / (G.std() + 1e-6)
+        with autograd.record():
+            logits = policy(nd.array(np.stack(states)))
+            logp = nd.log_softmax(logits, axis=-1)
+            chosen = nd.pick(logp, nd.array(
+                np.asarray(actions, np.float32)), axis=-1)
+            loss = -nd.mean(chosen * nd.array(G))
+        loss.backward()
+        trainer.step(batch_size=len(states))
+        recent.append(sum(rewards))
+        if (ep + 1) % 25 == 0:
+            logging.info("episode %d: avg return %.3f", ep + 1,
+                         float(np.mean(recent[-25:])))
+
+    avg = float(np.mean(recent[-25:]))
+    logging.info("final avg return over last 25 episodes: %.3f", avg)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,8 +27,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter"]
+           "ResizeIter", "PrefetchingIter", "CSVIter", "LibSVMIter",
+           "MNISTIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -181,6 +181,12 @@ class NDArrayIter(DataIter):
             self._rollover_remainder = None
         self._order = order
         self.cursor = 0
+
+    def __len__(self):
+        n = self.num_data
+        if self.last_batch_handle == "discard":
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
 
     def iter_next(self) -> bool:
         n = len(self._order)
@@ -356,6 +362,96 @@ class CSVIter(DataIter):
         self._inner = NDArrayIter(
             {"data": self._data}, {"label": label}, batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class LibSVMIter(DataIter):
+    """libsvm-format iterator (reference C++ ``LibSVMIter``,
+    ``src/io/iter_libsvm.cc``†).
+
+    Line format: ``label [qid:n] idx:val idx:val ...``.  Feature
+    indices are ZERO-based like the reference's LibSVMIter† (set
+    ``indexing='one'`` for conventional 1-based files — never guessed
+    silently).  Multi-dimensional labels come from a SECOND libsvm
+    file via ``label_libsvm`` (the reference's mechanism).
+    DIVERGENCE (SURVEY §7 hard-part 3): the reference yields CSR
+    batches; the TPU build densifies into ``(batch, *data_shape)`` —
+    same API, dense storage, documented in COVERAGE.md."""
+
+    def __init__(self, data_libsvm: str, data_shape, label_shape=(1,),
+                 label_libsvm=None, batch_size=1, round_batch=True,
+                 indexing="zero", **_ignored):
+        super().__init__(batch_size)
+        if indexing not in ("zero", "one"):
+            raise MXNetError("indexing must be 'zero' or 'one'")
+        off = 1 if indexing == "one" else 0
+        data, labels = self._parse(data_libsvm,
+                                   int(np.prod(data_shape)), off)
+        if label_libsvm is not None:
+            lab, _ = self._parse(label_libsvm,
+                                 int(np.prod(label_shape)), off)
+            lab = lab.reshape((-1,) + tuple(label_shape))
+            if len(lab) != len(data):
+                raise MXNetError(
+                    f"label file has {len(lab)} rows, data has "
+                    f"{len(data)}")
+        elif tuple(label_shape) not in ((1,), ()):
+            raise MXNetError(
+                f"label_shape {tuple(label_shape)} needs label_libsvm "
+                f"(the inline label is a single float per line)")
+        else:
+            lab = np.asarray(labels, np.float32).reshape(-1, 1)
+        self._inner = NDArrayIter(
+            {"data": data.reshape((-1,) + tuple(data_shape))},
+            {"label": lab},
+            batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @staticmethod
+    def _parse(path, dim, off):
+        rows = []
+        labels = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0]))
+                feats = []
+                for tok in parts[1:]:
+                    if tok.startswith("qid:"):
+                        continue
+                    idx, val = tok.split(":")
+                    feats.append((int(idx) - off, float(val)))
+                rows.append(feats)
+        data = np.zeros((len(rows), dim), np.float32)
+        for r, feats in enumerate(rows):
+            for j, v in feats:
+                if not 0 <= j < dim:
+                    raise MXNetError(
+                        f"libsvm feature index {j + off} out of range "
+                        f"for dim {dim} (indexing="
+                        f"{'one' if off else 'zero'} — wrong "
+                        f"`indexing=`?)")
+                data[r, j] = v
+        return data, labels
 
     @property
     def provide_data(self):

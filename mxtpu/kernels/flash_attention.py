@@ -41,11 +41,18 @@ _NEG_INF = -1e30
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Pure-lax attention — fallback path and parity oracle.
-    q: (B, H, Tq, D); k, v: (B, H, Tk, D)."""
+    q: (B, H, Tq, D); k, v: (B, H, Tk, D).
+
+    f32 inputs run the MXU at HIGHEST precision (the same discipline as
+    the Pallas kernel's _precision_for): on TPU the jax default feeds
+    bf16 multiplicands, which would make the oracle ~3 decimal digits
+    loose and the production f32 fallback silently half-precision."""
     D = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    prec = _precision_for(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32,
+                   precision=prec) * scale
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
         row = jnp.arange(Tq)[:, None] + (Tk - Tq)
@@ -59,7 +66,8 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
         Tq, Tk = s.shape[-2], s.shape[-1]
         visible = (jnp.arange(Tq) + (Tk - Tq)) >= 0
         p = p * visible[:, None].astype(p.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      precision=_precision_for(v.dtype))
 
 
 def _block(n: int, prefer: int) -> int:

@@ -110,6 +110,10 @@ class SequentialModule(BaseModule):
     def init_params(self, initializer="uniform", arg_params=None,
                     aux_params=None, allow_missing=False,
                     force_init=False, **kwargs):
+        # each child owns only a SUBSET of arg_params, so children run
+        # with allow_missing=True; the caller's allow_missing contract
+        # is enforced globally below (a typo'd checkpoint key must not
+        # silently fresh-initialize)
         for mod in self._modules:
             mod.init_params(initializer=initializer,
                             arg_params=arg_params,
@@ -117,6 +121,14 @@ class SequentialModule(BaseModule):
                             allow_missing=True,
                             force_init=force_init)
         self.params_initialized = True
+        if not allow_missing and arg_params:
+            arg, aux = self.get_params()
+            known = set(arg) | set(aux)
+            unknown = [k for k in arg_params if k not in known]
+            if unknown:
+                raise MXNetError(
+                    f"arg_params keys {sorted(unknown)} match no "
+                    f"module parameter (allow_missing=False)")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):

@@ -274,9 +274,24 @@ def fft(data, compute_size=128):
 
 
 def _ifft_raw(d, compute_size=128):
+    """Real-matmul IDFT: N*ifft(x)_n = sum_k a_k cos(2pi kn/N)
+    - b_k sin(2pi kn/N) for x = a + bi.  Complex arithmetic is
+    unimplemented on some experimental TPU backends (axon) — and one
+    unimplemented op poisons the whole client — while a (N, N)
+    cos/sin matmul rides the MXU; the op's contract (contrib.ifft†,
+    compute_size~128) keeps N small."""
     c = d.reshape(d.shape[:-1] + (d.shape[-1] // 2, 2))
-    comp = c[..., 0] + 1j * c[..., 1]
-    out = jnp.fft.ifft(comp, axis=-1).real * comp.shape[-1]
+    a = c[..., 0]
+    b = c[..., 1]
+    n = a.shape[-1]
+    k = np.arange(n)
+    ang = 2.0 * np.pi * np.outer(k, k) / n
+    cos_t = jnp.asarray(np.cos(ang), jnp.float32)
+    sin_t = jnp.asarray(np.sin(ang), jnp.float32)
+    prec = lax.Precision.HIGHEST \
+        if jnp.dtype(d.dtype) == jnp.float32 else None
+    out = jnp.matmul(a, cos_t, precision=prec) - \
+        jnp.matmul(b, sin_t, precision=prec)
     return out.astype(d.dtype)
 
 

@@ -352,8 +352,12 @@ def _adaptive_avg_pool(data, output_size=()):
 
     wh = axis_weights(H, oh)                 # (oh, H)
     ww = axis_weights(W, ow)                 # (ow, W)
-    # two small matmuls — MXU-friendly, no gather
-    return jnp.einsum("oh,nchw,pw->ncop", wh, data, ww)
+    # two small matmuls — MXU-friendly, no gather; exact averaging
+    # wants true-f32 accumulation, not the TPU default's bf16 inputs
+    prec = lax.Precision.HIGHEST \
+        if jnp.dtype(data.dtype) == jnp.float32 else None
+    return jnp.einsum("oh,nchw,pw->ncop", wh, data, ww,
+                      precision=prec)
 
 
 register_op("_contrib_AdaptiveAvgPooling2D",

@@ -239,12 +239,21 @@ register_op("sparse_retain", num_inputs=2)(_sparse_retain)
 # ---------------------------------------------------------------------------
 
 
+def _f32_precision(dtype):
+    """f32 linalg keeps true-f32 MXU passes — the TPU default's bf16
+    multiplicands are ~3 decimal digits looser than any linalg user
+    (or the reference's CPU oracle) expects."""
+    return lax.Precision.HIGHEST \
+        if jnp.dtype(dtype) == jnp.float32 else None
+
+
 def _potri(a):
     """inv(A) from its Cholesky factor L (A = L L^T) — linalg_potri†."""
     eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
     linv = lax.linalg.triangular_solve(a, eye, lower=True,
                                        left_side=True)
-    return jnp.swapaxes(linv, -1, -2) @ linv
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv,
+                      precision=_f32_precision(a.dtype))
 
 
 register_op("linalg_potri")(_potri)
@@ -254,7 +263,9 @@ def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
     tri = jnp.tril(a) if lower else jnp.triu(a)
     if transpose:
         tri = jnp.swapaxes(tri, -1, -2)
-    return alpha * (b @ tri if rightside else tri @ b)
+    prec = _f32_precision(a.dtype)
+    return alpha * (jnp.matmul(b, tri, precision=prec) if rightside
+                    else jnp.matmul(tri, b, precision=prec))
 
 
 register_op("linalg_trmm", num_inputs=2,

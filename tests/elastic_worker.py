@@ -1,0 +1,91 @@
+"""Worker for the preemption/restart recovery test (SURVEY §5.3).
+
+The reference's recovery story is checkpoint + full restart (a dead
+ps-lite worker killed the job; ``tools/kill-mxnet.py``† existed to mop
+up).  The TPU-native equivalent: preemption-safe checkpoints every
+step + coordinator restart of the WHOLE SPMD job — elastically
+shrinking mid-collective is impossible by design (documented).
+
+phase=crash : run 3 steps, checkpoint, rank 1 exits 37 (preempted).
+phase=resume: load the checkpoint, run 2 more steps.
+phase=straight: 5 uninterrupted steps (the oracle trajectory).
+Each phase appends per-step losses to <out_dir>/losses.<phase>.<rank>.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    out_dir, phase = sys.argv[1], sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+    rank = jax.process_index()
+
+    import mxtpu
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.models import mlp
+
+    mxtpu.random.seed(0)
+    net = mlp(classes=4, hidden=(16,))
+    net.initialize(init="xavier")
+    mesh = parallel.make_mesh({"dp": len(jax.devices())},
+                              devices=jax.devices())
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+    rng = np.random.RandomState(0)  # same data on every rank
+    batch = 4 * len(jax.devices())
+    X = rng.randn(8, batch, 6).astype(np.float32)
+    Y = rng.randint(0, 4, (8, batch)).astype(np.float32)
+
+    ckpt_params = os.path.join(out_dir, "elastic.params")
+    ckpt_states = os.path.join(out_dir, "elastic.states")
+
+    def run_steps(lo, hi):
+        losses = []
+        for t in range(lo, hi):
+            losses.append(float(step(nd.array(X[t]),
+                                     nd.array(Y[t])).asscalar()))
+        return losses
+
+    if phase == "resume":
+        # parameter collection must exist before load_states
+        net(nd.array(X[0][: batch]))
+        net.load_parameters(ckpt_params)
+        step.load_states(ckpt_states, x_example=nd.array(X[0]))
+        losses = run_steps(3, 5)
+    elif phase == "crash":
+        losses = run_steps(0, 3)
+        if rank == 0:
+            net.save_parameters(ckpt_params)
+            step.save_states(ckpt_states)
+        # all ranks reach the checkpoint barrier before the preemption
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt")
+    else:
+        losses = run_steps(0, 5)
+
+    with open(os.path.join(out_dir, f"losses.{phase}.{rank}"),
+              "w") as f:
+        f.write(",".join(f"{v:.8f}" for v in losses))
+    if phase == "crash" and rank == 1:
+        sys.stdout.flush()
+        os._exit(37)  # simulated preemption
+
+
+if __name__ == "__main__":
+    main()

@@ -114,9 +114,11 @@ def test_linalg_tail():
                                rtol=1e-3, atol=1e-4)
     a = _rand(3, 5)
     l, q = get_op("linalg_gelqf")(jnp.asarray(a))
-    np.testing.assert_allclose(np.asarray(l @ q), a, atol=1e-5)
-    np.testing.assert_allclose(np.asarray(q @ q.T), np.eye(3),
-                               atol=1e-5)
+    # oracle products in numpy: a device @ would run the TPU default's
+    # bf16 multiplicands and fail the tolerance, not the op
+    ln, qn = np.asarray(l), np.asarray(q)
+    np.testing.assert_allclose(ln @ qn, a, atol=1e-5)
+    np.testing.assert_allclose(qn @ qn.T, np.eye(3), atol=1e-5)
     u, w = get_op("linalg_syevd")(jnp.asarray(spd))
     rec = np.asarray(u).T @ np.diag(np.asarray(w)) @ np.asarray(u)
     np.testing.assert_allclose(rec, spd, rtol=1e-3, atol=1e-3)
