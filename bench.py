@@ -32,6 +32,12 @@ _PEAK_BF16 = (("TPU v5 lite", 197e12), ("TPU v5p", 459e12),
               ("TPU v5", 459e12), ("TPU v4", 275e12), ("TPU v3", 123e12),
               ("TPU v2", 45e12))
 
+_METRIC_NAMES = {
+    "resnet50": "resnet50_imagenet_train_throughput",
+    "bert": "bert_large_pretrain_throughput",
+    "lenet": "lenet_mnist_train_throughput",
+}
+
 # Analytic training FLOPs per unit (sample or token)
 _TRAIN_FLOPS = {
     "resnet50": 3 * 4.1e9,    # 3x forward GEMM/conv FLOPs @224x224
@@ -167,17 +173,29 @@ def main():
     order = [which] if which != "all" else ["resnet50", "bert", "lenet"]
     results = {}
     for model in order:
-        value, metric, unit = table[model]()
+        # one workload failing (e.g. a transient tunnel error) must not
+        # cost the round its benchmark line — record the error and move on
+        try:
+            value, metric, unit = table[model]()
+        except Exception as e:
+            results[model] = {"metric": _METRIC_NAMES[model],
+                              "value": None, "unit": None, "mfu": None,
+                              "vs_baseline": None,
+                              "error": str(e)[:300]}
+            continue
         prev = baseline.get(metric)
         results[model] = {
             "metric": metric, "value": round(value, 1), "unit": unit,
             "mfu": _mfu(model, value, peak),
             "vs_baseline": (round(value / prev, 3) if prev else None),
         }
-    primary = results[order[0]]
+    primary = next((results[m] for m in order
+                    if results[m]["value"] is not None),
+                   results[order[0]])
     out = dict(primary)
     if len(results) > 1:
-        out["extras"] = {m: results[m] for m in order[1:]}
+        out["extras"] = {m: results[m] for m in order
+                         if results[m] is not primary}
     print(json.dumps(out))
 
 

@@ -85,7 +85,14 @@ def main():
                     anchors, labels, logits, overlap_threshold=0.3,
                     negative_mining_ratio=3.0)
                 logp = nd.log_softmax(logits, axis=1)
-                rpn_ce = -nd.pick(logp, ct, axis=1)
+                # ct == -1 marks non-mined anchors (MultiBoxTarget
+                # ignore label): mask them out or the mining ratio is
+                # a no-op and easy negatives swamp the loss
+                keep = ct >= 0
+                per_anchor = -nd.pick(logp, nd.maximum(
+                    ct, nd.zeros_like(ct)), axis=1) * keep
+                rpn_ce = nd.sum(per_anchor) / nd.maximum(
+                    nd.sum(keep), nd.ones_like(nd.sum(keep)))
                 # box regression on positives (smooth-L1 over masked
                 # deltas), the reference's rpn_bbox_loss
                 reg = nd.transpose(rpn_reg, axes=(0, 2, 3, 1)) \
@@ -103,11 +110,10 @@ def main():
                                      end=1).reshape((-1,))
                 boxes = nd.slice_axis(roi_np, axis=1, begin=1, end=5)
                 gt_for_roi = nd.take(gt_boxes, bidx)  # (R, 4)
-                from mxtpu.ndarray.contrib import _box_iou_raw
-                iou = nd.NDArray(_box_iou_raw(
-                    boxes.data.reshape(-1, 1, 4),
-                    gt_for_roi.data.reshape(-1, 1, 4)),
-                    None, _placed=True).reshape((-1,))
+                from mxtpu.ndarray.contrib import box_iou
+                iou = box_iou(boxes.reshape((-1, 1, 4)),
+                              gt_for_roi.reshape((-1, 1, 4))) \
+                    .reshape((-1,))
                 # fg threshold 0.35 (toy-scale proposals) + 4x fg
                 # weighting against the ~95% background ROIs — the
                 # reference balances by sampling 25% fg instead
@@ -120,7 +126,7 @@ def main():
                 head_logp = nd.log_softmax(cls_scores, axis=-1)
                 head_ce = -nd.sum(w * nd.pick(head_logp, roi_cls,
                                               axis=-1)) / nd.sum(w)
-                loss = nd.mean(rpn_ce) + reg_loss + head_ce
+                loss = rpn_ce + reg_loss + head_ce
             loss.backward()
             trainer.step(batch_size=args.batch_size)
             total += float(loss.asscalar())
@@ -133,8 +139,7 @@ def main():
     # scale the RPN localizes well while the two-stage head stays
     # noisy — mirror of the reference recipe's behavior before its
     # long VOC schedules.
-    from mxtpu.ndarray.contrib import _box_iou_raw
-    import jax.numpy as jnp
+    from mxtpu.ndarray.contrib import box_iou
     metric = VOC07MApMetric(iou_thresh=0.3)
     hits, gts = 0, 0
     for _ in range(4):
@@ -145,9 +150,9 @@ def main():
         for i in range(args.batch_size):
             props = r[r[:, 0] == i][:, 1:]
             gt = lb[i, 0, 1:5] * size
-            iou = np.asarray(_box_iou_raw(
-                jnp.asarray(props), jnp.asarray(gt[None]
-                                                .astype(np.float32))))
+            iou = box_iou(nd.array(props),
+                          nd.array(gt[None].astype(np.float32))) \
+                .asnumpy()
             hits += int(iou.max() >= 0.5)
             gts += 1
         det = net.detect(nd.array(xb), info)

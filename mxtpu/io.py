@@ -183,10 +183,13 @@ class NDArrayIter(DataIter):
         self.cursor = 0
 
     def __len__(self):
+        """Batches per epoch.  For 'roll_over' this is the carry-free
+        count (n // batch_size); epochs consuming a previous epoch's
+        remainder may yield one more batch."""
         n = self.num_data
-        if self.last_batch_handle == "discard":
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
+        if self.last_batch_handle == "pad":
+            return (n + self.batch_size - 1) // self.batch_size
+        return n // self.batch_size
 
     def iter_next(self) -> bool:
         n = len(self._order)

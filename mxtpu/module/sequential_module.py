@@ -121,7 +121,7 @@ class SequentialModule(BaseModule):
                             allow_missing=True,
                             force_init=force_init)
         self.params_initialized = True
-        if not allow_missing and arg_params:
+        if not allow_missing and arg_params is not None:
             arg, aux = self.get_params()
             known = set(arg) | set(aux)
             unknown = [k for k in arg_params if k not in known]
@@ -129,6 +129,16 @@ class SequentialModule(BaseModule):
                 raise MXNetError(
                     f"arg_params keys {sorted(unknown)} match no "
                     f"module parameter (allow_missing=False)")
+            provided = set(arg_params) | set(aux_params or {})
+            # data/label inputs are not parameters; Module.get_params
+            # returns trainables+aux only, so every known name must be
+            # provided — a partial checkpoint fails loudly instead of
+            # silently fresh-initializing the gaps
+            missing = [k for k in known if k not in provided]
+            if missing:
+                raise MXNetError(
+                    f"arg_params is missing parameters "
+                    f"{sorted(missing)} (allow_missing=False)")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):
