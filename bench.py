@@ -32,6 +32,8 @@ _PEAK_BF16 = (("TPU v5 lite", 197e12), ("TPU v5p", 459e12),
               ("TPU v5", 459e12), ("TPU v4", 275e12), ("TPU v3", 123e12),
               ("TPU v2", 45e12))
 
+# single source for each workload's metric name (success AND error
+# paths report the same key)
 _METRIC_NAMES = {
     "resnet50": "resnet50_imagenet_train_throughput",
     "bert": "bert_large_pretrain_throughput",
@@ -90,7 +92,7 @@ def bench_lenet(batch_size=512, warmup=5, iters=30):
     x = nd.array(rng.randn(batch_size, 1, 28, 28).astype(np.float32))
     y = nd.array(rng.randint(0, 10, (batch_size,)).astype(np.float32))
     return _measure(step, x, y, warmup, iters, batch_size), \
-        "lenet_mnist_train_throughput", "samples/sec"
+        _METRIC_NAMES["lenet"], "samples/sec"
 
 
 def bench_resnet50(batch_size=None, warmup=3, iters=20):
@@ -116,7 +118,7 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
     x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
     return _measure(step, x, y, warmup, iters, batch_size), \
-        "resnet50_imagenet_train_throughput", "samples/sec"
+        _METRIC_NAMES["resnet50"], "samples/sec"
 
 
 def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20):
@@ -145,7 +147,7 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20):
                     .astype(np.float32))
     tokens_per_batch = batch_size * seq_len
     value = _measure(step, toks, toks, warmup, iters, tokens_per_batch)
-    return value, "bert_large_pretrain_throughput", "tokens/sec"
+    return value, _METRIC_NAMES["bert"], "tokens/sec"
 
 
 def _mfu(model, value, peak):

@@ -142,13 +142,21 @@ class SSDLoss(Loss):
 
     def hybrid_forward(self, F, cls_preds, box_preds, cls_target,
                        box_target, box_mask):
-        # class CE over (N, C+1, A) with sparse targets (N, A)
+        # class CE over (N, C+1, A) with sparse targets (N, A).
+        # MultiBoxTarget marks non-mined anchors with the ignore label
+        # -1 when negative_mining_ratio > 0 — mask them out (pick
+        # would wrap -1 to the last class and easy negatives would
+        # swamp the loss); without mining no -1 exists and this is the
+        # plain mean
         logp = F.log_softmax(cls_preds, axis=1)
-        ce = -F.pick(logp, cls_target, axis=1)
-        # only mined entries train the classifier: MultiBoxTarget marks
-        # ignored anchors with target -1? (ours: 0 = background, mined
-        # negatives included) — sum over anchors, mean over batch
-        cls_loss = F.mean(ce, axis=0, exclude=True)
+        keep = cls_target >= 0
+        safe_t = F.maximum(cls_target, F.zeros_like(cls_target))
+        ce = -F.pick(logp, safe_t, axis=1) * keep
+        # mean over KEPT anchors (== plain anchor mean when no ignore
+        # labels are present)
+        frac_keep = F.mean(keep, axis=0, exclude=True)
+        n_keep = F.maximum(frac_keep, 1e-8 * F.ones_like(frac_keep))
+        cls_loss = F.mean(ce, axis=0, exclude=True) / n_keep
         sl1 = F.smooth_l1((box_preds - box_target) * box_mask,
                           scalar=1.0)
         box_loss = F.mean(sl1, axis=0, exclude=True)

@@ -129,15 +129,17 @@ class SequentialModule(BaseModule):
                 raise MXNetError(
                     f"arg_params keys {sorted(unknown)} match no "
                     f"module parameter (allow_missing=False)")
-            provided = set(arg_params) | set(aux_params or {})
-            # data/label inputs are not parameters; Module.get_params
-            # returns trainables+aux only, so every known name must be
-            # provided — a partial checkpoint fails loudly instead of
-            # silently fresh-initializing the gaps
-            missing = [k for k in known if k not in provided]
+            # every trainable must come from arg_params — a partial
+            # checkpoint fails loudly instead of silently
+            # fresh-initializing the gaps.  Aux states are only
+            # required when aux_params was explicitly provided
+            # (aux_params=None means "fresh aux", reference semantics)
+            missing = [k for k in arg if k not in arg_params]
+            if aux_params is not None:
+                missing += [k for k in aux if k not in aux_params]
             if missing:
                 raise MXNetError(
-                    f"arg_params is missing parameters "
+                    f"checkpoint is missing parameters "
                     f"{sorted(missing)} (allow_missing=False)")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",

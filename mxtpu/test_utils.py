@@ -408,24 +408,33 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
                          "(dict name→numpy array) to fix shapes")
     base_loc = {k: _as_numpy(v) * scale for k, v in arg_params.items()}
 
+    import jax as _jax
     runs = []
     for entry in norm:
         loc = {k: v.astype(entry["type_dict"].get(k, v.dtype))
                for k, v in base_loc.items()}
         exe = _bind(sym, loc, aux_states=aux_states, grad_req=grad_req,
                     ctx=entry["ctx"])
-        outs = [o.asnumpy() for o in exe.forward(is_train=grad_req != "null")]
-        grads = None
-        if grad_req != "null":
-            # identical head grads across runs (seeded independently of
-            # the per-test global stream)
-            rs = np.random.RandomState(0)
-            ograds = [rs.normal(0, 1, size=o.shape).astype(o.dtype)
-                      for o in outs]
-            exe.backward(out_grads=[array(g, ctx=entry["ctx"])
-                                    for g in ograds])
-            grads = {name: g.asnumpy() for name, g in
-                     zip(args, exe.grad_arrays) if g is not None}
+        # true-f32 matmuls for the oracle runs: the TPU default feeds
+        # bf16 multiplicands to f32 dots (~3 decimal digits loose),
+        # which would measure platform rounding, not lowering-rule
+        # equivalence (SURVEY §7 hard-part 9).  Explicit low-precision
+        # type_dict variants (bf16/f16) are unaffected — precision
+        # only changes f32-input contractions.
+        with _jax.default_matmul_precision("highest"):
+            outs = [o.asnumpy()
+                    for o in exe.forward(is_train=grad_req != "null")]
+            grads = None
+            if grad_req != "null":
+                # identical head grads across runs (seeded
+                # independently of the per-test global stream)
+                rs = np.random.RandomState(0)
+                ograds = [rs.normal(0, 1, size=o.shape).astype(o.dtype)
+                          for o in outs]
+                exe.backward(out_grads=[array(g, ctx=entry["ctx"])
+                                        for g in ograds])
+                grads = {name: g.asnumpy() for name, g in
+                         zip(args, exe.grad_arrays) if g is not None}
         runs.append({"entry": entry, "outs": outs, "grads": grads})
 
     # baseline = widest dtype

@@ -91,3 +91,49 @@ def test_ssd_hybridize_matches_imperative():
     for e, g in ((a0, a1), (c0, c1), (b0, b1)):
         np.testing.assert_allclose(e.asnumpy(), g.asnumpy(),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_ssd_loss_ignores_non_mined_anchors():
+    """MultiBoxTarget's ignore label (-1, emitted under negative
+    mining) must not train the classifier: ignored anchors contribute
+    zero CE and the normalization counts only kept anchors."""
+    from mxtpu.models.ssd import SSDLoss
+    rng = np.random.RandomState(0)
+    N, C, A = 2, 3, 8
+    cls_preds = nd.array(rng.randn(N, C + 1, A).astype(np.float32))
+    box_preds = nd.array(np.zeros((N, A * 4), np.float32))
+    box_target = nd.array(np.zeros((N, A * 4), np.float32))
+    box_mask = nd.array(np.zeros((N, A * 4), np.float32))
+    ct = np.zeros((N, A), np.float32)
+    ct[:, 0] = 2.0          # one positive
+    ct[:, 1] = 0.0          # one mined negative
+    ct[:, 2:] = -1.0        # ignored
+    loss_fn = SSDLoss()
+    got = loss_fn(cls_preds, box_preds, nd.array(ct), box_target,
+                  box_mask).asnumpy()
+    # oracle: mean CE over the two kept anchors only
+    logits = cls_preds.asnumpy()
+    logp = logits - np.log(np.exp(logits).sum(axis=1, keepdims=True))
+    want = []
+    for n in range(N):
+        kept = [-logp[n, 2, 0], -logp[n, 0, 1]]
+        want.append(np.mean(kept))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ssd_loss_unchanged_without_ignore_labels():
+    """No -1 targets (mining off, the default pipeline): the masked
+    loss equals the plain anchor mean it replaced."""
+    from mxtpu.models.ssd import SSDLoss
+    rng = np.random.RandomState(1)
+    N, C, A = 2, 2, 6
+    cls_preds = nd.array(rng.randn(N, C + 1, A).astype(np.float32))
+    zeros = nd.array(np.zeros((N, A * 4), np.float32))
+    ct = rng.randint(0, C + 1, (N, A)).astype(np.float32)
+    got = SSDLoss()(cls_preds, zeros, nd.array(ct), zeros,
+                    zeros).asnumpy()
+    logits = cls_preds.asnumpy()
+    logp = logits - np.log(np.exp(logits).sum(axis=1, keepdims=True))
+    want = [np.mean([-logp[n, int(ct[n, a]), a] for a in range(A)])
+            for n in range(N)]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
