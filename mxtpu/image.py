@@ -382,7 +382,8 @@ class ImageDetIter:
                  path_imgidx=None, shuffle=False, max_objs=None,
                  rand_crop=0.0, rand_mirror=False, mean_pixels=None,
                  std_pixels=None, scale=1.0, aug_list=None,
-                 last_batch_handle="pad", seed=0, **kwargs):
+                 last_batch_handle="pad", seed=0,
+                 preprocess_threads=4, **kwargs):
         from . import recordio as rio
         from .io import DataDesc
         self.data_shape = tuple(data_shape)
@@ -395,9 +396,15 @@ class ImageDetIter:
             std_pixels if std_pixels is not None else (1, 1, 1),
             np.float32)
         self._rng = np.random.RandomState(seed)
-        self.auglist = aug_list if aug_list is not None else \
-            CreateDetAugmenter(data_shape, rand_crop=rand_crop,
-                               rand_mirror=rand_mirror, rng=self._rng)
+        # user-supplied augmenters run shared + single-threaded (their
+        # rng would race across threads); otherwise augmenters are
+        # built per-sample from _aug_args with per-sample seeds —
+        # _aug_args is the single switch next() and _decode_one gate on
+        self.auglist = aug_list
+        self._aug_args = None if aug_list is not None else \
+            dict(rand_crop=rand_crop, rand_mirror=rand_mirror)
+        self._threads = max(1, int(preprocess_threads))
+        self._pool = None
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         if path_imgidx and os.path.exists(path_imgidx):
@@ -470,14 +477,39 @@ class ImageDetIter:
         out[:n] = objs[:n]
         return out
 
-    def _decode_one(self, raw):
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        rec = getattr(self, "_rec", None)
+        if rec is not None and hasattr(rec, "close"):
+            rec.close()
+            self._rec = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _decode_one(self, raw, aug_seed=None):
+        """``aug_seed``: per-sample augmentation seed drawn serially
+        on the consumer (reproducible at any pool size); None = use
+        the shared (possibly user-supplied) augmenter list."""
         import cv2
 
         from . import recordio as rio
         header, img = rio.unpack_img(raw, iscolor=1)
         label = self._parse_label(header.label)
         img = img[:, :, ::-1]  # BGR→RGB
-        for aug in self.auglist:
+        if aug_seed is None:
+            augs = self.auglist or ()
+        else:
+            augs = CreateDetAugmenter(
+                self.data_shape,
+                rng=np.random.RandomState(aug_seed),
+                **self._aug_args)
+        for aug in augs:
             img, label = aug(img, label)
         c, h, w = self.data_shape
         if img.shape[:2] != (h, w):
@@ -494,15 +526,32 @@ class ImageDetIter:
         data = np.zeros((self.batch_size, c, h, w), np.float32)
         labels = -np.ones((self.batch_size, self.max_objs, 5),
                           np.float32)
-        n = 0
-        while n < self.batch_size:
+        raws = []
+        while len(raws) < self.batch_size:
             raw = self._read_raw()
             if raw is None:
                 break
-            img, label = self._decode_one(raw)
-            data[n] = img
-            labels[n] = label
-            n += 1
+            raws.append(raw)
+        n = len(raws)
+        if n and self._aug_args is not None:
+            # per-sample seeds drawn serially: the augmentation stream
+            # is identical whatever the decode-pool size
+            seeds = self._rng.randint(0, 2 ** 31 - 1, size=n,
+                                      dtype=np.int64)
+            if self._threads > 1:
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._pool = ThreadPoolExecutor(self._threads)
+                decoded = list(self._pool.map(self._decode_one, raws,
+                                              seeds))
+            else:
+                decoded = [self._decode_one(r, s)
+                           for r, s in zip(raws, seeds)]
+        else:
+            decoded = [self._decode_one(r) for r in raws]
+        for i, (img, label) in enumerate(decoded):
+            data[i] = img
+            labels[i] = label
         if n == 0:
             self._exhausted = True
             raise StopIteration

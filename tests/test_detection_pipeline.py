@@ -180,3 +180,27 @@ def test_voc07_map_difficult_neutral():
                       [0, .90, .6, .6, .9, .9]]])
     m.update([label], [pred])
     assert abs(m.get()[1] - 1.0) < 1e-6  # difficult det is neutral
+
+
+def test_imagedetiter_seeded_reproducible_any_pool_size(tmp_path):
+    """Per-sample augmentation seeds are drawn serially, so identical
+    iterator seeds give identical epochs at any preprocess_threads."""
+    rec, idx, _ = _write_rec(str(tmp_path / "rp"), n=12, size=48,
+                             seed=5)
+
+    def epoch(threads):
+        it = ImageDetIter(rec, (3, 32, 32), batch_size=4,
+                          path_imgidx=idx, shuffle=True,
+                          rand_crop=0.5, rand_mirror=True, seed=9,
+                          preprocess_threads=threads)
+        out = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+               for b in it]
+        it.close()
+        return out
+
+    a, b, c = epoch(4), epoch(4), epoch(1)
+    for (da, la), (db, lb_), (dc, lc) in zip(a, b, c):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(da, dc)
+        np.testing.assert_array_equal(la, lb_)
+        np.testing.assert_array_equal(la, lc)
