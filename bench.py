@@ -11,13 +11,15 @@ backward, fused optimizer (+BN aux writeback) — via
 ``mxtpu.parallel.build_train_step``, i.e. the samples/sec a
 Speedometer would report (SURVEY.md §5.5).
 
-``mfu`` is model-FLOPs utilisation: analytic training FLOPs/sample
-(3x forward for ResNet-50 at 224x224 ~= 3 x 4.1 GFLOP; 6 x N_params
-per token for BERT-Large, N = 334M) divided by the chip's peak bf16
-FLOP/s.  ``vs_baseline`` compares against the PREVIOUS round's
-self-measured number in BASELINE_SELF.json — the reference mount has
+``mfu`` is model-FLOPs utilisation: training FLOPs/sample as counted
+by XLA's cost_analysis of the compiled fwd+bwd program (see
+_TRAIN_FLOPS) divided by the chip's peak bf16 FLOP/s.
+``vs_baseline`` compares the run best against the PREVIOUS round's
+self-measured best in BASELINE_SELF.json — the reference mount has
 been empty every round (SURVEY.md provenance caveat), so the baseline
-is our own trend line; regression < 1.0 is failure.
+is our own trend line; regression < 1.0 is failure unless
+``within_noise`` (the shared-chip tunnel shows 5-15% run-to-run
+spread, recorded per metric in ``band``).
 """
 import json
 import os
@@ -40,10 +42,15 @@ _METRIC_NAMES = {
     "lenet": "lenet_mnist_train_throughput",
 }
 
-# Analytic training FLOPs per unit (sample or token)
+# Training FLOPs per unit (sample or token), from XLA's own
+# cost_analysis() of the compiled fwd+bwd program (r4: the widely
+# quoted "4.1 GFLOP" for ResNet-50 is multiply-ACCUMULATES; XLA counts
+# 7.54 GFLOP fwd / 22.49 GFLOP fwd+bwd per sample at 224x224, so r1-r3
+# under-reported ResNet MFU by 1.83x.  BERT's 6N estimate was within
+# 3% of XLA's 2.063 GFLOP/token and is replaced by the measured value.)
 _TRAIN_FLOPS = {
-    "resnet50": 3 * 4.1e9,    # 3x forward GEMM/conv FLOPs @224x224
-    "bert": 6 * 334e6,        # 6N per token (fwd 2N + bwd 4N)
+    "resnet50": 22.49e9,      # XLA cost_analysis, fwd+bwd, b256
+    "bert": 2.063e9,          # XLA cost_analysis, fwd+bwd, b32 s128
     "lenet": None,            # too small for MFU to mean anything
 }
 
@@ -57,24 +64,33 @@ def _peak_flops():
     return None
 
 
-def _measure(step, x, y, warmup, iters, batch_size, repeats=3):
-    """Best-of-N timing of BULKED execution: ``iters`` steps run as one
-    compiled ``lax.scan`` program (``TrainStep.run_steps``), the
-    TPU-native analogue of the reference's bulked graph execution.
-    Necessary for honesty here: each dispatch over the axon tunnel
-    costs ~10 ms of host RPC, which at ResNet step times would measure
-    the tunnel, not the chip (microbench: an 8192^3 bf16 matmul shows
-    61 TF/s dispatched per-call vs 130 TF/s scanned)."""
+def _measure(step, x, y, warmup, iters, batch_size, repeats=5):
+    """Timing of BULKED execution: ``iters`` steps run as one compiled
+    ``lax.scan`` program (``TrainStep.run_steps``), the TPU-native
+    analogue of the reference's bulked graph execution.  Necessary for
+    honesty here: the tunnel charges ~10 ms of host RPC per dispatch
+    plus ~2-3 ms per loop iteration (BASELINE.md r4 platform
+    analysis), which at single-step granularity would measure the
+    tunnel, not the chip.  Returns {best, median, n, spread} over
+    ``repeats`` runs — the shared chip shows 5-15% run-to-run spread,
+    so a single point is not a result."""
     last = step.run_steps(x, y, max(warmup, 2), reuse_batch=True)
     float(last.asnumpy()[-1])  # drain warmup incl. compile
-    best = 0.0
+    vals = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         last = step.run_steps(x, y, iters, reuse_batch=True)
         float(last.asnumpy()[-1])  # sync
         dt = time.perf_counter() - t0
-        best = max(best, batch_size * iters / dt)
-    return best
+        vals.append(batch_size * iters / dt)
+    vals.sort()
+    median = vals[len(vals) // 2] if len(vals) % 2 else \
+        0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+    # spread = (max-min)/median: the shared-chip tunnel shows +-5-15%
+    # run-to-run variance, so vs_baseline is only meaningful relative
+    # to this band (VERDICT r3 weak-2/weak-6)
+    return {"best": max(vals), "median": median, "n": len(vals),
+            "spread": round((max(vals) - min(vals)) / median, 4)}
 
 
 def bench_lenet(batch_size=512, warmup=5, iters=30):
@@ -178,7 +194,7 @@ def main():
         # one workload failing (e.g. a transient tunnel error) must not
         # cost the round its benchmark line — record the error and move on
         try:
-            value, metric, unit = table[model]()
+            stats, metric, unit = table[model]()
         except Exception as e:
             results[model] = {"metric": _METRIC_NAMES[model],
                               "value": None, "unit": None, "mfu": None,
@@ -186,10 +202,23 @@ def main():
                               "error": str(e)[:300]}
             continue
         prev = baseline.get(metric)
+        # value/vs_baseline stay best-vs-best: BASELINE_SELF.json's
+        # r2/r3 numbers were recorded as best-of-N, so switching the
+        # numerator to median would manufacture a ~spread/2 "regression"
+        # on unchanged performance.  The band carries the honesty.
+        value = stats["best"]
+        ratio = (value / prev) if prev else None
         results[model] = {
             "metric": metric, "value": round(value, 1), "unit": unit,
             "mfu": _mfu(model, value, peak),
-            "vs_baseline": (round(value / prev, 3) if prev else None),
+            "vs_baseline": (round(ratio, 3) if ratio else None),
+            # a regression/gain smaller than the half-width of the
+            # run-to-run band is tunnel noise, not a result
+            # (VERDICT r3 weak-2)
+            "within_noise": (abs(1.0 - ratio) <= stats["spread"] / 2
+                             if ratio else None),
+            "band": {"median": round(stats["median"], 1),
+                     "n": stats["n"], "spread": stats["spread"]},
         }
     primary = next((results[m] for m in order
                     if results[m]["value"] is not None),

@@ -1,0 +1,134 @@
+"""Ablation profile of the ResNet-50 training step on the real chip.
+
+Decomposes the 119 ms/step (b256 bf16) into fwd / bwd / optimizer and
+locates the conv-MFU gap.  Honest methodology (see tools/microbench.py):
+sustained timing chains iterations with a real data dependence inside
+one jitted program — the loss is folded back into the input at 1e-12 so
+nothing is DCE'd, hoisted, or strength-reduced.
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxtpu import nd
+from mxtpu.gluon import loss as gloss
+from mxtpu.models import resnet50
+from mxtpu.parallel import build_train_step
+
+try:
+    from tools.microbench import sustained
+except ImportError:  # run as `python tools/profile_resnet.py`
+    from microbench import sustained
+
+
+def sustained_ms(apply_fn, x0, n=20, repeats=3):
+    return sustained(apply_fn, x0, n=n, repeats=repeats) * 1e3
+
+
+def build_fns(batch=256, dtype="bfloat16", layout="NCHW"):
+    if layout == "NHWC":
+        from mxtpu.gluon.model_zoo.vision import resnet50_v1
+        net = resnet50_v1(classes=1000, layout="NHWC")
+    else:
+        net = resnet50(classes=1000)
+    net.initialize(init="xavier")
+    step = build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype=dtype)
+    rng = np.random.RandomState(0)
+    shape = (batch, 3, 224, 224) if layout == "NCHW" \
+        else (batch, 224, 224, 3)
+    x = nd.array(rng.randn(*shape).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    step._collect(x)
+
+    params = step._params
+    train_idx = step._train_idx
+    frozen_idx = [i for i in range(len(params))
+                  if i not in set(train_idx)]
+    train_vals = tuple(params[i]._data._data for i in train_idx)
+    frozen_vals = tuple(params[i]._data._data for i in frozen_idx)
+    cdt = jnp.dtype(dtype)
+
+    from mxtpu.gluon.block import _traced_forward
+    from mxtpu.ndarray.ndarray import NDArray
+    from mxtpu.symbol import _is_aux_name
+
+    def loss_of(tv, fv, xx):
+        pvals = [None] * len(params)
+        for i, v in zip(train_idx, tv):
+            pvals[i] = v
+        for i, v in zip(frozen_idx, fv):
+            pvals[i] = v
+        pvals = [v.astype(cdt)
+                 if v is not None and not _is_aux_name(params[i].name)
+                 and jnp.issubdtype(v.dtype, jnp.floating) else v
+                 for i, v in enumerate(pvals)]
+        raw_outs, _, _, _ = _traced_forward(
+            net, params, pvals,
+            [NDArray(xx.astype(cdt), None, _placed=True)], True,
+            jax.random.PRNGKey(0))
+        l = gloss.SoftmaxCrossEntropyLoss()(
+            NDArray(raw_outs[0], None, _placed=True),
+            NDArray(y.data if hasattr(y, "data") else y, None,
+                    _placed=True))
+        return jnp.mean(l.data.astype(jnp.float32))
+
+    return step, x, y, loss_of, train_vals, frozen_vals
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    layout = sys.argv[2] if len(sys.argv) > 2 else "NCHW"
+    print(f"device: {jax.devices()[0]}  batch={batch} layout={layout}")
+    step, x, y, loss_of, tv, fv = build_fns(batch=batch, layout=layout)
+
+    xj = x.data
+
+    # 1. forward only
+    def fwd_chain(xx):
+        l = loss_of(tv, fv, xx)
+        return xx + l.astype(xx.dtype) * 1e-12
+
+    t_fwd = sustained_ms(fwd_chain, xj, n=10)
+    print(f"fwd-only:  {t_fwd:.1f} ms/step")
+
+    # 2. forward+backward (grads wrt train params)
+    grad_fn = jax.grad(lambda tv_, xx: loss_of(tv_, fv, xx))
+
+    def fwdbwd_chain(xx):
+        g = grad_fn(tv, xx)
+        s = sum(jnp.sum(gi.astype(jnp.float32)) for gi in
+                jax.tree_util.tree_leaves(g))
+        return xx + s.astype(xx.dtype) * 1e-12
+
+    t_fb = sustained_ms(fwdbwd_chain, xj, n=10)
+    print(f"fwd+bwd:   {t_fb:.1f} ms/step  (bwd = {t_fb - t_fwd:.1f})")
+
+    # 3. full train step via run_steps (fwd+bwd+sgd+aux)
+    last = step.run_steps(x, y, 3, reuse_batch=True)
+    float(last.asnumpy()[-1])
+    t0 = time.perf_counter()
+    last = step.run_steps(x, y, 10, reuse_batch=True)
+    float(last.asnumpy()[-1])
+    t_full = (time.perf_counter() - t0) / 10 * 1e3
+    print(f"full step: {t_full:.1f} ms/step "
+          f"-> {batch / t_full * 1e3:.0f} samples/sec")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _TRAIN_FLOPS, _peak_flops
+    fl = _TRAIN_FLOPS["resnet50"] * batch / 1e12  # TFLOP, fwd+bwd
+    peak = _peak_flops() or 197e12
+    tf = fl / (t_fb / 1e3)
+    print(f"fwd+bwd sustained: {tf:.1f} TF/s "
+          f"({tf * 1e12 / peak * 100:.1f}% MFU)")
+
+
+if __name__ == "__main__":
+    main()
