@@ -86,11 +86,14 @@ def _measure(step, x, y, warmup, iters, batch_size, repeats=5):
     vals.sort()
     median = vals[len(vals) // 2] if len(vals) % 2 else \
         0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
-    # spread = (max-min)/median: the shared-chip tunnel shows +-5-15%
-    # run-to-run variance, so vs_baseline is only meaningful relative
-    # to this band (VERDICT r3 weak-2/weak-6)
+    # spread = (max-min)/median over the runs minus the single worst
+    # (the shared tunnel occasionally stalls a run outright — a 20x
+    # outlier would make every future delta "within noise").  Normal
+    # run-to-run variance on this chip is +-5-15% (VERDICT r3 weak-2).
+    core = vals[1:] if len(vals) >= 4 else vals
     return {"best": max(vals), "median": median, "n": len(vals),
-            "spread": round((max(vals) - min(vals)) / median, 4)}
+            "spread": round((max(core) - min(core)) / median, 4),
+            "runs": [round(v, 1) for v in vals]}
 
 
 def bench_lenet(batch_size=512, warmup=5, iters=30):

@@ -974,32 +974,100 @@ register_op("InstanceNorm", num_inputs=3,
             params=[Param("eps", float, 1e-3)])(_instance_norm)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_core(x, gamma, beta, axis, eps):
+    out, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta, axis, eps)
+    return out, mean, var
+
+
+def _bn_train_fwd_impl(x, gamma, beta, axis, eps):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    # statistics in f32 (AMP discipline: bf16 mantissas lose small
+    # variance contributions) via E[x^2]-E[x]^2 — ONE fused read of x.
+    # The big tensor itself streams in its own dtype: out = x*scale +
+    # shift with per-channel f32 scalars, so the pass is bf16-in/
+    # bf16-out instead of materialising an f32 copy (2x bandwidth).
+    s1 = jnp.sum(x.astype(jnp.float32), axis=axes)
+    s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    rstd = lax.rsqrt(var + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32 * rstd).reshape(shape)
+    shift = (beta.astype(jnp.float32) - mean * g32 * rstd).reshape(shape)
+    out = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+    return out, mean, var, rstd
+
+
+def _bn_core_fwd(x, gamma, beta, axis, eps):
+    out, mean, var, rstd = _bn_train_fwd_impl(x, gamma, beta, axis, eps)
+    return (out, mean, var), (x, gamma, mean, rstd)
+
+
+def _bn_core_bwd(axis, eps, res, dys):
+    # batch mean/var are the aux-state channel (running-stat EMA);
+    # like the reference's FMutateInputs aux states they are not a
+    # differentiable output — their cotangents are ignored
+    dy = dys[0]
+    # Analytic batch-norm backward (2 passes over the big tensors):
+    #   dbeta  = sum(dy);  dgamma = sum(dy * xhat)
+    #   dx = g*rstd * (dy - dbeta/N - xhat * dgamma/N)
+    # vs autodiff of the mean/var graph, which saves f32 residuals of
+    # activation size and re-reads them — measured 47 ms of the 121 ms
+    # ResNet-50 b256 step before this kernel (BASELINE.md r4).
+    x, gamma, mean, rstd = res
+    nd_ = x.ndim
+    axes = tuple(i for i in range(nd_) if i != axis)
+    shape = tuple(-1 if i == axis else 1 for i in range(nd_))
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * \
+        rstd.reshape(shape)
+    dbeta = jnp.sum(dy32, axis=axes)
+    dgamma = jnp.sum(dy32 * xhat, axis=axes)
+    g32 = gamma.astype(jnp.float32)
+    dx = (g32 * rstd).reshape(shape) * (
+        dy32 - (dbeta / n).reshape(shape)
+        - xhat * (dgamma / n).reshape(shape))
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+_bn_train_core.defvjp(_bn_core_fwd, _bn_core_bwd)
+
+
 def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1):
     """Normalise over all axes but `axis`.  Returns (out, batch_mean,
     batch_var) — the gluon layer owns the running-stat update (the
     reference mutates aux states inside the op via FMutateInputs;
-    functionally we return them instead)."""
+    functionally we return them instead).
+
+    The training path runs through a custom-VJP core with the analytic
+    2-pass backward; batch stats are returned via stop_gradient (the
+    running-stat EMA is not a differentiable consumer, matching the
+    reference's aux-state semantics)."""
     axis = axis % x.ndim
     axes = tuple(i for i in range(x.ndim) if i != axis)
-    # statistics in f32 regardless of compute dtype (AMP discipline:
-    # bf16 mantissas lose small EMA/variance contributions)
-    x32 = x.astype(jnp.float32)
+    shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
     if use_global_stats:
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)
-    else:
-        mean = jnp.mean(x32, axis=axes)
-        var = jnp.mean(jnp.square(x32 - mean.reshape(
-            tuple(-1 if i == axis else 1 for i in range(x.ndim)))),
-            axis=axes)
-    shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = (x32 - mean.reshape(shape)) * lax.rsqrt(
-        var.reshape(shape) + eps) * g.astype(jnp.float32).reshape(shape) \
-        + beta.astype(jnp.float32).reshape(shape)
-    return out.astype(x.dtype), mean, var
+        scale = (g.astype(jnp.float32) * lax.rsqrt(var + eps))
+        out = (x.astype(jnp.float32) - mean.reshape(shape)) * \
+            scale.reshape(shape) + \
+            beta.astype(jnp.float32).reshape(shape)
+        return out.astype(x.dtype), mean, var
+    out, mean, var = _bn_train_core(x, g, beta, axis, eps)
+    return out, mean, var
 
 
 register_op("BatchNorm", num_inputs=5, num_outputs=3,

@@ -1,0 +1,114 @@
+"""Conv strategy shootout on the real chip (VERDICT r4 item 1).
+
+Compares, with the honest chained harness (tools/microbench.py):
+  a) XLA conv_general_dilated          (the current path)
+  b) shifted-GEMM: sum over (kh,kw) of strided-slice + matmul, pure XLA
+  c) Pallas kernel: VMEM-staged tiles, MXU dot per (kh,kw) shift
+
+All NHWC, stride 1, SAME, C=O (chainable), bf16, b256.
+"""
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+try:
+    from tools.microbench import sustained
+except ImportError:
+    from microbench import sustained
+
+
+def xla_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def shifted_gemm_conv(x, w):
+    # x: (N,H,W,C), w: (KH,KW,C,O); pad then accumulate 9 matmuls
+    N, H, W, C = x.shape
+    KH, KW, _, O = w.shape
+    ph, pw = KH // 2, KW // 2
+    xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    acc = jnp.zeros((N, H, W, O), jnp.float32)
+    for kh in range(KH):
+        for kw in range(KW):
+            xs = jax.lax.slice(
+                xp, (0, kh, kw, 0), (N, kh + H, kw + W, C))
+            acc = acc + jnp.einsum(
+                "nhwc,co->nhwo", xs, w[kh, kw],
+                preferred_element_type=jnp.float32)
+    return acc.astype(x.dtype)
+
+
+def _conv_kernel(x_ref, w_ref, o_ref, acc, *, OH, OW, C, O, KH, KW):
+    bn = x_ref.shape[0]
+    acc[:] = jnp.zeros_like(acc)
+    for kh in range(KH):
+        for kw in range(KW):
+            xs = x_ref[:, kh:kh + OH, kw:kw + OW, :]
+            xm = xs.reshape(bn * OH * OW, C)
+            acc[:] += jax.lax.dot_general(
+                xm, w_ref[kh, kw], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    o_ref[:] = acc[:].reshape(bn, OH, OW, O).astype(o_ref.dtype)
+
+
+def pallas_conv(x, w, bn=8):
+    N, H, W, C = x.shape
+    KH, KW, _, O = w.shape
+    ph, pw = KH // 2, KW // 2
+    xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    HP, WP = H + 2 * ph, W + 2 * pw
+    return pl.pallas_call(
+        functools.partial(_conv_kernel, OH=H, OW=W, C=C, O=O,
+                          KH=KH, KW=KW),
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, HP, WP, C), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((KH, KW, C, O), lambda i: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, H, W, O), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, H, W, O), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bn * H * W, O), jnp.float32)],
+    )(xp, w)
+
+
+def main():
+    shapes = [(14, 256), (28, 128), (7, 512)]
+    if len(sys.argv) > 1:
+        shapes = [shapes[int(sys.argv[1])]]
+    N = 256
+    for (H, C) in shapes:
+        x = jax.random.normal(jax.random.PRNGKey(0), (N, H, H, C),
+                              jnp.bfloat16)
+        w = (jax.random.normal(jax.random.PRNGKey(1), (3, 3, C, C),
+                               jnp.bfloat16) / (3 * C ** 0.5))
+        fl = 2 * N * H * H * C * C * 9
+        ref = jax.jit(xla_conv)(x, w)
+        print(f"-- b{N} {H}x{H} C={C} ({fl/1e9:.0f} GFLOP) --")
+        for name, fn in [("xla_conv", xla_conv),
+                         ("shifted_gemm", shifted_gemm_conv),
+                         ("pallas bn=8", functools.partial(pallas_conv,
+                                                          bn=8)),
+                         ("pallas bn=16", functools.partial(pallas_conv,
+                                                           bn=16))]:
+            try:
+                got = jax.jit(lambda x: fn(x, w))(x)
+                err = float(jnp.max(jnp.abs(
+                    got.astype(jnp.float32) - ref.astype(jnp.float32))))
+                t = sustained(lambda x: fn(x, w), x, n=20)
+                print(f"  {name:14s}: {fl/t/1e12:6.1f} TF/s "
+                      f"({t*1e3:.2f} ms)  err={err:.2e}")
+            except Exception as e:
+                msg = str(e).split(chr(10))[0][:120]
+                print(f"  {name:14s}: FAILED {type(e).__name__}: {msg}")
+
+
+if __name__ == "__main__":
+    main()
