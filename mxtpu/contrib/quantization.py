@@ -200,11 +200,24 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
     calib_mode: 'none' (activation ranges computed per batch at
     runtime — range-exact, slower), 'naive' (abs-max over calibration
     data), 'entropy' (KL-optimal thresholds; the reference default for
-    convnets)."""
+    convnets).
+
+    quantized_dtype: 'int8' (symmetric), 'uint8' (shifted range
+    [0, hi] with zero point 0 — requires non-negative activations,
+    i.e. post-ReLU inputs), or 'auto' (per-layer: uint8 where the
+    calibrated input range is non-negative, else int8 — the
+    reference's auto policy)."""
     from .. import sym as sym_mod
-    if quantized_dtype != "int8":
-        raise MXNetError("int8 is the supported quantized_dtype "
-                         "(the uint8 tier is not implemented)")
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError(f"quantized_dtype must be int8/uint8/auto, "
+                         f"got {quantized_dtype!r}")
+    if quantized_dtype in ("uint8", "auto") and calib_mode == "none":
+        # without calibration there is no evidence activations are
+        # non-negative; auto degrades to int8, explicit uint8 needs data
+        if quantized_dtype == "uint8":
+            raise MXNetError("quantized_dtype='uint8' needs "
+                             "calibration (calib_mode != 'none')")
+        quantized_dtype = "int8"
     aux_params = aux_params or {}
 
     nodes = list(sym._topo())
@@ -223,6 +236,16 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
             need_ranges.append(tname)
 
     ranges: Dict[str, Tuple[float, float]] = {}
+    # per-tensor activation dtype: uint8 where the raw calibrated
+    # minimum is non-negative (post-ReLU tensors) and policy allows
+    qdtype: Dict[str, str] = {}
+
+    def _pick(name, raw_lo, sym_hi):
+        u8 = (quantized_dtype == "uint8"
+              or (quantized_dtype == "auto" and raw_lo >= 0.0))
+        qdtype[name] = "uint8" if u8 else "int8"
+        ranges[name] = (0.0, sym_hi) if u8 else (-sym_hi, sym_hi)
+
     if calib_mode in ("naive", "entropy"):
         if data_iter is None:
             raise MXNetError(f"calib_mode={calib_mode!r} needs "
@@ -232,15 +255,17 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
             collected = collect_layer_outputs(
                 sym, arg_params, aux_params, data_iter, need_ranges,
                 num_calib_batches, data_name, label_name)
+            raw_lo = {name: min(float(c.min()) for c in chunks)
+                      for name, chunks in collected.items()}
             if calib_mode == "entropy":
-                ranges.update(calib_entropy(collected))
+                for name, (_, t) in calib_entropy(collected).items():
+                    _pick(name, raw_lo[name], t)
             else:
                 for name, chunks in collected.items():
                     amax = max(float(np.abs(c).max()) for c in chunks)
-                    ranges[name] = (-amax, amax)
+                    _pick(name, raw_lo[name], amax)
         for name, (lo, hi) in input_ranges.items():
-            amax = max(abs(lo), abs(hi))
-            ranges[name] = (-amax, amax)
+            _pick(name, lo, max(abs(lo), abs(hi)))
     elif calib_mode != "none":
         raise MXNetError(f"unknown calib_mode {calib_mode!r}")
 
@@ -288,7 +313,8 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
         if tname in ranges:
             lo, hi = ranges[tname]
             kw = {"min_calib_range": lo, "max_calib_range": hi}
-        qd = sym_mod.quantize_v2(ins[0], out_type="int8",
+        qd = sym_mod.quantize_v2(ins[0],
+                                 out_type=qdtype.get(tname, "int8"),
                                  name=node.name + "_quantize", **kw)
         qdata, dmin, dmax = qd[0], qd[1], qd[2]
         wsrc, _ = node.inputs[1]

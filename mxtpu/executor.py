@@ -71,6 +71,12 @@ class Executor:
         self._jit = _JIT_DEFAULT
         self._jit_cache: Dict[Tuple, Any] = {}
         self._last_call = None  # inputs of the last jitted forward
+        self._pending_grads = None
+        # observed backward style: "ones" (backward(None) — precompute
+        # grads fused with forward), "explicit" (caller supplies
+        # cotangents — forward runs outputs only), "none" (caller
+        # never calls backward — ditto)
+        self._bwd_mode = "ones"
 
     @staticmethod
     def _name_arrays(arrays, names, what, allow_missing=False):
@@ -232,12 +238,25 @@ class Executor:
         aux_vals = tuple(self.aux_dict[n].data
                          for n in entry["aux_names"])
         key_data = jax.random.key_data(_rnd._next_key(None))
-        if is_train and entry["rec_names"]:
+        if is_train and entry["rec_names"] and self._bwd_mode == "ones":
             # one program computes outputs AND default-cotangent grads
-            # (the common Module loop calls backward(None))
-            raw_outs, grads = entry["fwd_bwd_ones"](
-                train_vals, other_vals, aux_vals, key_data)
-            self._pending_grads = grads
+            # (the common Module loop calls backward(None)).  When the
+            # observed usage is explicit cotangents or no backward at
+            # all, _bwd_mode switches and forward runs outputs only —
+            # otherwise every explicit-cotangent step would pay a
+            # wasted ones-backward, and eval-style is_train forwards a
+            # whole wasted bwd (r3 advisor, executor.py finding).
+            if self._pending_grads is not None:
+                # previous forward's precomputed grads were never
+                # consumed: caller does not call backward
+                self._bwd_mode = "none"
+                raw_outs = entry["fwd"](train_vals, other_vals,
+                                        aux_vals, key_data)
+                self._pending_grads = None
+            else:
+                raw_outs, grads = entry["fwd_bwd_ones"](
+                    train_vals, other_vals, aux_vals, key_data)
+                self._pending_grads = grads
         else:
             raw_outs = entry["fwd"](train_vals, other_vals, aux_vals,
                                     key_data)
@@ -280,18 +299,26 @@ class Executor:
                 self._last_call
             if out_grads is None and self._pending_grads is not None:
                 grads = self._pending_grads  # computed with forward
+                self._pending_grads = None
+                self._bwd_mode = "ones"
             else:
                 if out_grads is None:
-                    import jax.numpy as jnp
-                    cots = tuple(jnp.ones_like(o.data)
-                                 for o in self._outputs)
+                    # caller uses backward(None) but forward ran
+                    # outputs-only (mode was explicit/none): recompute
+                    # fused and switch back for the next iteration
+                    self._bwd_mode = "ones"
+                    _, grads = entry["fwd_bwd_ones"](
+                        train_vals, other_vals, aux_vals, key_data)
                 else:
+                    self._bwd_mode = "explicit"
                     cots = tuple(
                         (g.data if isinstance(g, NDArray)
                          else nd_mod.array(g).data).astype(o.data.dtype)
                         for g, o in zip(out_grads, self._outputs))
-                _, grads = entry["fwd_bwd"](train_vals, other_vals,
-                                            aux_vals, key_data, cots)
+                    _, grads = entry["fwd_bwd"](train_vals, other_vals,
+                                                aux_vals, key_data,
+                                                cots)
+                self._pending_grads = None
             for name, g in zip(entry["rec_names"], grads):
                 self._store_grad(name, NDArray(g, None, _placed=True))
             return

@@ -289,9 +289,20 @@ register_op("_contrib_DeformablePSROIPooling", num_inputs=-1,
 
 def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
                sample_ratio=2, position_sensitive=False):
+    """DIVERGENCE vs reference (contrib/roi_align.cc†): the reference's
+    sample_ratio<=0 means ADAPTIVE sampling (ceil(roi_size/pooled) grid
+    points per bin, data-dependent) — impossible under XLA static
+    shapes, so it is approximated with a fixed 2x2 grid per bin (the
+    value detection configs hard-code anyway).  position_sensitive
+    (R-FCN-style channel splitting) is not implemented and raises
+    rather than silently ignoring the flag (r3 advisor)."""
+    if position_sensitive:
+        raise MXNetError(
+            "ROIAlign position_sensitive=True is not implemented; use "
+            "_contrib_PSROIPooling for position-sensitive pooling")
     ph, pw = int(pooled_size[0]), int(pooled_size[1])
     N, C, H, W = data.shape
-    s = max(int(sample_ratio), 1)
+    s = int(sample_ratio) if int(sample_ratio) > 0 else 2
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
@@ -466,7 +477,9 @@ def _scale_of(lo, hi, dtype):
 
 def _requantize(data, min_range, max_range, min_calib_range=None,
                 max_calib_range=None, out_type="int8"):
-    """int32 -> int8 given the int32's float range (requantize†)."""
+    """int32 -> int8/uint8 given the int32's float range
+    (requantize†).  uint8 output uses the shifted range [0, hi]
+    (zero-point 0, the post-ReLU convention of the uint8 tier)."""
     lo = min_range.reshape(())
     hi = max_range.reshape(())
     f = (data.astype(jnp.float32) /
@@ -474,18 +487,28 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     if min_calib_range is not None:
         lo = jnp.asarray(min_calib_range, jnp.float32)
         hi = jnp.asarray(max_calib_range, jnp.float32)
+        if out_type == "uint8":
+            lo = jnp.maximum(lo, 0.0)
+    elif out_type == "uint8":
+        lo = jnp.asarray(0.0, jnp.float32)
+        hi = jnp.maximum(f.max(), 1e-12)
     else:
         amax = jnp.maximum(jnp.abs(f).max(), 1e-12)
         lo, hi = -amax, amax
-    scale = _scale_of(lo, hi, jnp.int8)
-    q = jnp.clip(jnp.round(f * scale), -127, 127).astype(jnp.int8)
+    if out_type == "uint8":
+        scale = _scale_of(lo, hi, jnp.uint8)
+        q = jnp.clip(jnp.round(f * scale), 0, 255).astype(jnp.uint8)
+    else:
+        scale = _scale_of(lo, hi, jnp.int8)
+        q = jnp.clip(jnp.round(f * scale), -127, 127).astype(jnp.int8)
     return q, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
 
 
 register_op("_contrib_requantize", num_inputs=3, num_outputs=3,
             params=[Param("min_calib_range", float, None),
                     Param("max_calib_range", float, None),
-                    Param("out_type", str, "int8")],
+                    Param("out_type", str, "int8",
+                          enum=("int8", "uint8"))],
             aliases=("requantize",), differentiable=False)(_requantize)
 
 
@@ -524,8 +547,23 @@ def _quantized_conv(data, weight, *rest, kernel=(), stride=None,
     pad_t = _tuple(pad, nd) if pad is not None else (0,) * nd
     from .ops_impl import _CONV_DN
     layout = layout or {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    if data.dtype == jnp.uint8:
+        # uint8 activations use the shifted-range-with-zero-point-0
+        # convention (min_data == 0, the post-ReLU default — the
+        # reference's MKLDNN u8s8s32 tier ditto), so the accumulator
+        # stays scale-only.  conv_general_dilated requires matching
+        # operand dtypes; int16 holds u8 and s8 exactly.  A blind
+        # .astype(int8) would wrap 128..255 negative (r3 advisor).
+        lhs = data.astype(jnp.int16)
+        rhs = weight.astype(jnp.int16)
+    elif data.dtype == jnp.int8:
+        lhs = data
+        rhs = weight.astype(jnp.int8)
+    else:
+        raise MXNetError(
+            f"quantized_conv expects int8/uint8 data, got {data.dtype}")
     out = lax.conv_general_dilated(
-        data.astype(jnp.int8), weight.astype(jnp.int8),
+        lhs, rhs,
         window_strides=stride_t, padding=[(p, p) for p in pad_t],
         rhs_dilation=dilate_t,
         dimension_numbers=_CONV_DN[layout],
@@ -564,9 +602,16 @@ def _quantized_fully_connected(data, weight, *rest, num_hidden=0,
         bias = rest[0]
         mins_maxes = rest[1:]
     min_d, max_d, min_w, max_w = mins_maxes[:4]
+    if data.dtype not in (jnp.int8, jnp.uint8):
+        raise MXNetError(
+            f"quantized_fully_connected expects int8/uint8 data, got "
+            f"{data.dtype}")
     x = data.reshape(data.shape[0], -1) if flatten else data
+    # dot_general takes mixed u8 x s8 operands directly (uint8 keeps
+    # the zero-point-0 convention — see _quantized_conv); casting
+    # uint8 through int8 would wrap 128..255 negative (r3 advisor)
     out = lax.dot_general(
-        x.astype(jnp.int8), weight.astype(jnp.int8),
+        x, weight.astype(jnp.int8),
         (((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)
     unit, lo, hi = _q_out_range(min_d, max_d, min_w, max_w,

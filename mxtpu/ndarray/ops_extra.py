@@ -117,7 +117,10 @@ def _amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
 register_op("amp_multicast", num_inputs=-1,
             params=[Param("num_outputs", int, 0),
                     Param("cast_narrow", bool, False)],
-            num_outputs_fn=lambda attrs: int(attrs.get("num_outputs"))
+            # attrs reach num_outputs_fn without Param defaults applied
+            # — a missing attr must not TypeError (r3 advisor)
+            num_outputs_fn=lambda attrs: int(attrs.get("num_outputs")
+                                             or 1)
             )(_amp_multicast)
 
 
@@ -430,7 +433,7 @@ register_op("multi_mp_sgd_update", num_inputs=-1,
                     Param("rescale_grad", float, 1.0),
                     Param("clip_gradient", float, -1.0),
                     Param("num_weights", int, 0)],
-            num_outputs_fn=lambda attrs: 2 * int(attrs["num_weights"]),
+            num_outputs_fn=lambda attrs: 2 * int(attrs.get("num_weights") or 1),
             differentiable=False)(_multi_mp_sgd)
 
 
@@ -454,7 +457,7 @@ register_op("multi_mp_sgd_mom_update", num_inputs=-1,
                     Param("rescale_grad", float, 1.0),
                     Param("clip_gradient", float, -1.0),
                     Param("num_weights", int, 0)],
-            num_outputs_fn=lambda attrs: 3 * int(attrs["num_weights"]),
+            num_outputs_fn=lambda attrs: 3 * int(attrs.get("num_weights") or 1),
             differentiable=False)(_multi_mp_sgd_mom)
 
 

@@ -203,6 +203,9 @@ def _sample_multinomial(key, data, shape=(), get_prob=False,
             axis=-1,
             shape=(data.shape[0], n) if shape else (data.shape[0],))
     draw = draw.astype(jnp.dtype(dtype))
+    # reference output shape is data.shape[:-1] + shape (a
+    # multi-dimensional `shape` is NOT flattened into one axis)
+    out_shape = data.shape[:-1] + tuple(_shape(shape) if shape else ())
     if get_prob:
         lsm = jax.nn.log_softmax(logits, axis=-1)
         idx = draw.astype(jnp.int32)
@@ -212,8 +215,8 @@ def _sample_multinomial(key, data, shape=(), get_prob=False,
             lp = jnp.take_along_axis(
                 lsm, idx.reshape(data.shape[0], -1), axis=-1
             ).reshape(draw.shape)
-        return draw, lp
-    return draw
+        return draw.reshape(out_shape), lp.reshape(out_shape)
+    return draw.reshape(out_shape)
 
 
 register_op("_sample_multinomial", num_inputs=2, differentiable=False,

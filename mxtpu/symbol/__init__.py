@@ -591,8 +591,17 @@ def _num_outputs_of(op_name: str, n_inputs: int, attrs) -> int:
     except Exception:
         return 1
     if op.num_outputs_fn is not None:
-        return op.num_outputs_fn(
-            {k: _coerce_attr(v) for k, v in attrs.items()})
+        # apply Param defaults first so num_outputs_fn callbacks see
+        # resolved attrs, not raw ones — otherwise every callback must
+        # individually defend against missing keys (r4 review)
+        attrs_c = {k: _coerce_attr(v) for k, v in attrs.items()}
+        try:
+            attrs_c = op.resolve_params(
+                {k: v for k, v in attrs_c.items()
+                 if k in op.params.params})
+        except MXNetError:
+            pass  # bad attr values surface at execution time instead
+        return op.num_outputs_fn(attrs_c)
     if op.num_outputs == -1:
         if op_name in ("split", "SliceChannel"):
             return int(_coerce_attr(attrs.get("num_outputs", 1)))

@@ -125,3 +125,31 @@ def test_forward_backward_single_program_for_default_cotangent():
     assert exe._pending_grads is not None
     exe.backward()  # must not need another device program
     assert exe.grad_dict
+
+
+def test_executor_adaptive_backward_modes():
+    """forward(is_train)+backward(explicit cotangents) must produce the
+    same grads on every iteration, and after the executor adapts (it
+    stops precomputing ones-grads once it sees explicit cotangents /
+    no-backward usage — r3 advisor), backward(None) must still work."""
+    import numpy as np
+    from mxtpu import nd, sym
+    x = sym.Variable("x")
+    y = sym.sin(x * 2.0)
+    a = nd.array(np.linspace(-1, 1, 6).astype(np.float32))
+    exe = y.bind(None, {"x": a}, args_grad={"x": nd.zeros_like(a)})
+    cot = nd.array(np.full((6,), 0.5, np.float32))
+    want = 0.5 * 2.0 * np.cos(2.0 * np.linspace(-1, 1, 6))
+    for _ in range(3):  # repeat: mode flips to "explicit" after iter 1
+        exe.forward(is_train=True)
+        exe.backward(cot)
+        np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), want,
+                                   rtol=1e-5)
+    # eval-style forwards (never backward) — then a backward(None)
+    # arrives anyway and must still be correct
+    exe.forward(is_train=True)
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(
+        exe.grad_dict["x"].asnumpy(),
+        2.0 * np.cos(2.0 * np.linspace(-1, 1, 6)), rtol=1e-5)

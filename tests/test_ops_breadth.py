@@ -604,3 +604,40 @@ def test_amp_multicast_ints_pass_through():
     assert outs[0].dtype == jnp.float32
     assert outs[1].dtype == jnp.int32  # ints never vote or get cast
     assert outs[2].dtype == jnp.float32
+
+
+def test_multinomial_multidim_shape():
+    # reference: output shape is data.shape[:-1] + shape, NOT a
+    # flattened trailing axis (r3 advisor, random_ops.py)
+    key = jnp.asarray([0, 7], jnp.uint32)
+    p = jnp.asarray([[0.3, 0.7], [0.5, 0.5], [0.9, 0.1]])
+    d = get_op("_sample_multinomial")(key, p, shape=(4, 5))
+    assert d.shape == (3, 4, 5)
+    d1, lp1 = get_op("_sample_multinomial")(
+        key, p[0], shape=(2, 3), get_prob=True)
+    assert d1.shape == (2, 3) and lp1.shape == (2, 3)
+
+
+def test_num_outputs_fn_without_attrs():
+    # attrs reach num_outputs_fn without Param defaults applied; a
+    # missing attr must not raise (r3 advisor, ops_extra.py)
+    for name, factor in [("amp_multicast", 1),
+                         ("multi_mp_sgd_update", 2),
+                         ("multi_mp_sgd_mom_update", 3)]:
+        fn = get_op(name).num_outputs_fn
+        assert fn({}) == factor
+        assert fn({"num_outputs": 4, "num_weights": 4}) == 4 * factor
+
+
+def test_roi_align_position_sensitive_raises():
+    from mxtpu.base import MXNetError
+    data = jnp.ones((1, 4, 8, 8))
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 4.0, 4.0]])
+    with pytest.raises(MXNetError):
+        get_op("_contrib_ROIAlign")(data, rois, pooled_size=(2, 2),
+                                    position_sensitive=True)
+    # adaptive (sample_ratio<=0) approximates with a fixed 2x2 grid
+    out = get_op("_contrib_ROIAlign")(data, rois, pooled_size=(2, 2),
+                                      sample_ratio=-1)
+    assert out.shape == (1, 4, 2, 2)
+    assert bool(jnp.all(jnp.isfinite(out)))
