@@ -1,13 +1,20 @@
 """``mx.contrib`` (reference ``python/mxnet/contrib/``†):
-quantization calibration, ONNX interchange, ndarray contrib
-re-exports."""
+quantization calibration, text/vocabulary/embeddings, ONNX
+interchange, ndarray contrib re-exports."""
 from . import quantization
 from ..ndarray import contrib as ndarray  # mx.contrib.ndarray.* ops
 
-__all__ = ["quantization", "ndarray", "onnx"]
+__all__ = ["quantization", "ndarray", "onnx", "text"]
 
 
 def __getattr__(name):
+    if name == "text":
+        # lazy like onnx: numpy-heavy loaders stay off the hot
+        # `import mxtpu` path
+        import importlib
+        mod = importlib.import_module(__name__ + ".text")
+        globals()["text"] = mod
+        return mod
     if name == "onnx":
         # NOT `from . import onnx` — the fromlist getattr would
         # re-enter this hook and recurse

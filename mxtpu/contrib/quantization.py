@@ -241,6 +241,14 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
     qdtype: Dict[str, str] = {}
 
     def _pick(name, raw_lo, sym_hi):
+        if quantized_dtype == "uint8" and raw_lo < 0.0:
+            # silently clamping negative activations to 0 would wreck
+            # accuracy with no signal; the reference requires
+            # non-negative inputs for its u8 tier too
+            raise MXNetError(
+                f"quantized_dtype='uint8' but calibrated tensor "
+                f"{name!r} has negative minimum {raw_lo:.4g}; use "
+                f"'auto' (per-tensor choice) or 'int8'")
         u8 = (quantized_dtype == "uint8"
               or (quantized_dtype == "auto" and raw_lo >= 0.0))
         qdtype[name] = "uint8" if u8 else "int8"
@@ -255,8 +263,12 @@ def quantize_model(sym, arg_params: Dict[str, NDArray],
             collected = collect_layer_outputs(
                 sym, arg_params, aux_params, data_iter, need_ranges,
                 num_calib_batches, data_name, label_name)
+            # the min-scan only matters for the uint8 policy; keep the
+            # plain-int8 path free of the extra pass
             raw_lo = {name: min(float(c.min()) for c in chunks)
-                      for name, chunks in collected.items()}
+                      for name, chunks in collected.items()} \
+                if quantized_dtype in ("uint8", "auto") else \
+                {name: -1.0 for name in collected}
             if calib_mode == "entropy":
                 for name, (_, t) in calib_entropy(collected).items():
                     _pick(name, raw_lo[name], t)

@@ -1,0 +1,20 @@
+"""Text utilities: vocabulary, token indexing, pretrained embeddings
+(reference ``python/mxnet/contrib/text/``† — utils.py, vocab.py,
+embedding.py).
+
+DIVERGENCE: the reference downloads pretrained GloVe/fastText archives
+on demand; this environment has no network egress, so embeddings load
+from a local file path (``CustomEmbedding``-style) or from a directory
+given via ``embedding_root``.  File formats are compatible with the
+published GloVe (``token v1 .. vn``) and fastText (header line
+``count dim`` then rows) text formats.
+"""
+from . import embedding, utils, vocab
+from .embedding import (CompositeEmbedding, CustomEmbedding, FastText,
+                        GloVe, TokenEmbedding)
+from .utils import count_tokens_from_str
+from .vocab import Vocabulary
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary",
+           "count_tokens_from_str", "TokenEmbedding", "GloVe",
+           "FastText", "CustomEmbedding", "CompositeEmbedding"]

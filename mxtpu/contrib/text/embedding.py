@@ -1,0 +1,232 @@
+"""Pretrained token embeddings (reference
+``contrib/text/embedding.py``†): TokenEmbedding base + GloVe/FastText
+text-format loaders, CustomEmbedding, CompositeEmbedding.
+
+DIVERGENCE (documented): no network egress here, so nothing downloads;
+``GloVe``/``FastText`` read ``<embedding_root>/<file_name>`` that the
+user provides offline, with the published text formats:
+
+- GloVe:    each line ``token v1 v2 ... vn``
+- fastText: optional first line ``vocab_size dim`` header, then rows
+
+Unknown tokens vectorize through ``init_unknown_vec`` (zeros by
+default), matching the reference.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding", "get_pretrained_file_names"]
+
+_REGISTRY = {}
+
+
+def _register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def get_pretrained_file_names(embedding_name: Optional[str] = None):
+    """Known pretrained file names per embedding family (the
+    reference's catalogue; files must be provided offline)."""
+    cat = {
+        "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                  "glove.6B.200d.txt", "glove.6B.300d.txt",
+                  "glove.42B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.simple.vec", "wiki.en.vec"],
+    }
+    if embedding_name is None:
+        return cat
+    try:
+        return cat[embedding_name.lower()]
+    except KeyError:
+        raise MXNetError(f"unknown embedding family {embedding_name!r};"
+                         f" choices: {sorted(cat)}")
+
+
+class TokenEmbedding:
+    """Base: token -> vector store over an index (reference
+    ``_TokenEmbedding``†)."""
+
+    def __init__(self, unknown_token: str = "<unk>",
+                 init_unknown_vec: Callable = np.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token: List[str] = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec: Optional[np.ndarray] = None
+
+    # -- loading -------------------------------------------------------
+    def _load_embedding(self, path: str, elem_delim: str = " ",
+                        encoding: str = "utf8",
+                        skip_header: bool = False):
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"pretrained embedding file {path!r} not found; this "
+                f"build has no network egress — place the file there "
+                f"(published GloVe/fastText text formats)")
+        vecs: List[np.ndarray] = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip("\n").split(elem_delim)
+                if lineno == 0 and (skip_header or len(parts) == 2):
+                    continue  # fastText 'count dim' header
+                if len(parts) < 2:
+                    continue
+                tok = parts[0]
+                try:
+                    vec = np.asarray([float(x) for x in parts[1:] if x],
+                                     np.float32)
+                except ValueError:
+                    raise MXNetError(
+                        f"{path}:{lineno + 1}: malformed vector row")
+                if dim is None:
+                    dim = vec.size
+                elif vec.size != dim:
+                    raise MXNetError(
+                        f"{path}:{lineno + 1}: dim {vec.size} != {dim}")
+                if tok in self._token_to_idx:
+                    continue  # first occurrence wins (reference ditto)
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+                vecs.append(vec)
+        if dim is None:
+            raise MXNetError(f"no vectors found in {path!r}")
+        unk = np.asarray(self._init_unknown_vec((dim,)), np.float32)
+        self._idx_to_vec = np.vstack([unk[None, :]] + [v[None, :]
+                                                       for v in vecs])
+
+    # -- API -----------------------------------------------------------
+    @property
+    def vec_len(self) -> int:
+        return 0 if self._idx_to_vec is None \
+            else int(self._idx_to_vec.shape[1])
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        from ... import nd
+        return nd.array(self._idx_to_vec)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown
+        vector.  With ``lower_case_backup``, miss -> try lowercase."""
+        from ... import nd
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        out = self._idx_to_vec[np.asarray(idx, np.int64)]
+        return nd.array(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference semantics:
+        unknown tokens raise)."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors, np.float32)
+        arr = arr.reshape(len(tokens), -1)
+        for t, v in zip(tokens, arr):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is unknown; only known "
+                                 f"tokens can be updated")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+@_register
+class GloVe(TokenEmbedding):
+    """GloVe text-format loader (``glove.*.txt``)."""
+
+    def __init__(self, pretrained_file_name: str = "glove.6B.50d.txt",
+                 embedding_root: str = os.path.join(
+                     os.path.expanduser("~"), ".mxtpu", "embedding"),
+                 init_unknown_vec: Callable = np.zeros, **kwargs):
+        super().__init__(init_unknown_vec=init_unknown_vec, **kwargs)
+        self._load_embedding(
+            os.path.join(embedding_root, "glove",
+                         pretrained_file_name))
+
+
+@_register
+class FastText(TokenEmbedding):
+    """fastText ``.vec`` text-format loader (header line skipped)."""
+
+    def __init__(self, pretrained_file_name: str = "wiki.simple.vec",
+                 embedding_root: str = os.path.join(
+                     os.path.expanduser("~"), ".mxtpu", "embedding"),
+                 init_unknown_vec: Callable = np.zeros, **kwargs):
+        super().__init__(init_unknown_vec=init_unknown_vec, **kwargs)
+        self._load_embedding(
+            os.path.join(embedding_root, "fasttext",
+                         pretrained_file_name), skip_header=True)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Load any token-vector text file by explicit path (reference
+    ``CustomEmbedding``†)."""
+
+    def __init__(self, pretrained_file_path: str,
+                 elem_delim: str = " ", encoding: str = "utf8",
+                 init_unknown_vec: Callable = np.zeros, **kwargs):
+        super().__init__(init_unknown_vec=init_unknown_vec, **kwargs)
+        self._load_embedding(pretrained_file_path,
+                             elem_delim=elem_delim, encoding=encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Index a vocabulary into one or more TokenEmbeddings,
+    concatenating their vectors (reference ``CompositeEmbedding``†) —
+    the matrix that seeds ``gluon.nn.Embedding.weight``."""
+
+    def __init__(self, vocabulary: Vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXNetError("vocabulary must be a Vocabulary")
+        embs = token_embeddings if isinstance(
+            token_embeddings, (list, tuple)) else [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._vocabulary = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in embs:
+            if emb._idx_to_vec is None:
+                raise MXNetError("token_embeddings must be loaded")
+            rows = np.zeros((len(self._idx_to_token), emb.vec_len),
+                            np.float32)
+            for i, tok in enumerate(self._idx_to_token):
+                j = emb._token_to_idx.get(tok, 0)
+                rows[i] = emb._idx_to_vec[j]
+            parts.append(rows)
+        self._idx_to_vec = np.concatenate(parts, axis=1)
+
+    @property
+    def vocabulary(self) -> Vocabulary:
+        return self._vocabulary

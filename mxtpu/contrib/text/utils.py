@@ -1,0 +1,27 @@
+"""Token counting helpers (reference ``contrib/text/utils.py``†)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Optional
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str: str, token_delim: str = " ",
+                          seq_delim: str = "\n",
+                          to_lower: bool = False,
+                          counter_to_update: Optional[Counter] = None
+                          ) -> Counter:
+    """Count tokens in ``source_str`` split on ``token_delim`` and
+    ``seq_delim`` (reference semantics: both delimiters are literal
+    strings, empty tokens are dropped, counts accumulate into
+    ``counter_to_update`` when given)."""
+    source = source_str.lower() if to_lower else source_str
+    tokens = [t for t in
+              re.split(re.escape(token_delim) + "|"
+                       + re.escape(seq_delim), source) if t]
+    counter = counter_to_update if counter_to_update is not None \
+        else Counter()
+    counter.update(tokens)
+    return counter

@@ -1,0 +1,91 @@
+"""Vocabulary: token <-> index mapping (reference
+``contrib/text/vocab.py``†).
+
+Indexing contract (the reference's): index 0 is ``unknown_token``,
+reserved tokens follow, then counter tokens sorted by frequency
+(descending) with ties broken alphabetically; ``most_freq_count`` and
+``min_freq`` prune the counter part.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional, Sequence, Union
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    def __init__(self, counter: Optional[Counter] = None,
+                 most_freq_count: Optional[int] = None,
+                 min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must not repeat")
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token must not be a reserved "
+                             "token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token: List[str] = [unknown_token] + reserved_tokens
+        if counter is not None:
+            # frequency-descending, ties alphabetical — the reference's
+            # deterministic ordering
+            pairs = sorted(counter.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+            taken = set(self._idx_to_token)
+            kept = 0
+            for tok, freq in pairs:
+                if freq < min_freq:
+                    break
+                if most_freq_count is not None and \
+                        kept >= most_freq_count:
+                    break
+                if tok in taken:
+                    continue
+                self._idx_to_token.append(tok)
+                kept += 1
+        self._token_to_idx = {t: i
+                              for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens: Union[str, Sequence[str]]):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices: Union[int, Sequence[int]]):
+        single = not isinstance(indices, (list, tuple))
+        idxs = [indices] if single else list(indices)
+        out = []
+        for i in idxs:
+            if not 0 <= int(i) < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range "
+                                 f"[0, {len(self._idx_to_token)})")
+            out.append(self._idx_to_token[int(i)])
+        return out[0] if single else out

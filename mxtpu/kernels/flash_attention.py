@@ -22,10 +22,11 @@ VMEM scratch.  Memory stays O(T·D) per head; the O(T²) attention
 matrix is never materialised in either direction.
 
 Backward dispatch (``MXTPU_FLASH_BWD``): ``auto`` (default) picks AD
-through the fused lax reference below ~T=4096 — measured faster on
-v5e while the score tile fits — and the blockwise kernels past that
-(5.6× at T=8192, and the only option when O(T²) would blow HBM);
-``pallas``/``ref`` force a path.
+through the fused lax reference below T=1024 — measured faster on
+v5e while everything is floor-bound — and the blockwise kernels from
+T=1024 up (1.4×/2.2×/3.8× vs the fallback at T=1024/2048/4096 with
+512-blocks, r4 honest harness; and the only option when O(T²) would
+blow HBM); ``pallas``/``ref`` force a path.
 """
 from __future__ import annotations
 
@@ -156,8 +157,11 @@ def _precision_for(dtype):
 def _flash_forward(q3, k3, v3, causal, sm_scale, interpret):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
-    bq = _block(Tq, 128)
-    bk = _block(Tk, 128)
+    # 512-blocks: r4 measurement — 128-blocks made the grid 16x finer
+    # and each MXU dot tiny; 512 took T=2048 fwd+bwd from 16.1 to
+    # 5.1 ms (fallback: 10.9).  VMEM: s-tile 512^2 f32 = 1 MB.
+    bq = _block(Tq, 512)
+    bk = _block(Tk, 512)
     nq, nk = Tq // bq, Tk // bk
     kernel = functools.partial(_fa_kernel, sm_scale=sm_scale,
                                causal=causal, bq=bq, bk=bk, nk=nk,
@@ -289,8 +293,8 @@ def _flash_backward(q3, k3, v3, do3, lse, delta_rows, causal, sm_scale,
                     interpret):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
-    bq = _block(Tq, 128)
-    bk = _block(Tk, 128)
+    bq = _block(Tq, 512)
+    bk = _block(Tk, 512)
     nq, nk = Tq // bq, Tk // bk
     d = Tk - Tq
 
@@ -371,12 +375,13 @@ def _fa_bwd(causal, sm_scale, res, do):
         raise ValueError(
             f"MXTPU_FLASH_BWD={mode!r} not recognised; "
             f"choices: auto, pallas, ref")
-    # Measured on v5e: ref wins at T=2048, blockwise wins at T=4096
-    # (crossover between; threshold set at the measured winner) and is
-    # 5.6× faster at T=8192 — and the only option when the score
-    # matrix would blow HBM.
+    # Measured on v5e (r4, honest chained harness with 512-blocks):
+    # ref-bwd wins at T=512 (2.6 vs 3.5 ms), blockwise wins from
+    # T=1024 (2.9 vs 4.0 ms; 2.2x at 2048, 3.8x at 4096) — and is the
+    # only option when the score matrix would blow HBM.  (The r3
+    # threshold of 4096 came from the retracted per-dispatch harness.)
     use_pallas = mode == "pallas" or (
-        mode == "auto" and (max(Tq, Tk) >= 4096
+        mode == "auto" and (max(Tq, Tk) >= 1024
                             or B * H * Tq * Tk * 4 > 2 ** 31))
     if not use_pallas:
         _, vjp = jax.vjp(

@@ -114,14 +114,21 @@ def _amp_multicast(*arrays, num_outputs=0, cast_narrow=False):
                  for a in arrays)
 
 
+def _amp_multicast_n_outputs(attrs):
+    # output count IS the input count; a missing num_outputs attr must
+    # fail loudly, not silently declare 1 output for an N-output op
+    # (r4 review) — but with a clear message, not a TypeError
+    n = int(attrs.get("num_outputs") or 0)
+    if n <= 0:
+        raise MXNetError("amp_multicast requires num_outputs "
+                         "(= number of inputs)")
+    return n
+
+
 register_op("amp_multicast", num_inputs=-1,
             params=[Param("num_outputs", int, 0),
                     Param("cast_narrow", bool, False)],
-            # attrs reach num_outputs_fn without Param defaults applied
-            # — a missing attr must not TypeError (r3 advisor)
-            num_outputs_fn=lambda attrs: int(attrs.get("num_outputs")
-                                             or 1)
-            )(_amp_multicast)
+            num_outputs_fn=_amp_multicast_n_outputs)(_amp_multicast)
 
 
 def _all_finite(data, init_output=True):

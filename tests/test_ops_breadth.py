@@ -621,12 +621,18 @@ def test_multinomial_multidim_shape():
 def test_num_outputs_fn_without_attrs():
     # attrs reach num_outputs_fn without Param defaults applied; a
     # missing attr must not raise (r3 advisor, ops_extra.py)
-    for name, factor in [("amp_multicast", 1),
-                         ("multi_mp_sgd_update", 2),
+    for name, factor in [("multi_mp_sgd_update", 2),
                          ("multi_mp_sgd_mom_update", 3)]:
         fn = get_op(name).num_outputs_fn
-        assert fn({}) == factor
-        assert fn({"num_outputs": 4, "num_weights": 4}) == 4 * factor
+        assert fn({}) == factor  # degenerate 1-weight default
+        assert fn({"num_weights": 4}) == 4 * factor
+    # amp_multicast's output count is its input count — a missing
+    # num_outputs must fail loudly, not silently declare 1
+    from mxtpu.base import MXNetError
+    fn = get_op("amp_multicast").num_outputs_fn
+    assert fn({"num_outputs": 3}) == 3
+    with pytest.raises(MXNetError):
+        fn({})
 
 
 def test_roi_align_position_sensitive_raises():

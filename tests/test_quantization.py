@@ -220,3 +220,12 @@ def test_quantize_model_auto_picks_uint8_post_relu():
     outs = {n.attrs.get("out_type") for n in quants}
     # signed data -> int8 quantize; post-relu-pool fc input -> uint8
     assert outs == {"int8", "uint8"}, outs
+
+
+def test_quantize_model_uint8_rejects_negative_input():
+    from mxtpu.base import MXNetError
+    sym, args, X = _setup()  # X is signed (randn)
+    it = mio.NDArrayIter(X, None, batch_size=4)
+    with pytest.raises(MXNetError):
+        q.quantize_model(sym, args, {}, data_iter=it,
+                         calib_mode="naive", quantized_dtype="uint8")

@@ -1,0 +1,133 @@
+"""contrib.text: vocabulary, token indexing, pretrained-embedding
+composition (VERDICT r3 item 6; reference
+``python/mxnet/contrib/text/``†).  Embedding files are offline
+fixtures in the published GloVe/fastText text formats.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.base import MXNetError
+from mxtpu.contrib import text
+
+
+CORPUS = "the quick brown fox jumps over the lazy dog\n" \
+         "the dog barks at the fox\n"
+
+
+def test_count_tokens_from_str():
+    c = text.count_tokens_from_str(CORPUS)
+    assert c["the"] == 4 and c["fox"] == 2 and c["dog"] == 2
+    c2 = text.count_tokens_from_str("A a B", to_lower=True)
+    assert c2["a"] == 2 and c2["b"] == 1
+    base = text.count_tokens_from_str("x y")
+    text.count_tokens_from_str("y z", counter_to_update=base)
+    assert base["y"] == 2 and base["x"] == 1 and base["z"] == 1
+
+
+def test_vocabulary_ordering_and_indexing():
+    counter = text.count_tokens_from_str(CORPUS)
+    v = text.Vocabulary(counter, most_freq_count=None, min_freq=1,
+                        unknown_token="<unk>",
+                        reserved_tokens=["<pad>"])
+    # index 0 unknown, 1 reserved, then freq-desc alpha-tie order
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    assert v.idx_to_token[2] == "the"          # freq 4
+    assert set(v.idx_to_token[3:5]) == {"dog", "fox"}  # freq 2, alpha
+    assert v.idx_to_token[3] == "dog"
+    assert v.to_indices("the") == 2
+    assert v.to_indices(["the", "never-seen"]) == [2, 0]
+    assert v.to_tokens([2, 0]) == ["the", "<unk>"]
+    with pytest.raises(MXNetError):
+        v.to_tokens(len(v))
+    # pruning
+    v2 = text.Vocabulary(counter, most_freq_count=2)
+    assert len(v2) == 3  # unk + 2 kept
+    v3 = text.Vocabulary(counter, min_freq=2)
+    assert set(v3.idx_to_token[1:]) == {"the", "dog", "fox"}
+
+
+def _write_glove(path, tokens, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    vecs = {}
+    with open(path, "w") as f:
+        for t in tokens:
+            v = rng.randn(dim).astype(np.float32)
+            vecs[t] = v
+            f.write(t + " " + " ".join(f"{x:.6f}" for x in v) + "\n")
+    return vecs
+
+
+def test_custom_embedding_loads_glove_format(tmp_path):
+    p = tmp_path / "tiny.txt"
+    vecs = _write_glove(str(p), ["the", "fox", "dog"])
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 4 and len(emb) == 4  # + <unk>
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("fox").asnumpy(), vecs["fox"],
+        rtol=1e-5)
+    # unknown -> zeros (init_unknown_vec default)
+    assert np.all(emb.get_vecs_by_tokens("absent").asnumpy() == 0)
+    got = emb.get_vecs_by_tokens(["the", "absent", "dog"]).asnumpy()
+    assert got.shape == (3, 4)
+    np.testing.assert_allclose(got[2], vecs["dog"], rtol=1e-5)
+    # update_token_vectors
+    emb.update_token_vectors("the", nd.array(np.ones(4, np.float32)))
+    assert np.all(emb.get_vecs_by_tokens("the").asnumpy() == 1)
+    with pytest.raises(MXNetError):
+        emb.update_token_vectors("absent", nd.zeros((4,)))
+
+
+def test_glove_fasttext_roots(tmp_path):
+    root = tmp_path / "emb"
+    (root / "glove").mkdir(parents=True)
+    (root / "fasttext").mkdir()
+    _write_glove(str(root / "glove" / "glove.6B.50d.txt"),
+                 ["alpha", "beta"], dim=3)
+    # fastText format: header line then rows
+    with open(root / "fasttext" / "wiki.simple.vec", "w") as f:
+        f.write("2 3\n")
+        f.write("alpha 1 2 3\n")
+        f.write("gamma 4 5 6\n")
+    g = text.embedding.GloVe(embedding_root=str(root))
+    assert g.vec_len == 3 and "beta" in g.token_to_idx
+    ft = text.embedding.FastText(embedding_root=str(root))
+    assert ft.vec_len == 3
+    np.testing.assert_allclose(
+        ft.get_vecs_by_tokens("gamma").asnumpy(), [4, 5, 6])
+    with pytest.raises(MXNetError):
+        text.embedding.CustomEmbedding(str(root / "missing.txt"))
+    assert "glove.6B.300d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+
+
+def test_composite_embedding_with_nn_embedding(tmp_path):
+    """The VERDICT r3 'done' bar: vocab from a corpus + fixture
+    embedding composed into gluon nn.Embedding."""
+    p1, p2 = tmp_path / "a.txt", tmp_path / "b.txt"
+    v1 = _write_glove(str(p1), ["the", "fox", "dog"], dim=4, seed=1)
+    v2 = _write_glove(str(p2), ["the", "lazy"], dim=2, seed=2)
+    vocab = text.Vocabulary(text.count_tokens_from_str(CORPUS))
+    comp = text.CompositeEmbedding(
+        vocab, [text.embedding.CustomEmbedding(str(p1)),
+                text.embedding.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 6 and len(comp) == len(vocab)
+    i_fox = vocab.to_indices("fox")
+    np.testing.assert_allclose(
+        comp.idx_to_vec.asnumpy()[i_fox, :4], v1["fox"], rtol=1e-5)
+    np.testing.assert_allclose(
+        comp.idx_to_vec.asnumpy()[i_fox, 4:], 0.0)  # absent in b.txt
+
+    from mxtpu.gluon import nn
+    layer = nn.Embedding(len(vocab), comp.vec_len)
+    layer.initialize()
+    layer(nd.array(np.asarray([0], np.float32)))  # deferred init
+    layer.weight.set_data(comp.idx_to_vec)
+    idx = nd.array(np.asarray(
+        vocab.to_indices(["the", "fox", "nope"]), np.float32))
+    out = layer(idx).asnumpy()
+    np.testing.assert_allclose(out[1], comp.idx_to_vec.asnumpy()[i_fox],
+                               rtol=1e-5)
+    assert np.all(out[2] == 0)  # unknown row

