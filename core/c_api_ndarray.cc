@@ -1,0 +1,453 @@
+/*
+ * Training-tier C ABI implementation (reference
+ * src/c_api/c_api_ndarray.cc† rebuilt over the TPU runtime): embeds
+ * CPython and drives mxtpu.c_ndarray.  Same embedding discipline as
+ * c_predict_api.cc — numpy-free C side, tensors cross as PyBytes,
+ * works embedded in a plain C program or loaded into a live Python
+ * process.
+ */
+#include "c_api_ndarray.h"
+
+#include <Python.h>
+
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE
+#endif
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_nd_last_error;
+
+struct Array {
+  PyObject *obj = nullptr;          // mxtpu NDArray
+  std::vector<mx_uint> shape_buf;   // backs MXNDArrayGetShape
+};
+
+// thread-local result stores backing MXImperativeInvoke/MXNDArrayLoad
+thread_local std::vector<NDArrayHandle> g_invoke_out;
+thread_local std::vector<NDArrayHandle> g_load_arrs;
+thread_local std::vector<std::string> g_load_name_store;
+thread_local std::vector<const char *> g_load_names;
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_nd_last_error = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) g_nd_last_error = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+std::once_flag g_nd_init_once;
+
+bool ensure_interpreter() {
+  std::call_once(g_nd_init_once, []() {
+    if (Py_IsInitialized()) return;
+    // When this library is dlopen()ed by a non-Python host (perl XS,
+    // a C program using dlopen), libpython arrives RTLD_LOCAL and
+    // Python's own extension modules (math, numpy) fail with
+    // undefined PyFloat_Type etc.  Find libpython via a symbol we
+    // link against and re-open it RTLD_GLOBAL before initializing.
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info)
+        != 0 && info.dli_fname != nullptr) {
+      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+    }
+    Py_InitializeEx(0);
+    if (Py_IsInitialized()) PyEval_SaveThread();
+  });
+  if (!Py_IsInitialized()) {
+    g_nd_last_error = "failed to initialize embedded Python";
+    return false;
+  }
+  return true;
+}
+
+PyObject *helper(const char *fn) {
+  PyObject *mod = PyImport_ImportModule("mxtpu.c_ndarray");
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) set_error_from_python();
+  return f;
+}
+
+// call mxtpu.c_ndarray.<fn>(*args); steals nothing, returns new ref
+PyObject *call_helper(const char *fn, PyObject *args) {
+  PyObject *f = helper(fn);
+  if (f == nullptr) return nullptr;
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+PyObject *shape_tuple(const mx_uint *shape, mx_uint ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  if (t == nullptr) return nullptr;
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyObject *v = PyLong_FromUnsignedLong(shape[i]);
+    if (v == nullptr) {
+      Py_DECREF(t);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(t, i, v);
+  }
+  return t;
+}
+
+Array *as_array(NDArrayHandle h) { return static_cast<Array *>(h); }
+
+NDArrayHandle wrap(PyObject *obj) {
+  Array *a = new Array();
+  a->obj = obj;  // takes the reference
+  return a;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXNDGetLastError(void) { return g_nd_last_error.c_str(); }
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle *out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  if (out == nullptr || (shape == nullptr && ndim > 0)) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *st = shape_tuple(shape, ndim);
+  if (st == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(Oi)", st, dtype);
+  Py_DECREF(st);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("create", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap(r);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  Array *a = as_array(handle);
+  if (Py_IsInitialized()) {
+    GIL gil;
+    Py_XDECREF(a->obj);
+  }
+  delete a;
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  if (handle == nullptr || (data == nullptr && size > 0)) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  Array *a = as_array(handle);
+  // element size from the dtype code; shape/dtype via helpers
+  PyObject *args1 = Py_BuildValue("(O)", a->obj);
+  if (args1 == nullptr) { set_error_from_python(); return -1; }
+  PyObject *code = call_helper("dtype_code_of", args1);
+  PyObject *shp = call_helper("shape_of", args1);
+  Py_DECREF(args1);
+  if (code == nullptr || shp == nullptr) {
+    Py_XDECREF(code);
+    Py_XDECREF(shp);
+    return -1;
+  }
+  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 1};
+  long c = PyLong_AsLong(code);
+  Py_DECREF(code);
+  size_t nbytes = size * (c >= 0 && c <= 7 ? esize[c] : 4);
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data),
+      static_cast<Py_ssize_t>(nbytes));
+  PyObject *args = blob != nullptr
+      ? Py_BuildValue("(OlN)", shp, c, blob) : nullptr;
+  Py_DECREF(shp);
+  if (args == nullptr) {
+    Py_XDECREF(blob);
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("from_bytes", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_XDECREF(a->obj);
+  a->obj = r;  // rebinding IS the reference's write semantics here
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           size_t size) {
+  if (handle == nullptr || data == nullptr) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  Array *a = as_array(handle);
+  PyObject *args = Py_BuildValue("(O)", a->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *blob = call_helper("to_bytes", args);
+  PyObject *code = call_helper("dtype_code_of", args);
+  Py_DECREF(args);
+  if (blob == nullptr || code == nullptr) {
+    Py_XDECREF(blob);
+    Py_XDECREF(code);
+    return -1;
+  }
+  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 1};
+  long c = PyLong_AsLong(code);
+  Py_DECREF(code);
+  size_t want = size * (c >= 0 && c <= 7 ? esize[c] : 4);
+  char *buf = nullptr;
+  Py_ssize_t blen = 0;
+  if (PyBytes_AsStringAndSize(blob, &buf, &blen) != 0) {
+    set_error_from_python();
+    Py_DECREF(blob);
+    return -1;
+  }
+  if (static_cast<size_t>(blen) < want) {
+    g_nd_last_error = "copy size exceeds array size";
+    Py_DECREF(blob);
+    return -1;
+  }
+  std::memcpy(data, buf, want);
+  Py_DECREF(blob);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  if (handle == nullptr || out_dim == nullptr || out_pdata == nullptr) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  Array *a = as_array(handle);
+  PyObject *args = Py_BuildValue("(O)", a->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *shp = call_helper("shape_of", args);
+  Py_DECREF(args);
+  if (shp == nullptr) return -1;
+  a->shape_buf.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(shp); ++i) {
+    a->shape_buf.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i))));
+  }
+  Py_DECREF(shp);
+  *out_dim = static_cast<mx_uint>(a->shape_buf.size());
+  *out_pdata = a->shape_buf.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  if (handle == nullptr || out_dtype == nullptr) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  Array *a = as_array(handle);
+  PyObject *args = Py_BuildValue("(O)", a->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *code = call_helper("dtype_code_of", args);
+  Py_DECREF(args);
+  if (code == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(code));
+  Py_DECREF(code);
+  return 0;
+}
+
+int NNGetOpHandle(const char *op_name, OpHandle *out) {
+  if (op_name == nullptr || out == nullptr) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  // validated lazily at invoke time (keeps this callable before the
+  // interpreter exists); the handle is just the interned name
+  *out = new std::string(op_name);
+  return 0;
+}
+
+int MXImperativeInvoke(OpHandle op, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys,
+                       const char **param_vals) {
+  if (op == nullptr || num_outputs == nullptr || outputs == nullptr ||
+      (num_inputs > 0 && inputs == nullptr) ||
+      (num_params > 0 &&
+       (param_keys == nullptr || param_vals == nullptr))) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  const std::string *name = static_cast<std::string *>(op);
+  PyObject *ins = PyList_New(num_inputs);
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  bool ok = ins != nullptr && keys != nullptr && vals != nullptr;
+  for (int i = 0; ok && i < num_inputs; ++i) {
+    PyObject *o = as_array(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  for (int i = 0; ok && i < num_params; ++i) {
+    PyObject *k = PyUnicode_FromString(param_keys[i]);
+    PyObject *v = PyUnicode_FromString(param_vals[i]);
+    if (k == nullptr || v == nullptr) {
+      ok = false;
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      break;
+    }
+    PyList_SET_ITEM(keys, i, k);
+    PyList_SET_ITEM(vals, i, v);
+  }
+  PyObject *args = ok ? Py_BuildValue("(sOOO)", name->c_str(), ins,
+                                      keys, vals)
+                      : nullptr;
+  Py_XDECREF(ins);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  g_invoke_out.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    g_invoke_out.push_back(wrap(o));
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(g_invoke_out.size());
+  *outputs = g_invoke_out.data();
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args_in, const char **keys) {
+  if (fname == nullptr || (num_args > 0 && args_in == nullptr)) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *handles = PyList_New(num_args);
+  PyObject *names = keys != nullptr ? PyList_New(num_args) : Py_None;
+  bool ok = handles != nullptr && names != nullptr;
+  if (names == Py_None) Py_INCREF(Py_None);
+  for (mx_uint i = 0; ok && i < num_args; ++i) {
+    PyObject *o = as_array(args_in[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(handles, i, o);
+    if (keys != nullptr) {
+      PyObject *k = PyUnicode_FromString(keys[i]);
+      if (k == nullptr) { ok = false; break; }
+      PyList_SET_ITEM(names, i, k);
+    }
+  }
+  PyObject *args = ok ? Py_BuildValue("(sOO)", fname, handles, names)
+                      : nullptr;
+  Py_XDECREF(handles);
+  Py_XDECREF(names);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("save", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  if (fname == nullptr || out_size == nullptr || out_arr == nullptr ||
+      out_name_size == nullptr || out_names == nullptr) {
+    g_nd_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", fname);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("load", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  g_load_arrs.clear();
+  g_load_name_store.clear();
+  g_load_names.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(arrs); ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);
+    g_load_arrs.push_back(wrap(o));
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+    g_load_name_store.emplace_back(s != nullptr ? s : "");
+  }
+  for (const std::string &s : g_load_name_store)
+    g_load_names.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(g_load_arrs.size());
+  *out_arr = g_load_arrs.data();
+  *out_name_size = static_cast<mx_uint>(g_load_names.size());
+  *out_names = g_load_names.data();
+  return 0;
+}
+
+}  // extern "C"

@@ -12,6 +12,11 @@
 
 #include <Python.h>
 
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE
+#endif
+#include <dlfcn.h>
+
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -60,6 +65,16 @@ bool ensure_interpreter() {
   // concurrently must not both run Py_InitializeEx (UB)
   std::call_once(g_init_once, []() {
     if (Py_IsInitialized()) return;
+    // When this library is dlopen()ed by a non-Python host (perl XS,
+    // a C program using dlopen), libpython arrives RTLD_LOCAL and
+    // Python's own extension modules (math, numpy) fail with
+    // undefined PyFloat_Type etc.  Find libpython via a symbol we
+    // link against and re-open it RTLD_GLOBAL before initializing.
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info)
+        != 0 && info.dli_fname != nullptr) {
+      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+    }
     Py_InitializeEx(0);
     if (Py_IsInitialized()) {
       // the embedding thread owns the GIL after Py_Initialize;

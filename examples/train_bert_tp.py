@@ -76,8 +76,7 @@ def build(args, mesh, init_vals=None):
     net.initialize(init="xavier")
     net(nd.array(np.zeros((2, args.seq_len), np.float32)))
     if init_vals is not None:
-        for p, v in zip(net.collect_params().values(), init_vals):
-            p.set_data(nd.array(v))
+        parallel.restore_params(net, init_vals)
 
     def mlm_loss(pred, y):
         return gloss.SoftmaxCrossEntropyLoss()(
@@ -120,8 +119,7 @@ def main():
 
     mx.random.seed(0)
     net, step = build(args, mesh)
-    init_vals = [p.data().asnumpy()
-                 for p in net.collect_params().values()]
+    init_vals = parallel.snapshot_params(net)
 
     rng = np.random.RandomState(0)
     toks = nd.array(rng.randint(0, args.vocab,
@@ -143,9 +141,12 @@ def main():
     qkv = [p for p in net.collect_params().values()
            if p.shape is not None and len(p.shape) == 2
            and p.shape[0] > p.shape[1]]
-    assert qkv and len(qkv[0].data().data.sharding.device_set) == n
-    logging.info("TP sharding verified: %s over %d devices",
-                 qkv[0].name, n)
+    spec = qkv[0].data().data.sharding.spec
+    # a replicated sharding also spans every device; the SPEC naming
+    # the mp axis is what proves tensor parallelism engaged
+    assert "mp" in jax.tree_util.tree_leaves(tuple(spec)), spec
+    logging.info("TP sharding verified: %s spec=%s over %d devices",
+                 qkv[0].name, tuple(spec), n)
 
     if args.parity:
         _, ref_step = build(args, mesh=None, init_vals=init_vals)

@@ -78,8 +78,12 @@ class TokenEmbedding:
         with io.open(path, "r", encoding=encoding) as f:
             for lineno, line in enumerate(f):
                 parts = line.rstrip("\n").split(elem_delim)
-                if lineno == 0 and (skip_header or len(parts) == 2):
-                    continue  # fastText 'count dim' header
+                if lineno == 0 and (skip_header or (
+                        len(parts) == 2
+                        and all(x.isdigit() for x in parts))):
+                    # fastText 'count dim' header: BOTH fields integral
+                    # — a dim-1 embedding row like "a 1.0" is data
+                    continue
                 if len(parts) < 2:
                     continue
                 tok = parts[0]

@@ -35,9 +35,34 @@ from ..ops.registry import get_op
 __all__ = ["make_mesh", "shard_batch", "replicate", "TrainStep",
            "build_train_step", "Mesh", "PartitionSpec", "P",
            "spmd_pipeline", "stack_stage_params", "PipelineTrainStep",
-           "build_pipeline_train_step"]
+           "build_pipeline_train_step", "snapshot_params",
+           "restore_params"]
 
 PartitionSpec = P
+
+
+def snapshot_params(net):
+    """Parameter values of ``net`` in collect_params() order (a list
+    of numpy arrays).  Pairs with :func:`restore_params` to clone one
+    net's init into another INSTANCE of the same architecture: block
+    auto-naming gives every instance fresh prefixes, so values must be
+    carried by position, not name — keeping that subtle assumption in
+    one place (r4 review)."""
+    return [p.data().asnumpy() for p in net.collect_params().values()]
+
+
+def restore_params(net, values):
+    """Set ``net``'s parameters from a :func:`snapshot_params` list
+    (same architecture, any instance).  The net must already be
+    shape-initialised (run one forward first for deferred blocks)."""
+    from .. import nd as _nd
+    params = list(net.collect_params().values())
+    if len(params) != len(values):
+        raise ValueError(
+            f"parameter count mismatch: net has {len(params)}, "
+            f"snapshot has {len(values)} — not the same architecture")
+    for p, v in zip(params, values):
+        p.set_data(_nd.array(v))
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:

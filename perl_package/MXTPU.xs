@@ -1,0 +1,192 @@
+/*
+ * AI::MXTPU — minimal Perl frontend (reference ``perl-package/``†
+ * AI::MXNet analog) over the training-tier C ABI
+ * (core/c_api_ndarray.h): NDArray create/copy/query, registry-op
+ * invoke, save/load.  Built by perl_package/build.sh via xsubpp.
+ *
+ * Perl-side API (lib/AI/MXTPU.pm wraps these _xs functions in an OO
+ * layer): handles are opaque IVs owned by AI::MXTPU::NDArray.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "c_api_ndarray.h"
+
+static void croak_last(pTHX_ const char *what) {
+  croak("%s: %s", what, MXNDGetLastError());
+}
+
+MODULE = AI::MXTPU  PACKAGE = AI::MXTPU
+
+PROTOTYPES: DISABLE
+
+IV
+_xs_create(shape_av, dtype)
+    AV *shape_av
+    int dtype
+  CODE:
+    {
+      mx_uint shape[32];
+      mx_uint ndim = (mx_uint)(av_len(shape_av) + 1);
+      NDArrayHandle h;
+      mx_uint i;
+      if (ndim > 32) croak("too many dimensions");
+      for (i = 0; i < ndim; ++i) {
+        SV **e = av_fetch(shape_av, i, 0);
+        shape[i] = (mx_uint)SvUV(e ? *e : &PL_sv_undef);
+      }
+      if (MXNDArrayCreate(shape, ndim, 1, 0, 0, dtype, &h) != 0)
+        croak_last(aTHX_ "MXNDArrayCreate");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_xs_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+_xs_copy_from(h, data_av)
+    IV h
+    AV *data_av
+  CODE:
+    {
+      size_t n = (size_t)(av_len(data_av) + 1);
+      float *buf;
+      size_t i;
+      Newx(buf, n, float);
+      for (i = 0; i < n; ++i) {
+        SV **e = av_fetch(data_av, i, 0);
+        buf[i] = (float)SvNV(e ? *e : &PL_sv_undef);
+      }
+      if (MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf, n)
+          != 0) {
+        Safefree(buf);
+        croak_last(aTHX_ "MXNDArraySyncCopyFromCPU");
+      }
+      Safefree(buf);
+    }
+
+void
+_xs_copy_to(h, n)
+    IV h
+    UV n
+  PPCODE:
+    {
+      float *buf;
+      UV i;
+      Newx(buf, n, float);
+      if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n)
+          != 0) {
+        Safefree(buf);
+        croak_last(aTHX_ "MXNDArraySyncCopyToCPU");
+      }
+      EXTEND(SP, (SSize_t)n);
+      for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVnv(buf[i])));
+      Safefree(buf);
+    }
+
+void
+_xs_shape(h)
+    IV h
+  PPCODE:
+    {
+      mx_uint ndim = 0, i;
+      const mx_uint *shp = NULL;
+      if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, &shp)
+          != 0)
+        croak_last(aTHX_ "MXNDArrayGetShape");
+      EXTEND(SP, (SSize_t)ndim);
+      for (i = 0; i < ndim; ++i)
+        PUSHs(sv_2mortal(newSVuv(shp[i])));
+    }
+
+void
+_xs_invoke(op_name, in_av, keys_av, vals_av)
+    char *op_name
+    AV *in_av
+    AV *keys_av
+    AV *vals_av
+  PPCODE:
+    {
+      OpHandle op;
+      NDArrayHandle ins[64];
+      NDArrayHandle *outs = NULL;
+      const char *keys[64];
+      const char *vals[64];
+      int n_in = (int)(av_len(in_av) + 1);
+      int n_par = (int)(av_len(keys_av) + 1);
+      int n_out = 0, i;
+      if (n_in > 64 || n_par > 64) croak("too many inputs/params");
+      if (NNGetOpHandle(op_name, &op) != 0)
+        croak_last(aTHX_ "NNGetOpHandle");
+      for (i = 0; i < n_in; ++i) {
+        SV **e = av_fetch(in_av, i, 0);
+        ins[i] = INT2PTR(NDArrayHandle, SvIV(e ? *e : &PL_sv_undef));
+      }
+      for (i = 0; i < n_par; ++i) {
+        SV **k = av_fetch(keys_av, i, 0);
+        SV **v = av_fetch(vals_av, i, 0);
+        keys[i] = SvPV_nolen(k ? *k : &PL_sv_undef);
+        vals[i] = SvPV_nolen(v ? *v : &PL_sv_undef);
+      }
+      if (MXImperativeInvoke(op, n_in, ins, &n_out, &outs, n_par,
+                             keys, vals) != 0)
+        croak_last(aTHX_ "MXImperativeInvoke");
+      EXTEND(SP, (SSize_t)n_out);
+      for (i = 0; i < n_out; ++i)
+        PUSHs(sv_2mortal(newSViv(PTR2IV(outs[i]))));
+    }
+
+void
+_xs_save(fname, handles_av, keys_av)
+    char *fname
+    AV *handles_av
+    AV *keys_av
+  CODE:
+    {
+      NDArrayHandle hs[256];
+      const char *keys[256];
+      mx_uint n = (mx_uint)(av_len(handles_av) + 1);
+      int with_keys = av_len(keys_av) + 1 > 0;
+      mx_uint i;
+      if (n > 256) croak("too many arrays");
+      for (i = 0; i < n; ++i) {
+        SV **e = av_fetch(handles_av, i, 0);
+        hs[i] = INT2PTR(NDArrayHandle, SvIV(e ? *e : &PL_sv_undef));
+        if (with_keys) {
+          SV **k = av_fetch(keys_av, i, 0);
+          keys[i] = SvPV_nolen(k ? *k : &PL_sv_undef);
+        }
+      }
+      if (MXNDArraySave(fname, n, hs, with_keys ? keys : NULL) != 0)
+        croak_last(aTHX_ "MXNDArraySave");
+    }
+
+void
+_xs_load(fname)
+    char *fname
+  PPCODE:
+    {
+      mx_uint n_arr = 0, n_names = 0, i;
+      NDArrayHandle *arrs = NULL;
+      const char **names = NULL;
+      AV *h_av;
+      AV *n_av;
+      if (MXNDArrayLoad(fname, &n_arr, &arrs, &n_names, &names) != 0)
+        croak_last(aTHX_ "MXNDArrayLoad");
+      h_av = newAV();
+      n_av = newAV();
+      for (i = 0; i < n_arr; ++i)
+        av_push(h_av, newSViv(PTR2IV(arrs[i])));
+      for (i = 0; i < n_names; ++i)
+        av_push(n_av, newSVpv(names[i], 0));
+      EXTEND(SP, 2);
+      PUSHs(sv_2mortal(newRV_noinc((SV *)h_av)));
+      PUSHs(sv_2mortal(newRV_noinc((SV *)n_av)));
+    }

@@ -1,0 +1,27 @@
+#!/bin/sh
+# Build AI::MXTPU's XS glue against the training-tier C ABI.
+# Usage: sh perl_package/build.sh [python]
+# (the python that owns libmxtpu_ndarray's embedded interpreter)
+set -e
+cd "$(dirname "$0")"
+PY="${1:-python3}"
+
+# the C ABI library must exist first
+make -C ../core ndarray "PYTHON=$PY"
+
+ARCHLIB=$(perl -MConfig -e 'print $Config{archlibexp}')
+CCFLAGS=$(perl -MConfig -e 'print $Config{ccflags}')
+
+xsubpp -typemap "$(perl -MConfig -e \
+  'print $Config{privlibexp}')/ExtUtils/typemap" MXTPU.xs > MXTPU.c
+
+# DynaLoader looks for auto/AI/MXTPU/MXTPU.so under @INC, so the
+# shared object lands inside lib/; rpath the core dir so it finds
+# libmxtpu_ndarray at runtime
+mkdir -p lib/auto/AI/MXTPU
+gcc -O2 -shared -fPIC $CCFLAGS \
+  -I"$ARCHLIB/CORE" -I../core \
+  MXTPU.c -L../core -lmxtpu_ndarray \
+  -Wl,-rpath,"$(cd ../core && pwd)" \
+  -o lib/auto/AI/MXTPU/MXTPU.so
+echo "built perl_package/lib/auto/AI/MXTPU/MXTPU.so"

@@ -1,0 +1,55 @@
+#!/usr/bin/perl
+# Train a linear model from Perl through AI::MXTPU (the same
+# least-squares task as core/train_example.c, proving the C ABI
+# serves a dynamic third language).
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use AI::MXTPU;
+
+my ($N, $D) = (64, 4);
+my @wstar = (1.0, 2.0, -1.0, 0.5);
+
+# fixed LCG data, same as the C example
+my ($s, @x, @y) = (12345);
+for my $i (0 .. $N * $D - 1) {
+    $s = ($s * 1103515245 + 12345) % (2**32);
+    push @x, (($s >> 16) & 0x7fff) / 16384.0 - 1.0;
+}
+for my $i (0 .. $N - 1) {
+    my $v = 0;
+    $v += $x[$i * $D + $_] * $wstar[$_] for 0 .. $D - 1;
+    push @y, $v;
+}
+
+my $X = AI::MXTPU::NDArray->from_list([$N, $D], \@x);
+my $Y = AI::MXTPU::NDArray->from_list([$N, 1], \@y);
+my $w = AI::MXTPU::NDArray->zeros([$D, 1]);
+my ($Xt) = AI::MXTPU::invoke("transpose", [$X]);
+
+my ($first, $loss);
+for my $step (0 .. 9) {
+    my ($pred) = AI::MXTPU::invoke("dot",          [$X, $w]);
+    my ($diff) = AI::MXTPU::invoke("elemwise_sub", [$pred, $Y]);
+    my ($sq)   = AI::MXTPU::invoke("square",       [$diff]);
+    my ($ml)   = AI::MXTPU::invoke("mean",         [$sq]);
+    $loss = $ml->asscalar;
+    $first = $loss if $step == 0;
+    my ($g0) = AI::MXTPU::invoke("dot", [$Xt, $diff]);
+    my ($g)  = AI::MXTPU::invoke("_mul_scalar", [$g0],
+                                 { scalar => 2.0 / $N });
+    ($w) = AI::MXTPU::invoke("sgd_update", [$w, $g],
+                             { lr => 0.5, wd => 0.0 });
+    printf "step %d loss %.6f\n", $step, $loss;
+}
+die "loss did not converge ($first -> $loss)\n"
+    unless $loss < $first * 0.05;
+
+AI::MXTPU::save("/tmp/perl_train_w.params", [$w], ["w"]);
+my ($arrs, $names) = AI::MXTPU::load("/tmp/perl_train_w.params");
+die "load mismatch\n"
+    unless @$arrs == 1 && $names->[0] eq "w";
+my @wv = @{ $arrs->[0]->aslist };
+printf "perl frontend OK: loss %.6f -> %.6f; w ~ [%s]\n",
+    $first, $loss, join(" ", map { sprintf "%.2f", $_ } @wv);

@@ -1,0 +1,92 @@
+package AI::MXTPU;
+# AI::MXTPU — minimal Perl frontend (reference perl-package/
+# AI::MXNet† analog) over the training-tier C ABI.  See
+# AI::MXTPU::NDArray for the OO tensor surface; AI::MXTPU::invoke
+# runs any registry operator imperatively.
+use strict;
+use warnings;
+use DynaLoader ();
+
+our $VERSION = '0.1';
+our @ISA = ('DynaLoader');
+
+# build.sh puts MXTPU.so next to this tree; let bootstrap find it
+__PACKAGE__->bootstrap($VERSION);
+
+package AI::MXTPU::NDArray;
+use strict;
+use warnings;
+
+sub _wrap {
+    my ($class, $handle) = @_;
+    return bless { h => $handle }, $class;
+}
+
+# AI::MXTPU::NDArray->zeros([2,3])  (float32)
+sub zeros {
+    my ($class, $shape) = @_;
+    return $class->_wrap(AI::MXTPU::_xs_create($shape, 0));
+}
+
+# AI::MXTPU::NDArray->from_list([2,3], [1..6])
+sub from_list {
+    my ($class, $shape, $data) = @_;
+    my $self = $class->zeros($shape);
+    AI::MXTPU::_xs_copy_from($self->{h}, $data);
+    return $self;
+}
+
+sub shape {
+    my ($self) = @_;
+    return [AI::MXTPU::_xs_shape($self->{h})];
+}
+
+sub size {
+    my ($self) = @_;
+    my $n = 1;
+    $n *= $_ for @{$self->shape};
+    return $n;
+}
+
+sub aslist {
+    my ($self) = @_;
+    return [AI::MXTPU::_xs_copy_to($self->{h}, $self->size)];
+}
+
+sub asscalar {
+    my ($self) = @_;
+    return $self->aslist->[0];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::_xs_free($self->{h}) if defined $self->{h};
+}
+
+package AI::MXTPU;
+
+# AI::MXTPU::invoke("dot", [$a, $b], { transpose_b => 1 })
+# -> list of NDArrays
+sub invoke {
+    my ($op, $inputs, $params) = @_;
+    $params ||= {};
+    my @keys = sort keys %$params;
+    my @vals = map { "" . $params->{$_} } @keys;
+    my @hs = map { $_->{h} } @$inputs;
+    my @out = AI::MXTPU::_xs_invoke($op, \@hs, \@keys, \@vals);
+    return map { AI::MXTPU::NDArray->_wrap($_) } @out;
+}
+
+sub save {
+    my ($fname, $arrays, $names) = @_;
+    my @hs = map { $_->{h} } @$arrays;
+    AI::MXTPU::_xs_save($fname, \@hs, $names || []);
+}
+
+sub load {
+    my ($fname) = @_;
+    my ($hs, $names) = AI::MXTPU::_xs_load($fname);
+    return ([map { AI::MXTPU::NDArray->_wrap($_) } @$hs], $names);
+}
+
+1;

@@ -1,0 +1,34 @@
+"""Perl frontend (reference ``perl-package/``† AI::MXNet, minimal):
+XS bindings over the training-tier C ABI train a linear model from
+Perl end-to-end.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PERL = os.path.join(_ROOT, "perl_package")
+
+
+def test_perl_trains_linear_model():
+    if shutil.which("perl") is None or \
+            shutil.which("xsubpp") is None or \
+            shutil.which("gcc") is None:
+        pytest.skip("perl/xsubpp/gcc not available")
+    r = subprocess.run(["sh", os.path.join(_PERL, "build.sh"),
+                        sys.executable],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-1500:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        ["perl", os.path.join(_PERL, "examples", "train_linear.pl")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, \
+        f"stdout:{r.stdout[-800:]}\nstderr:{r.stderr[-800:]}"
+    assert "perl frontend OK" in r.stdout, r.stdout[-800:]
+    assert r.stdout.count("step ") == 10

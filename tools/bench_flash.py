@@ -41,8 +41,14 @@ def run(T, B=4, H=16, D=64, j=4):
     q = jax.random.normal(key, shape, jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), shape, jnp.bfloat16)
-    # attention fwd+bwd flops ~= 3 * (4*T^2*D) per (b,h) pair
-    fl = 3 * 4 * T * T * D * B * H * j
+    # attention fwd+bwd flops ~= 3 * (4*T^2*D) per (b,h) pair.
+    # CAUSAL convention: the flash kernel skips blocks strictly above
+    # the diagonal (~T^2/2 executed) while the fallback computes the
+    # full masked T^2 — each path is credited the FLOPs it actually
+    # executes, so the TF/s columns are per-path utilization and NOT
+    # directly comparable; compare times/speedup instead (r4 review)
+    fl_full = 3 * 4 * T * T * D * B * H * j
+    fl = {"flash": fl_full // 2, "fallback": fl_full}
     rows = {}
     for name, attn in [
             ("flash", functools.partial(flash_attention, causal=True)),
@@ -52,7 +58,7 @@ def run(T, B=4, H=16, D=64, j=4):
             t = sustained(fwdbwd_chain(attn, q, k, v, j=j), q, n=8)
             rows[name] = t / j
             print(f"  T={T} {name:8s}: {t/j*1e3:7.2f} ms/fwd+bwd "
-                  f"({fl/j/(t/j)/1e12:5.1f} TF/s)")
+                  f"({fl[name]/j/(t/j)/1e12:5.1f} TF/s)")
         except Exception as e:
             print(f"  T={T} {name:8s}: FAILED {type(e).__name__}: "
                   f"{str(e)[:100]}")
