@@ -10,19 +10,37 @@
 
 #include <Python.h>
 
-#ifndef _GNU_SOURCE
-#define _GNU_SOURCE
-#endif
-#include <dlfcn.h>
-
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <string>
 #include <vector>
 
+#include "pyembed.h"
+
+using mxtpu_embed::GIL;
+
 namespace {
 
 thread_local std::string g_nd_last_error;
+
+// element size by reference dtype code; 0 = unknown (caller errors).
+// bfloat16 (12) included — the esize tables previously defaulted
+// unknown codes to 4 bytes, an OOB read for bf16 (r4 review)
+size_t esize_of(long code) {
+  switch (code) {
+    case 0: return 4;   // float32
+    case 1: return 8;   // float64
+    case 2: return 2;   // float16
+    case 3: return 1;   // uint8
+    case 4: return 4;   // int32
+    case 5: return 1;   // int8
+    case 6: return 8;   // int64
+    case 7: return 1;   // bool
+    case 12: return 2;  // bfloat16
+    default: return 0;
+  }
+}
 
 struct Array {
   PyObject *obj = nullptr;          // mxtpu NDArray
@@ -35,56 +53,12 @@ thread_local std::vector<NDArrayHandle> g_load_arrs;
 thread_local std::vector<std::string> g_load_name_store;
 thread_local std::vector<const char *> g_load_names;
 
-class GIL {
- public:
-  GIL() : state_(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
 void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
-  PyErr_Fetch(&type, &value, &trace);
-  PyErr_NormalizeException(&type, &value, &trace);
-  g_nd_last_error = "python error";
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *msg = PyUnicode_AsUTF8(s);
-      if (msg != nullptr) g_nd_last_error = msg;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(trace);
+  mxtpu_embed::set_error_from_python(&g_nd_last_error);
 }
 
-std::once_flag g_nd_init_once;
-
 bool ensure_interpreter() {
-  std::call_once(g_nd_init_once, []() {
-    if (Py_IsInitialized()) return;
-    // When this library is dlopen()ed by a non-Python host (perl XS,
-    // a C program using dlopen), libpython arrives RTLD_LOCAL and
-    // Python's own extension modules (math, numpy) fail with
-    // undefined PyFloat_Type etc.  Find libpython via a symbol we
-    // link against and re-open it RTLD_GLOBAL before initializing.
-    Dl_info info;
-    if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info)
-        != 0 && info.dli_fname != nullptr) {
-      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
-    }
-    Py_InitializeEx(0);
-    if (Py_IsInitialized()) PyEval_SaveThread();
-  });
-  if (!Py_IsInitialized()) {
-    g_nd_last_error = "failed to initialize embedded Python";
-    return false;
-  }
-  return true;
+  return mxtpu_embed::ensure_interpreter(&g_nd_last_error);
 }
 
 PyObject *helper(const char *fn) {
@@ -196,10 +170,15 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
     Py_XDECREF(shp);
     return -1;
   }
-  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 1};
   long c = PyLong_AsLong(code);
   Py_DECREF(code);
-  size_t nbytes = size * (c >= 0 && c <= 7 ? esize[c] : 4);
+  size_t es = esize_of(c);
+  if (es == 0) {
+    Py_DECREF(shp);
+    g_nd_last_error = "unknown dtype code for host copy";
+    return -1;
+  }
+  size_t nbytes = size * es;
   PyObject *blob = PyBytes_FromStringAndSize(
       static_cast<const char *>(data),
       static_cast<Py_ssize_t>(nbytes));
@@ -238,10 +217,15 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
     Py_XDECREF(code);
     return -1;
   }
-  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 1};
   long c = PyLong_AsLong(code);
   Py_DECREF(code);
-  size_t want = size * (c >= 0 && c <= 7 ? esize[c] : 4);
+  size_t es = esize_of(c);
+  if (es == 0) {
+    Py_DECREF(blob);
+    g_nd_last_error = "unknown dtype code for host copy";
+    return -1;
+  }
+  size_t want = size * es;
   char *buf = nullptr;
   Py_ssize_t blen = 0;
   if (PyBytes_AsStringAndSize(blob, &buf, &blen) != 0) {
@@ -307,9 +291,20 @@ int NNGetOpHandle(const char *op_name, OpHandle *out) {
     g_nd_last_error = "null argument";
     return -1;
   }
-  // validated lazily at invoke time (keeps this callable before the
-  // interpreter exists); the handle is just the interned name
-  *out = new std::string(op_name);
+  // handles are INTERNED per name (bindings call this on every
+  // invoke — a fresh allocation per call would leak unboundedly;
+  // r4 review); validated lazily at invoke time so this stays
+  // callable before the interpreter exists
+  static std::mutex mu;
+  static std::map<std::string, std::string *> *interned =
+      new std::map<std::string, std::string *>();
+  std::lock_guard<std::mutex> lock(mu);
+  auto it = interned->find(op_name);
+  if (it == interned->end()) {
+    it = interned->emplace(op_name,
+                           new std::string(op_name)).first;
+  }
+  *out = it->second;
   return 0;
 }
 

@@ -12,15 +12,13 @@
 
 #include <Python.h>
 
-#ifndef _GNU_SOURCE
-#define _GNU_SOURCE
-#endif
-#include <dlfcn.h>
-
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "pyembed.h"
+
+using mxtpu_embed::GIL;
 
 namespace {
 
@@ -31,63 +29,12 @@ struct Predictor {
   std::vector<mx_uint> shape_buf;       // backs MXPredGetOutputShape
 };
 
-class GIL {
- public:
-  GIL() : state_(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
 void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
-  PyErr_Fetch(&type, &value, &trace);
-  PyErr_NormalizeException(&type, &value, &trace);
-  g_last_error = "python error";
-  if (value != nullptr) {
-    PyObject *s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char *msg = PyUnicode_AsUTF8(s);
-      if (msg != nullptr) g_last_error = msg;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(trace);
+  mxtpu_embed::set_error_from_python(&g_last_error);
 }
 
-std::once_flag g_init_once;
-
 bool ensure_interpreter() {
-  // once_flag: two threads creating their first predictor
-  // concurrently must not both run Py_InitializeEx (UB)
-  std::call_once(g_init_once, []() {
-    if (Py_IsInitialized()) return;
-    // When this library is dlopen()ed by a non-Python host (perl XS,
-    // a C program using dlopen), libpython arrives RTLD_LOCAL and
-    // Python's own extension modules (math, numpy) fail with
-    // undefined PyFloat_Type etc.  Find libpython via a symbol we
-    // link against and re-open it RTLD_GLOBAL before initializing.
-    Dl_info info;
-    if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info)
-        != 0 && info.dli_fname != nullptr) {
-      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
-    }
-    Py_InitializeEx(0);
-    if (Py_IsInitialized()) {
-      // the embedding thread owns the GIL after Py_Initialize;
-      // release it so every ABI call can use the uniform
-      // PyGILState path
-      PyEval_SaveThread();
-    }
-  });
-  if (!Py_IsInitialized()) {
-    g_last_error = "failed to initialize embedded Python";
-    return false;
-  }
-  return true;
+  return mxtpu_embed::ensure_interpreter(&g_last_error);
 }
 
 }  // namespace

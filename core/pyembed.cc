@@ -1,0 +1,61 @@
+#include "pyembed.h"
+
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE
+#endif
+#include <dlfcn.h>
+
+#include <mutex>
+
+namespace mxtpu_embed {
+
+namespace {
+std::once_flag g_init_once;
+}
+
+bool ensure_interpreter(std::string *err) {
+  std::call_once(g_init_once, []() {
+    if (Py_IsInitialized()) return;
+    // When this library is dlopen()ed by a non-Python host, libpython
+    // arrives RTLD_LOCAL and Python's own extension modules (math,
+    // numpy) fail with undefined PyFloat_Type etc.  Find libpython
+    // via a symbol we link against and promote it to RTLD_GLOBAL.
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info)
+        != 0 && info.dli_fname != nullptr) {
+      dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+    }
+    Py_InitializeEx(0);
+    if (Py_IsInitialized()) {
+      // the embedding thread owns the GIL after Py_Initialize;
+      // release it so every ABI call can use the uniform
+      // PyGILState path
+      PyEval_SaveThread();
+    }
+  });
+  if (!Py_IsInitialized()) {
+    if (err != nullptr) *err = "failed to initialize embedded Python";
+    return false;
+  }
+  return true;
+}
+
+void set_error_from_python(std::string *err) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  *err = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != nullptr) *err = msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+}  // namespace mxtpu_embed

@@ -1,0 +1,39 @@
+/*
+ * Shared CPython-embedding layer for the C ABIs (predict + ndarray).
+ * ONE once_flag in ONE translation unit: when both ABI surfaces live
+ * in the same shared library (libmxtpu_c.so), two threads making
+ * their first calls through different surfaces can no longer race
+ * Py_InitializeEx (r4 review).
+ */
+#ifndef MXTPU_PYEMBED_H_
+#define MXTPU_PYEMBED_H_
+
+#include <Python.h>
+
+#include <string>
+
+namespace mxtpu_embed {
+
+// Initialize (or adopt) the interpreter; promotes libpython to
+// RTLD_GLOBAL first so Python's own extension modules resolve when
+// this library was dlopen()ed by a non-Python host (perl XS, dlopen
+// from C).  Thread-safe.  Returns false on failure and fills *err.
+bool ensure_interpreter(std::string *err);
+
+// Fetch the current Python exception into *err (normalized str()).
+void set_error_from_python(std::string *err);
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+  GIL(const GIL &) = delete;
+  GIL &operator=(const GIL &) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace mxtpu_embed
+
+#endif  /* MXTPU_PYEMBED_H_ */

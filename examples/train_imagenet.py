@@ -1,0 +1,102 @@
+"""ImageNet-class training through the canonical recipe (reference
+``example/image-classification/train_imagenet.py``† over
+``common/fit.py``†).
+
+Data: ``--data-train`` names an ImageRecordIter .rec file (the
+reference's path); without it the script synthesizes
+ImageNet-shaped batches so the full recipe — gluon model_zoo network,
+fit loop, LR schedule, checkpointing, Speedometer — still runs
+end-to-end (this environment has no dataset download).
+
+  # synthetic smoke run, ResNet-18 at 64x64:
+  python examples/train_imagenet.py --network resnet18_v1 \\
+      --image-shape 3,64,64 --num-classes 10 --num-examples 256 \\
+      --num-epochs 1
+  # real records:
+  python examples/train_imagenet.py --data-train train.rec \\
+      --network resnet50_v1 --batch-size 256 --dtype bfloat16
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxtpu as mx
+from common_fit import add_fit_args, fit
+from mxtpu.io import NDArrayIter
+
+
+def get_symbol(network, num_classes):
+    """Gluon model_zoo network traced to a training symbol (the
+    reference used symbols/*.py factories; the zoo is this
+    framework's canonical model source)."""
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_model(network, classes=num_classes)
+    net.initialize(init="xavier")
+    data = mx.sym.Variable("data")
+    out = net(data)
+    return mx.sym.SoftmaxOutput(out, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def synthetic_iter(batch_size, image_shape, num_classes, num_examples,
+                   seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (num_examples,) + tuple(image_shape)
+    x = rng.randn(*shape).astype(np.float32)
+    y = rng.randint(0, num_classes, (num_examples,)).astype(np.float32)
+    # make the labels learnable: bias a class-specific spatial
+    # quadrant (channel-count independent, no class collisions)
+    H, W = image_shape[1], image_shape[2]
+    for i in range(num_examples):
+        c = int(y[i])
+        r0 = (c // 2 % 2) * (H // 2)
+        c0 = (c % 2) * (W // 2)
+        x[i, :, r0:r0 + H // 2, c0:c0 + W // 2] += 1.5
+    return NDArrayIter(x, y, batch_size=batch_size, shuffle=True,
+                       label_name="softmax_label")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train an imagenet-class model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    add_fit_args(parser)  # incl. --network/--num-classes/--dtype/...
+    parser.set_defaults(network="resnet50_v1", num_classes=1000)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--data-train", default=None,
+                        help=".rec file for ImageRecordIter")
+    parser.add_argument("--data-val", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.data_train:
+        from mxtpu.io import ImageRecordIter
+        train = ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=True,
+            label_name="softmax_label")
+        val = ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size,
+            label_name="softmax_label") if args.data_val else None
+    else:
+        n = min(args.num_examples or 1024, 4096)
+        train = synthetic_iter(args.batch_size, image_shape,
+                               args.num_classes, n)
+        val = synthetic_iter(args.batch_size, image_shape,
+                             args.num_classes, max(n // 4, 32),
+                             seed=1)
+
+    sym = get_symbol(args.network, args.num_classes)
+    fit(args, sym, train, val)
+
+
+if __name__ == "__main__":
+    main()

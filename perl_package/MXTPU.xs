@@ -18,6 +18,17 @@ static void croak_last(pTHX_ const char *what) {
   croak("%s: %s", what, MXNDGetLastError());
 }
 
+/* The Perl list marshalling is float32-only; copying another dtype
+ * through a float-sized buffer would over/under-run it (r4 review). */
+static void require_f32(pTHX_ IV h) {
+  int dtype = -1;
+  if (MXNDArrayGetDType(INT2PTR(NDArrayHandle, h), &dtype) != 0)
+    croak_last(aTHX_ "MXNDArrayGetDType");
+  if (dtype != 0)
+    croak("AI::MXTPU list copies support float32 arrays only "
+          "(got dtype code %d)", dtype);
+}
+
 MODULE = AI::MXTPU  PACKAGE = AI::MXTPU
 
 PROTOTYPES: DISABLE
@@ -59,6 +70,7 @@ _xs_copy_from(h, data_av)
       size_t n = (size_t)(av_len(data_av) + 1);
       float *buf;
       size_t i;
+      require_f32(aTHX_ h);
       Newx(buf, n, float);
       for (i = 0; i < n; ++i) {
         SV **e = av_fetch(data_av, i, 0);
@@ -80,6 +92,7 @@ _xs_copy_to(h, n)
     {
       float *buf;
       UV i;
+      require_f32(aTHX_ h);
       Newx(buf, n, float);
       if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n)
           != 0) {

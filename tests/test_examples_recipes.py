@@ -81,3 +81,20 @@ def test_train_bert_tp_recipe(caplog):
     msgs = [r.message for r in caplog.records]
     assert any("TP sharding verified" in m for m in msgs)
     assert any("parity vs 1-device OK" in m for m in msgs)
+
+
+def test_train_imagenet_recipe(caplog):
+    """train_imagenet analog (VERDICT r3 missing-6): model_zoo network
+    through the canonical fit recipe on synthetic ImageNet-shaped
+    data."""
+    import logging
+    caplog.set_level(logging.INFO)
+    _run("train_imagenet.py",
+         ["--network", "resnet18_v1", "--image-shape", "3,32,32",
+          "--num-classes", "4", "--num-examples", "512",
+          "--num-epochs", "3", "--batch-size", "64",
+          "--lr", "0.02"])
+    msgs = [r.message for r in caplog.records]
+    accs = [float(m.split("=")[1]) for m in msgs
+            if m.startswith("Epoch[2] Train-accuracy")]
+    assert accs and accs[-1] > 0.5, msgs[-6:]
