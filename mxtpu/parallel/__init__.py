@@ -36,9 +36,11 @@ __all__ = ["make_mesh", "shard_batch", "replicate", "TrainStep",
            "build_train_step", "Mesh", "PartitionSpec", "P",
            "spmd_pipeline", "stack_stage_params", "PipelineTrainStep",
            "build_pipeline_train_step", "snapshot_params",
-           "restore_params"]
+           "restore_params", "moe"]
 
 PartitionSpec = P
+
+from . import moe  # noqa: E402  (expert parallelism — the ep axis)
 
 
 def snapshot_params(net):

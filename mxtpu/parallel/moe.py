@@ -1,0 +1,150 @@
+"""Mixture-of-Experts with expert parallelism (the ``ep`` mesh axis).
+
+The reference era predates MoE; this is a new-capability subsystem
+mandated by the north star (full dp/tp/pp/sp/**ep** sharding support).
+Design is the Mesh-TensorFlow / Switch-Transformer capacity
+formulation — the TPU-native shape-static way to route:
+
+  gate     : (tokens, E) softmax over experts
+  dispatch : (tokens, E, C) one-hot — token t is slot c of expert e
+  combine  : dispatch * gate prob
+  expert_in  = einsum('td,tec->ecd', x, dispatch)   # (E, C, D)
+  expert_out = ffn_e(expert_in[e])                   # per expert
+  y          = einsum('ecd,tec->td', expert_out, combine)
+
+Everything is dense einsums over static shapes (no ragged gathers —
+XLA tiles them onto the MXU), and expert parallelism is pure SPMD:
+``expert_in``/``expert_out`` carry a ``P("ep")`` sharding constraint
+on the expert axis, so GSPMD lowers the two einsums into all-to-all
+dispatch/return collectives over ICI exactly like the reference
+NCCL/MPI frameworks hand-code.  Tokens over capacity are dropped
+(their combine weight is 0 and the residual path carries them) —
+Switch semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["moe_ffn", "switch_router", "MoEFFN"]
+
+
+def switch_router(x2d, gate_w, capacity: int, *, key=None,
+                  jitter: float = 0.0):
+    """Top-1 (Switch) routing: returns (dispatch, combine, aux_loss).
+
+    x2d: (T, D) tokens; gate_w: (D, E).
+    dispatch: (T, E, C) one-hot float; combine = dispatch * gate_prob.
+    aux_loss is the Switch load-balancing loss (mean fraction *
+    mean router prob per expert, scaled by E).
+    """
+    T, D = x2d.shape
+    E = gate_w.shape[1]
+    logits = (x2d.astype(jnp.float32)
+              @ gate_w.astype(jnp.float32))          # (T, E)
+    if jitter > 0.0 and key is not None:
+        logits = logits + jax.random.uniform(
+            key, logits.shape, minval=-jitter, maxval=jitter)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)              # (T,)
+    onehot = jax.nn.one_hot(expert, E,
+                            dtype=jnp.float32)       # (T, E)
+    # position of each token within its expert's queue (prefix count)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (T, E), -1 ow
+    keep = (pos >= 0) & (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_c, capacity,
+                          dtype=jnp.float32)         # (T, E, C)
+    dispatch = slot * keep.astype(jnp.float32)[..., None]
+    gate_p = jnp.sum(probs * onehot, axis=-1)        # (T,)
+    combine = dispatch * gate_p[:, None, None]
+    # load-balancing aux (Switch eq. 4): E * sum_e f_e * P_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, *, capacity_factor: float = 1.25,
+            mesh: Optional[Mesh] = None, ep_axis: str = "ep",
+            activation: Callable = jax.nn.relu, key=None,
+            jitter: float = 0.0):
+    """Switch-MoE feed-forward.  x: (..., T, D) or (T, D);
+    per-expert params w1: (E, D, H), b1: (E, H), w2: (E, H, D),
+    b2: (E, D).  Returns (y, aux_loss).
+
+    With ``mesh`` given, the expert axis of the dispatched activations
+    is shard-constrained to ``ep_axis`` — GSPMD inserts the
+    all-to-alls; each device computes only its local experts."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x2d = x.reshape(-1, D)
+    T = x2d.shape[0]
+    E = w1.shape[0]
+    capacity = max(int(math.ceil(T / E * capacity_factor)), 1)
+    dispatch, combine, aux = switch_router(
+        x2d, gate_w, capacity, key=key, jitter=jitter)
+
+    cdt = x.dtype
+    expert_in = jnp.einsum("td,tec->ecd", x2d.astype(jnp.float32),
+                           dispatch).astype(cdt)     # (E, C, D)
+
+    def constrain(v):
+        if mesh is not None:
+            v = jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(ep_axis)))
+        return v
+
+    # the PARAMETERS shard over ep too — expert parallelism's whole
+    # point is that each device stores and computes only its local
+    # experts' weights (r4 review: constraining activations alone
+    # leaves every device holding all E experts' parameters)
+    w1c = constrain(w1.astype(cdt))
+    b1c = constrain(b1.astype(cdt))
+    w2c = constrain(w2.astype(cdt))
+    b2c = constrain(b2.astype(cdt))
+    expert_in = constrain(expert_in)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1c) \
+        + b1c[:, None, :]
+    h = activation(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w2c) \
+        + b2c[:, None, :]
+    expert_out = constrain(expert_out)
+    y = jnp.einsum("ecd,tec->td", expert_out.astype(jnp.float32),
+                   combine).astype(cdt)
+    return y.reshape(orig_shape), aux
+
+
+class MoEFFN:
+    """Parameter container + apply for a Switch-MoE FFN (functional
+    API — compose inside jitted train steps)."""
+
+    def __init__(self, units: int, hidden: int, num_experts: int,
+                 capacity_factor: float = 1.25, seed: int = 0):
+        k = jax.random.PRNGKey(seed)
+        ks = jax.random.split(k, 5)
+        E, D, H = num_experts, units, hidden
+        s1 = 1.0 / math.sqrt(D)
+        s2 = 1.0 / math.sqrt(H)
+        self.gate_w = jax.random.normal(ks[0], (D, E)) * s1
+        self.w1 = jax.random.normal(ks[1], (E, D, H)) * s1
+        self.b1 = jnp.zeros((E, H))
+        self.w2 = jax.random.normal(ks[2], (E, H, D)) * s2
+        self.b2 = jnp.zeros((E, D))
+        self.capacity_factor = capacity_factor
+
+    def params(self):
+        return (self.gate_w, self.w1, self.b1, self.w2, self.b2)
+
+    def apply(self, params, x, mesh=None, ep_axis="ep", key=None,
+              jitter: float = 0.0):
+        gate_w, w1, b1, w2, b2 = params
+        return moe_ffn(x, gate_w, w1, b1, w2, b2,
+                       capacity_factor=self.capacity_factor,
+                       mesh=mesh, ep_axis=ep_axis, key=key,
+                       jitter=jitter)
