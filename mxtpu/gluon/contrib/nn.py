@@ -6,7 +6,8 @@ from ...base import MXNetError
 from .. import nn
 from ..block import Block, HybridBlock
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "MoEDense"]
 
 
 class Concurrent(nn.Sequential):
@@ -48,3 +49,60 @@ class Identity(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x
+
+
+class MoEDense(HybridBlock):
+    """Switch-MoE feed-forward layer (``_contrib_MoEFFN`` op;
+    ``mxtpu.parallel.moe`` is the functional core).  New capability —
+    the reference era predates MoE.
+
+    Returns ``(y, aux_loss)``: compose the load-balancing aux into the
+    training loss (``loss = task_loss + alpha * aux``).  For expert
+    parallelism, shard the expert-axis parameters over an ``ep`` mesh
+    axis via ``build_train_step(param_spec_fn=...)`` — GSPMD turns the
+    dispatch/return einsums into all-to-alls.
+    """
+
+    def __init__(self, units, hidden, num_experts,
+                 capacity_factor=1.25, activation="relu",
+                 weight_initializer=None, in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._units = units
+        self._hidden = hidden
+        self._E = num_experts
+        self._cf = capacity_factor
+        self._act = activation
+        self.gate_weight = self.params.get(
+            "gate_weight", shape=(in_units, num_experts),
+            init=weight_initializer, allow_deferred_init=True)
+        self.expert_w1 = self.params.get(
+            "expert_w1", shape=(num_experts, in_units, hidden),
+            init=weight_initializer, allow_deferred_init=True)
+        self.expert_b1 = self.params.get(
+            "expert_b1", shape=(num_experts, hidden), init="zeros",
+            allow_deferred_init=True)
+        self.expert_w2 = self.params.get(
+            "expert_w2", shape=(num_experts, hidden, units),
+            init=weight_initializer, allow_deferred_init=True)
+        self.expert_b2 = self.params.get(
+            "expert_b2", shape=(num_experts, units), init="zeros",
+            allow_deferred_init=True)
+
+    def _infer_params(self, x, *args):
+        d = int(x.shape[-1])
+        if self.gate_weight.shape and self.gate_weight.shape[0] == 0:
+            self.gate_weight.shape = (d, self._E)
+            self.expert_w1.shape = (self._E, d, self._hidden)
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        return F._contrib_MoEFFN(
+            x, gate_weight, expert_w1, expert_b1, expert_w2,
+            expert_b2, capacity_factor=self._cf,
+            activation=self._act)
+
+    def __repr__(self):
+        return (f"MoEDense({self._E} experts, "
+                f"hidden={self._hidden} -> {self._units}, "
+                f"{self._act})")

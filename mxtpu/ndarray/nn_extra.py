@@ -704,3 +704,31 @@ register_op("_contrib_quantized_concat", num_inputs=-1, num_outputs=3,
             params=[Param("num_args", int, 0), Param("dim", int, 1)],
             aliases=("quantized_concat",),
             differentiable=False)(_quantized_concat)
+
+
+# ---------------------------------------------------------------------------
+# Switch-MoE feed-forward (new capability; parallel/moe.py is the
+# functional core — expert parallelism engages when the expert-axis
+# parameters are sharded P("ep") via param_spec_fn, GSPMD propagates)
+# ---------------------------------------------------------------------------
+
+
+def _contrib_moe_ffn(data, gate_w, w1, b1, w2, b2,
+                     capacity_factor=1.25, activation="relu"):
+    from ..parallel.moe import moe_ffn  # lazy: avoids an import cycle
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "tanh": jnp.tanh}.get(activation)
+    if act is None:
+        raise MXNetError(f"MoEFFN activation {activation!r} not in "
+                         f"relu/gelu/tanh")
+    y, aux = moe_ffn(data, gate_w, w1, b1, w2, b2,
+                     capacity_factor=float(capacity_factor),
+                     activation=act)
+    return y, aux
+
+
+register_op("_contrib_MoEFFN", num_inputs=6, num_outputs=2,
+            params=[Param("capacity_factor", float, 1.25),
+                    Param("activation", str, "relu",
+                          enum=("relu", "gelu", "tanh"))],
+            aliases=("MoEFFN",))(_contrib_moe_ffn)

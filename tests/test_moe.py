@@ -107,3 +107,36 @@ def test_moe_grads_flow_and_balance_loss_trains():
     params2 = tuple(p - 0.1 * g for p, g in zip(params, grads))
     l1 = float(loss(params2))
     assert l1 < l0, (l0, l1)
+
+
+def test_gluon_moe_dense_trains():
+    """The gluon MoEDense layer trains end-to-end through autograd
+    (task loss + aux), incl. deferred shape inference."""
+    import mxtpu as mx
+    from mxtpu import autograd, nd
+    from mxtpu.gluon import Trainer
+    from mxtpu.gluon.contrib.nn import MoEDense
+
+    rng = np.random.RandomState(5)
+    layer = MoEDense(units=6, hidden=12, num_experts=4)
+    layer.initialize(init="xavier")
+    X = nd.array(rng.randn(32, 6).astype(np.float32))
+    Yt = nd.array(rng.randn(32, 6).astype(np.float32))
+    tr = Trainer(layer.collect_params(), "adam",
+                 {"learning_rate": 0.01})
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            y, aux = layer(X)
+            l = nd.mean(nd.square(y - Yt)) + 0.01 * aux
+        l.backward()
+        tr.step(32)
+        losses.append(float(l.asscalar()))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+    # gate gets gradients too (the router is trainable)
+    with autograd.record():
+        y, aux = layer(X)
+        l = nd.mean(nd.square(y - Yt)) + 0.01 * aux
+    l.backward()
+    g = layer.gate_weight.grad()
+    assert float(nd.sum(nd.abs(g)).asscalar()) > 0

@@ -1,7 +1,7 @@
 """Registry-wide cpu<->tpu consistency sweep (VERDICT r3 item 2).
 
-284 auto-synthesized + curated one-op cases over 272 distinct registry
-rules run fwd+bwd on BOTH backends and cross-compare — the reference's
+300 auto-synthesized + curated one-op cases over ~280 distinct
+registry rules run fwd+bwd on BOTH backends and cross-compare — the reference's
 ``tests/python/gpu/test_operator_gpu.py``† pattern at registry scale.
 Groups of ~25 cases compile as ONE program per backend in an isolated
 subprocess (see tests/tpu_sweep_runner.py for why).
@@ -21,10 +21,10 @@ import pytest
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 GROUP_SIZE = 25
-N_GROUPS = 12  # ceil(284 / 25)
+N_GROUPS = 12  # must satisfy N_GROUPS*GROUP_SIZE >= len(cases)
 
 # documented per-op tolerance overrides (relative to max(|ref|, 1)):
-# populated from the first real-hardware run (r4: 284 cases, ONE
+# populated from the r4 real-hardware runs (300 cases, ONE
 # divergence).  Every entry is a DIVERGENCE ACKNOWLEDGEMENT with a
 # cause, not a silent skip; tol=None means value comparison is
 # skipped entirely for that op.

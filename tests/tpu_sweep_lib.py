@@ -351,6 +351,12 @@ def curated_cases():
     c["_contrib_quantized_flatten"] = [
         (((_ri(0, 254, 2, 3, 4) - 127).astype(np.int8),
           np.float32(-2.0), np.float32(2.0)), {})]
+    c["_contrib_MoEFFN"] = [((_r(24, 8), _r(8, 4) * 2,
+                              _r(4, 8, 16, scale=0.3),
+                              _r(4, 16, scale=0.1),
+                              _r(4, 16, 8, scale=0.3),
+                              _r(4, 8, scale=0.1)),
+                             dict(capacity_factor=1.5))]
     c["_contrib_quantized_concat"] = [
         (((_ri(0, 254, 2, 3) - 127).astype(np.int8),
           (_ri(0, 254, 2, 4) - 127).astype(np.int8),
@@ -398,6 +404,16 @@ def build_cases():
     seen_fns = {}
     for name in sorted(list_ops()):
         op = get_op(name)
+        # curated entries take precedence over alias dedup — an alias
+        # that sorts earlier (e.g. "MoEFFN" < "_contrib_MoEFFN") must
+        # not claim the rule and strand the curated case
+        if name in curated:
+            if id(op.fn) in seen_fns:
+                skipped[seen_fns[id(op.fn)]] = f"alias of {name}"
+            seen_fns[id(op.fn)] = name
+            for i, (args, kw) in enumerate(curated[name]):
+                cases.append((name, i, args, kw))
+            continue
         # aliases share the rule fn; sweep each rule once
         if id(op.fn) in seen_fns:
             skipped[name] = f"alias of {seen_fns[id(op.fn)]}"
@@ -406,10 +422,6 @@ def build_cases():
         reason = ledger_reason(name, op)
         if reason is not None:
             skipped[name] = reason
-            continue
-        if name in curated:
-            for i, (args, kw) in enumerate(curated[name]):
-                cases.append((name, i, args, kw))
             continue
         n_in = op.num_inputs if op.num_inputs >= 0 else 3
         if n_in == 0:
