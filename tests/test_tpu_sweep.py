@@ -1,7 +1,7 @@
 """Registry-wide cpu<->tpu consistency sweep (VERDICT r3 item 2).
 
-300 auto-synthesized + curated one-op cases over ~280 distinct
-registry rules run fwd+bwd on BOTH backends and cross-compare — the reference's
+~300 auto-synthesized + curated one-op cases (incl. a bf16 tier)
+over ~280 distinct registry rules run fwd+bwd on BOTH backends and cross-compare — the reference's
 ``tests/python/gpu/test_operator_gpu.py``† pattern at registry scale.
 Groups of ~25 cases compile as ONE program per backend in an isolated
 subprocess (see tests/tpu_sweep_runner.py for why).
@@ -21,25 +21,32 @@ import pytest
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 GROUP_SIZE = 25
-N_GROUPS = 12  # must satisfy N_GROUPS*GROUP_SIZE >= len(cases)
+N_GROUPS = 13  # must satisfy N_GROUPS*GROUP_SIZE >= len(cases)
 
 # documented per-op tolerance overrides (relative to max(|ref|, 1)):
 # populated from the r4 real-hardware runs (300 cases, ONE
 # divergence).  Every entry is a DIVERGENCE ACKNOWLEDGEMENT with a
 # cause, not a silent skip; tol=None means value comparison is
 # skipped entirely for that op.
+# keys are (op_name, tier) with tier 0 = f32 cases, 100 = bf16 tier —
+# an acknowledgement for one tier must NOT silently loosen the other
+# (r4 review)
 XFAIL_TOL = {
     # eigenvectors are defined only up to per-column sign (and
     # ordering within degenerate eigenspaces) — cpu and tpu LAPACK/
     # Eigh lowering legitimately pick different conventions (measured
     # fwd dev 1.6 on the real chip).  Eigenvalue correctness is
     # covered by test_ops_breadth's linalg tests.
-    "linalg_syevd": ("eigenvector sign/order convention differs per "
-                     "backend", None),
+    ("linalg_syevd", 0): ("eigenvector sign/order convention differs "
+                          "per backend", None),
 }
 
 DEFAULT_FWD_TOL = 2e-4
 DEFAULT_GRAD_TOL = 2e-3
+# case idx >= 100 marks the bf16 tier (tpu_sweep_lib.bf16_cases):
+# an 8-bit mantissa needs correspondingly loose bounds
+BF16_FWD_TOL = 3e-2
+BF16_GRAD_TOL = 6e-2
 
 
 def test_sweep_covers_registry():
@@ -79,17 +86,20 @@ def test_registry_sweep_group(group):
         if r["status"] != "ok":
             bad.append(r)
             continue
-        if r["name"] in XFAIL_TOL:
-            tol = XFAIL_TOL[r["name"]][1]
+        tier = 100 if r["case"] >= 100 else 0
+        if (r["name"], tier) in XFAIL_TOL:
+            tol = XFAIL_TOL[(r["name"], tier)][1]
             if tol is None:
                 continue  # documented convention divergence
-            fwd_tol = tol
+            fwd_tol, grad_tol = tol, DEFAULT_GRAD_TOL
+        elif tier == 100:  # bf16 tier
+            fwd_tol, grad_tol = BF16_FWD_TOL, BF16_GRAD_TOL
         else:
-            fwd_tol = DEFAULT_FWD_TOL
+            fwd_tol, grad_tol = DEFAULT_FWD_TOL, DEFAULT_GRAD_TOL
         if r["max_fwd_err"] is not None and \
                 r["max_fwd_err"] > fwd_tol:
             bad.append(r)
         elif r["max_grad_err"] is not None and \
-                r["max_grad_err"] > DEFAULT_GRAD_TOL:
+                r["max_grad_err"] > grad_tol:
             bad.append(r)
     assert not bad, json.dumps(bad, indent=2)[:3000]

@@ -79,6 +79,10 @@ def main():
         for (name, idx, n_args, want_grad, argnums, kw) in layout:
             aa = flat[pos:pos + n_args]
             pos += n_args
+            if (name, idx) in trace_errors:
+                outs.append(None)
+                outs.append(None)
+                continue
             f = case_fwd(name, kw)
             outs.append(f(*aa))
             if want_grad:
@@ -102,16 +106,26 @@ def main():
     cpu = jax.local_devices(backend="cpu")[0]
     acc = jax.devices()[0]
 
-    # grads whose trace fails on CPU (e.g. int-only outputs) must be
-    # dropped from the program BEFORE compiling either backend; probe
-    # each case's grad trace abstractly first (cheap, no execution)
+    # cases whose fwd/grad trace fails must be dropped from the
+    # program BEFORE compiling either backend (ONE bad trace would
+    # otherwise fail the whole fused group); probe abstractly first
+    # (cheap, no execution).  Dropped-fwd cases get their own error
+    # entry in the results.
+    trace_errors = {}
     for i, (name, idx, n_args, want_grad, argnums, kw) in \
             enumerate(layout):
-        if not want_grad:
-            continue
         start = sum(l[2] for l in layout[:i])
         aa = flat_args[start:start + n_args]
         f = case_fwd(name, kw)
+        try:
+            jax.eval_shape(f, *aa)
+        except Exception as e:
+            trace_errors[(name, idx)] = \
+                f"trace: {type(e).__name__}: {str(e)[:160]}"
+            layout[i] = (name, idx, n_args, False, argnums, kw)
+            continue
+        if not want_grad:
+            continue
 
         def scalar(*a2):
             return sum(jnp.sum(l) for l in f(*a2)
@@ -156,6 +170,11 @@ def main():
 
     for i, (name, idx, n_args, want_grad, argnums, kw) in \
             enumerate(layout):
+        if (name, idx) in trace_errors:
+            results.append({"name": name, "case": idx,
+                            "status": "trace_error",
+                            "error": trace_errors[(name, idx)]})
+            continue
         fwd_err = maxerr(ref[2 * i], got[2 * i])
         grad_err = maxerr(ref[2 * i + 1], got[2 * i + 1]) \
             if want_grad else None
