@@ -1,0 +1,137 @@
+"""Mixture-of-Experts training with expert parallelism — the ``ep``
+counterpart of examples/train_bert_tp.py (new capability; the
+reference era predates MoE).
+
+A small MoE MLP classifier trains on synthetic data over a dp x ep
+mesh: the batch shards over ``dp``, the expert-axis parameters of
+every MoEDense layer shard over ``ep`` (param_spec_fn), and GSPMD
+lowers the dispatch/return einsums to all-to-alls.
+
+Virtual 8-device mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+  python examples/train_moe.py --dp 2 --ep 4
+
+``--parity`` re-runs the same batch + init unsharded and asserts the
+losses match.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx
+from mxtpu import nd, parallel
+from mxtpu.gluon import loss as gloss, nn
+from mxtpu.gluon.block import HybridBlock
+from mxtpu.gluon.contrib.nn import MoEDense
+from mxtpu.parallel import P
+
+
+class MoEClassifier(HybridBlock):
+    """Dense -> MoEDense -> Dense head; the MoE aux loss rides along
+    as a second output for the training loss to consume."""
+
+    def __init__(self, classes, units=32, hidden=64, experts=4,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.proj = nn.Dense(units, activation="relu", flatten=False)
+        self.moe = MoEDense(units=units, hidden=hidden,
+                            num_experts=experts, in_units=units)
+        self.head = nn.Dense(classes, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        h = self.proj(x)
+        y, aux = self.moe(h)
+        return self.head(h + y), aux  # residual around the MoE block
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--ep", type=int, default=4)
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--aux-weight", type=float, default=0.01)
+    p.add_argument("--parity", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    n = args.dp * args.ep
+    devices = jax.devices()
+    if len(devices) < n:
+        sys.exit(f"need {n} devices (dp*ep), have {len(devices)}")
+    mesh = parallel.make_mesh({"dp": args.dp, "ep": args.ep},
+                              devices=devices[:n])
+
+    def expert_spec(param):
+        # expert-axis parameters (E, ...) shard over ep; everything
+        # else (and a non-divisible expert count) replicates, like
+        # megatron_spec in train_bert_tp.py
+        if param.shape is not None and len(param.shape) >= 2 \
+                and "expert" in param.name \
+                and param.shape[0] % args.ep == 0:
+            return P("ep")
+        return None
+
+    def moe_loss(outs, y):
+        pred, aux = outs
+        return gloss.SoftmaxCrossEntropyLoss()(pred, y).mean() \
+            + args.aux_weight * aux
+
+    def build(init_vals=None, use_mesh=True):
+        mx.random.seed(0)
+        net = MoEClassifier(args.classes, experts=args.experts)
+        net.initialize(init="xavier")
+        net(nd.array(np.zeros((2, 16), np.float32)))
+        if init_vals is not None:
+            parallel.restore_params(net, init_vals)
+        step = parallel.build_train_step(
+            net, moe_loss, "adam", {"learning_rate": args.lr},
+            mesh=mesh if use_mesh else None, dp_axis="dp",
+            param_spec_fn=expert_spec if use_mesh else None)
+        return net, step
+
+    net, step = build()
+    init_vals = parallel.snapshot_params(net)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(args.batch_size, 16).astype(np.float32)
+    y = rng.randint(0, args.classes, (args.batch_size,))
+    # separable synthetic task: class mean offset in a random direction
+    dirs = rng.randn(args.classes, 16).astype(np.float32)
+    X += 1.5 * dirs[y]
+    Xn, yn = nd.array(X), nd.array(y.astype(np.float32))
+
+    losses = [float(step(Xn, yn).asscalar()) for _ in range(args.steps)]
+    logging.info("dp%dxep%d: loss %.4f -> %.4f", args.dp, args.ep,
+                 losses[0], losses[-1])
+    assert losses[-1] < losses[0], "did not learn"
+
+    # prove the expert weights really shard over ep
+    w1 = [q for name, q in net.collect_params().items()
+          if "expert_w1" in name][0]
+    spec = w1.data().data.sharding.spec
+    assert "ep" in jax.tree_util.tree_leaves(tuple(spec)), spec
+    logging.info("EP sharding verified: expert_w1 spec=%s",
+                 tuple(spec))
+
+    if args.parity:
+        _, ref_step = build(init_vals=init_vals, use_mesh=False)
+        ref = [float(ref_step(Xn, yn).asscalar())
+               for _ in range(min(args.steps, 3))]
+        dev = max(abs(a - b) for a, b in zip(losses, ref))
+        assert np.allclose(losses[:len(ref)], ref, rtol=2e-4,
+                           atol=2e-4), (losses[:len(ref)], ref)
+        logging.info("parity vs unsharded OK (max delta %.2e)", dev)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,3 +98,15 @@ def test_train_imagenet_recipe(caplog):
     accs = [float(m.split("=")[1]) for m in msgs
             if m.startswith("Epoch[2] Train-accuracy")]
     assert accs and accs[-1] > 0.5, msgs[-6:]
+
+
+def test_train_moe_recipe(caplog):
+    """Expert-parallel MoE recipe: dp2 x ep4 mesh, expert weights
+    sharded over ep, loss parity vs the unsharded run."""
+    import logging
+    caplog.set_level(logging.INFO)
+    _run("train_moe.py",
+         ["--dp", "2", "--ep", "4", "--steps", "12", "--parity"])
+    msgs = [r.message for r in caplog.records]
+    assert any("EP sharding verified" in m for m in msgs)
+    assert any("parity vs unsharded OK" in m for m in msgs)
