@@ -1,0 +1,67 @@
+/*
+ * Train a linear model from C++ through the training-tier frontend
+ * (mxtpu-cpp/ndarray.hpp) — the reference cpp-package's† training
+ * capability, same task as core/train_example.c but RAII/STL.
+ *
+ * Build & run (tests/test_cpp_frontend.py drives this):
+ *   make -C core ndarray
+ *   g++ -std=c++17 cpp_package/example/train.cc -Lcore \
+ *       -lmxtpu_ndarray -Wl,-rpath,core -o /tmp/cpp_train
+ */
+#include <cstdio>
+#include <vector>
+
+#include "../include/mxtpu-cpp/ndarray.hpp"
+
+using mxtpu::nd::NDArray;
+using mxtpu::nd::invoke;
+
+int main() {
+  const int N = 64, D = 4;
+  const float wstar[D] = {1.0f, 2.0f, -1.0f, 0.5f};
+  std::vector<float> xbuf(N * D), ybuf(N);
+  unsigned s = 12345u;
+  for (auto &v : xbuf) {
+    s = s * 1103515245u + 12345u;
+    v = ((float)(s >> 16 & 0x7fff) / 16384.0f) - 1.0f;
+  }
+  for (int i = 0; i < N; ++i)
+    for (int j = 0; j < D; ++j)
+      ybuf[i] += xbuf[i * D + j] * wstar[j];
+
+  NDArray X({N, D}, xbuf), Y({N, 1}, ybuf), w({D, 1});
+  NDArray Xt = invoke("transpose", {X})[0];
+
+  float first = -1.0f, loss = -1.0f;
+  for (int step = 0; step < 10; ++step) {
+    NDArray pred = invoke("dot", {X, w})[0];
+    NDArray diff = invoke("elemwise_sub", {pred, Y})[0];
+    loss = invoke("mean", {invoke("square", {diff})[0]})[0].scalar();
+    if (step == 0) first = loss;
+    NDArray g0 = invoke("dot", {Xt, diff})[0];
+    NDArray g = invoke("_mul_scalar", {g0},
+                       {{"scalar", "0.03125"}})[0];  /* 2/N */
+    w = invoke("sgd_update", {w, g},
+               {{"lr", "0.5"}, {"wd", "0.0"}})[0];
+    std::printf("step %d loss %.6f\n", step, (double)loss);
+  }
+  if (!(loss < first * 0.05f)) {
+    std::fprintf(stderr, "FAIL: no convergence (%f -> %f)\n",
+                 (double)first, (double)loss);
+    return 1;
+  }
+
+  mxtpu::nd::save("/tmp/cpp_train_w.params", {w}, {"w"});
+  auto loaded = mxtpu::nd::load("/tmp/cpp_train_w.params");
+  if (loaded.first.size() != 1 || loaded.second.size() != 1 ||
+      loaded.second[0] != "w") {
+    std::fprintf(stderr, "FAIL: load mismatch\n");
+    return 1;
+  }
+  auto wv = loaded.first[0].to_vector();
+  std::printf("C++ training frontend OK: loss %.6f -> %.6f; "
+              "w ~ [%.2f %.2f %.2f %.2f]\n",
+              (double)first, (double)loss, (double)wv[0],
+              (double)wv[1], (double)wv[2], (double)wv[3]);
+  return 0;
+}

@@ -1,0 +1,181 @@
+/*
+ * C++ training frontend over the training-tier C ABI (the role of the
+ * reference's cpp-package† NDArray/Operator surface): RAII NDArray,
+ * imperative operator invocation over the full registry, save/load.
+ *
+ * Header-only; link with -lmxtpu_ndarray (build: `make -C core
+ * ndarray`).  Throws mxtpu::NDError on any ABI failure, carrying
+ * MXNDGetLastError().
+ */
+#ifndef MXTPU_CPP_NDARRAY_HPP_
+#define MXTPU_CPP_NDARRAY_HPP_
+
+#include <cstddef>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../core/c_api_ndarray.h"
+
+namespace mxtpu {
+namespace nd {
+
+class NDError : public std::runtime_error {
+ public:
+  explicit NDError(const std::string &what)
+      : std::runtime_error(what) {}
+};
+
+inline void ndcheck(int rc, const char *call) {
+  if (rc != 0) {
+    throw NDError(std::string(call) + ": " + MXNDGetLastError());
+  }
+}
+
+/* RAII float32 NDArray handle (the reference cpp-package NDArray,
+ * scoped to the training tier). */
+class NDArray {
+ public:
+  NDArray() = default;
+
+  /* zeros of the given shape */
+  explicit NDArray(const std::vector<mx_uint> &shape) {
+    NDArrayHandle h = nullptr;
+    ndcheck(MXNDArrayCreate(shape.data(),
+                            static_cast<mx_uint>(shape.size()),
+                            1, 0, 0, /*dtype=f32*/ 0, &h),
+            "MXNDArrayCreate");
+    reset(h);
+  }
+
+  NDArray(const std::vector<mx_uint> &shape,
+          const std::vector<float> &data)
+      : NDArray(shape) {
+    copy_from(data);
+  }
+
+  static NDArray adopt(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  NDArrayHandle get() const { return h_ ? h_.get() : nullptr; }
+  explicit operator bool() const { return static_cast<bool>(h_); }
+
+  std::vector<mx_uint> shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *data = nullptr;
+    ndcheck(MXNDArrayGetShape(get(), &ndim, &data),
+            "MXNDArrayGetShape");
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  std::size_t size() const {
+    auto s = shape();
+    return std::accumulate(s.begin(), s.end(),
+                           static_cast<std::size_t>(1),
+                           std::multiplies<std::size_t>());
+  }
+
+  void copy_from(const std::vector<float> &data) {
+    ndcheck(MXNDArraySyncCopyFromCPU(get(), data.data(), data.size()),
+            "MXNDArraySyncCopyFromCPU");
+  }
+
+  std::vector<float> to_vector() const {
+    std::vector<float> out(size());
+    ndcheck(MXNDArraySyncCopyToCPU(get(), out.data(), out.size()),
+            "MXNDArraySyncCopyToCPU");
+    return out;
+  }
+
+  float scalar() const {
+    auto v = to_vector();
+    if (v.empty()) throw NDError("scalar() on empty array");
+    return v[0];
+  }
+
+ private:
+  void reset(NDArrayHandle h) {
+    h_ = std::shared_ptr<void>(h, [](NDArrayHandle p) {
+      if (p != nullptr) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* Imperative operator invocation over the registry (the reference's
+ * generated op.h, collapsed to one variadic call). */
+inline std::vector<NDArray> invoke(
+    const std::string &op_name, const std::vector<NDArray> &inputs,
+    const std::map<std::string, std::string> &params = {}) {
+  OpHandle op = nullptr;
+  ndcheck(NNGetOpHandle(op_name.c_str(), &op), "NNGetOpHandle");
+  std::vector<NDArrayHandle> in;
+  in.reserve(inputs.size());
+  for (const auto &a : inputs) in.push_back(a.get());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  NDArrayHandle *outs = nullptr;
+  ndcheck(MXImperativeInvoke(op, static_cast<int>(in.size()),
+                             in.data(), &n_out, &outs,
+                             static_cast<int>(keys.size()),
+                             keys.data(), vals.data()),
+          "MXImperativeInvoke");
+  std::vector<NDArray> result;
+  result.reserve(n_out);
+  for (int i = 0; i < n_out; ++i)
+    result.push_back(NDArray::adopt(outs[i]));
+  return result;
+}
+
+inline void save(const std::string &fname,
+                 const std::vector<NDArray> &arrays,
+                 const std::vector<std::string> &names = {}) {
+  if (!names.empty() && names.size() != arrays.size()) {
+    throw NDError("save(): names/arrays size mismatch ("
+                  + std::to_string(names.size()) + " vs "
+                  + std::to_string(arrays.size()) + ")");
+  }
+  std::vector<NDArrayHandle> hs;
+  hs.reserve(arrays.size());
+  for (const auto &a : arrays) hs.push_back(a.get());
+  std::vector<const char *> keys;
+  for (const auto &n : names) keys.push_back(n.c_str());
+  ndcheck(MXNDArraySave(fname.c_str(),
+                        static_cast<mx_uint>(hs.size()), hs.data(),
+                        names.empty() ? nullptr : keys.data()),
+          "MXNDArraySave");
+}
+
+inline std::pair<std::vector<NDArray>, std::vector<std::string>>
+load(const std::string &fname) {
+  mx_uint n_arr = 0, n_names = 0;
+  NDArrayHandle *arrs = nullptr;
+  const char **names = nullptr;
+  ndcheck(MXNDArrayLoad(fname.c_str(), &n_arr, &arrs, &n_names,
+                        &names),
+          "MXNDArrayLoad");
+  std::vector<NDArray> out;
+  out.reserve(n_arr);
+  for (mx_uint i = 0; i < n_arr; ++i)
+    out.push_back(NDArray::adopt(arrs[i]));
+  std::vector<std::string> nm;
+  nm.reserve(n_names);
+  for (mx_uint i = 0; i < n_names; ++i) nm.emplace_back(names[i]);
+  return {std::move(out), std::move(nm)};
+}
+
+}  // namespace nd
+}  // namespace mxtpu
+
+#endif  /* MXTPU_CPP_NDARRAY_HPP_ */

@@ -22,12 +22,12 @@ _CPP = os.path.join(_ROOT, "cpp_package")
 _LIB = os.path.join(_CORE, "libmxtpu_predict.so")
 
 
-def _ensure_lib():
+def _ensure_lib(target="predict", lib=_LIB):
     if shutil.which("g++") is None or shutil.which("make") is None:
         pytest.skip("g++/make not available")
-    if not os.path.exists(_LIB):
+    if not os.path.exists(lib):
         r = subprocess.run(
-            ["make", "predict", f"PYTHON={sys.executable}"],
+            ["make", target, f"PYTHON={sys.executable}"],
             cwd=_CORE, capture_output=True, text=True)
         assert r.returncode == 0, r.stderr[-1000:]
 
@@ -93,3 +93,26 @@ def test_python_reshape_matches_original(exported_model):
     out4 = np.frombuffer(p4.get_output(0), np.float32).reshape(4, 4)
     np.testing.assert_allclose(out4[:2], out2, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(out4[2:], out2, rtol=1e-5, atol=1e-6)
+
+
+def test_cpp_training_frontend(tmp_path):
+    """C++ RAII training frontend (mxtpu-cpp/ndarray.hpp over the
+    training C ABI) trains a linear model end-to-end — the reference
+    cpp-package's training capability."""
+    _ensure_lib("ndarray", os.path.join(_CORE, "libmxtpu_ndarray.so"))
+    exe = str(tmp_path / "cpp_train")
+    r = subprocess.run(
+        ["g++", "-std=c++17",
+         os.path.join(_ROOT, "cpp_package", "example", "train.cc"),
+         f"-L{_CORE}", "-lmxtpu_ndarray", f"-Wl,-rpath,{_CORE}",
+         "-o", exe],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-1500:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert r.returncode == 0, \
+        f"stdout:{r.stdout[-800:]}\nstderr:{r.stderr[-800:]}"
+    assert "C++ training frontend OK" in r.stdout, r.stdout[-800:]
