@@ -524,11 +524,15 @@ def _deconv_hook(in_shapes, attrs):
     return out
 
 
-def _channel_hook(in_shapes, attrs):
+def _channel_hook(in_shapes, attrs, default_axis=1):
+    # default_axis must match each op's Param default: BatchNorm/
+    # InstanceNorm normalise per channel (axis 1), LayerNorm per the
+    # LAST axis (-1) — guessing gamma from axis 1 for a default-axis
+    # LayerNorm inferred the wrong shape (r4 fix)
     data = in_shapes[0]
     if data is None:
         return [None] * len(in_shapes)
-    axis = int(_coerce_attr(attrs.get("axis", 1)))
+    axis = int(_coerce_attr(attrs.get("axis", default_axis)))
     c = data[axis]
     return [data] + [(c,)] * (len(in_shapes) - 1)
 
@@ -546,7 +550,8 @@ _INFER_HOOKS = {
     "Deconvolution": _deconv_hook,
     "BatchNorm": _channel_hook,
     "InstanceNorm": _channel_hook,
-    "LayerNorm": _channel_hook,
+    "LayerNorm": lambda in_shapes, attrs: _channel_hook(
+        in_shapes, attrs, default_axis=-1),
     "Embedding": _embedding_hook,
 }
 

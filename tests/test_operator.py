@@ -334,3 +334,16 @@ def test_numeric_gradient_layernorm():
            "b": np.random.randn(6).astype(np.float64)}
     tu.check_numeric_gradient(sym, loc, numeric_eps=1e-4, rtol=1e-2,
                               atol=1e-3)
+
+
+def test_layernorm_default_axis_infers_last_dim():
+    """Regression (r4): the shape-infer channel hook guessed LayerNorm
+    gamma from axis 1 (BatchNorm's default) when no axis attr was
+    given; LayerNorm's op default is the LAST axis."""
+    import mxtpu as mx
+    data = mx.sym.Variable("data")
+    ln = mx.sym.LayerNorm(data, name="ln")
+    shapes, _, _ = ln.infer_shape(data=(2, 6, 8))
+    got = dict(zip(ln.list_arguments(), shapes))
+    assert got["ln_gamma"] == (8,), got
+    assert got["ln_beta"] == (8,), got
