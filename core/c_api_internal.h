@@ -1,0 +1,37 @@
+/*
+ * Internal (non-installed) shared definitions between the C ABI
+ * translation units.  The NDArray handle layout lives here so the
+ * symbolic tier (c_api_symbolic.cc) can wrap/unwrap handles created
+ * by the imperative tier (c_api_ndarray.cc) — one struct definition,
+ * not two that must be kept in sync.
+ */
+#ifndef MXTPU_C_API_INTERNAL_H_
+#define MXTPU_C_API_INTERNAL_H_
+
+#include <Python.h>
+
+#include <vector>
+
+#include "c_api_ndarray.h"
+
+namespace mxtpu_capi {
+
+struct Array {
+  PyObject *obj = nullptr;          // mxtpu NDArray
+  std::vector<mx_uint> shape_buf;   // backs MXNDArrayGetShape
+};
+
+inline Array *as_array(NDArrayHandle h) {
+  return static_cast<Array *>(h);
+}
+
+// wraps a NEW reference (takes ownership)
+inline NDArrayHandle wrap_array(PyObject *obj) {
+  Array *a = new Array();
+  a->obj = obj;
+  return a;
+}
+
+}  // namespace mxtpu_capi
+
+#endif  /* MXTPU_C_API_INTERNAL_H_ */

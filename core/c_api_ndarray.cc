@@ -16,6 +16,7 @@
 #include <string>
 #include <vector>
 
+#include "c_api_internal.h"
 #include "pyembed.h"
 
 using mxtpu_embed::GIL;
@@ -42,10 +43,7 @@ size_t esize_of(long code) {
   }
 }
 
-struct Array {
-  PyObject *obj = nullptr;          // mxtpu NDArray
-  std::vector<mx_uint> shape_buf;   // backs MXNDArrayGetShape
-};
+using mxtpu_capi::Array;
 
 // thread-local result stores backing MXImperativeInvoke/MXNDArrayLoad
 thread_local std::vector<NDArrayHandle> g_invoke_out;
@@ -97,12 +95,10 @@ PyObject *shape_tuple(const mx_uint *shape, mx_uint ndim) {
   return t;
 }
 
-Array *as_array(NDArrayHandle h) { return static_cast<Array *>(h); }
+using mxtpu_capi::as_array;
 
 NDArrayHandle wrap(PyObject *obj) {
-  Array *a = new Array();
-  a->obj = obj;  // takes the reference
-  return a;
+  return mxtpu_capi::wrap_array(obj);  // takes the reference
 }
 
 }  // namespace

@@ -1,0 +1,570 @@
+/*
+ * Symbolic/executor-tier C ABI implementation (reference
+ * src/c_api/c_api_symbolic.cc† + c_api_executor.cc† rebuilt over the
+ * TPU runtime): embeds CPython and drives mxtpu.c_symbol.  Same
+ * embedding discipline as the predict/ndarray tiers — one shared
+ * interpreter (pyembed.cc), tensors cross as NDArray handles from the
+ * imperative tier, strings/attrs as C strings.
+ */
+#include "c_api_symbolic.h"
+
+#include <Python.h>
+
+#include <string>
+#include <vector>
+
+#include "c_api_internal.h"
+#include "pyembed.h"
+
+using mxtpu_capi::as_array;
+using mxtpu_capi::wrap_array;
+using mxtpu_embed::GIL;
+
+namespace {
+
+thread_local std::string g_sym_last_error;
+
+// thread-local result stores
+thread_local std::string g_json_store;
+thread_local std::vector<std::string> g_name_store;
+thread_local std::vector<const char *> g_name_ptrs;
+thread_local std::vector<NDArrayHandle> g_exec_out;
+
+// CSR shape-result stores (one triple per category)
+struct ShapeStore {
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint *> ptrs;
+};
+thread_local ShapeStore g_shape_store[3];
+
+struct Sym {
+  PyObject *obj = nullptr;  // mxtpu Symbol or c_symbol.AtomicSymbol
+};
+
+struct Exec {
+  PyObject *obj = nullptr;  // mxtpu Executor
+};
+
+void set_error_from_python() {
+  mxtpu_embed::set_error_from_python(&g_sym_last_error);
+}
+
+bool ensure_interpreter() {
+  return mxtpu_embed::ensure_interpreter(&g_sym_last_error);
+}
+
+// call mxtpu.c_symbol.<fn>(*args); returns new ref or nullptr
+PyObject *call_helper(const char *fn, PyObject *args) {
+  PyObject *mod = PyImport_ImportModule("mxtpu.c_symbol");
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+Sym *as_sym(SymbolHandle h) { return static_cast<Sym *>(h); }
+Exec *as_exec(ExecutorHandle h) { return static_cast<Exec *>(h); }
+
+SymbolHandle wrap_sym(PyObject *obj) {
+  Sym *s = new Sym();
+  s->obj = obj;  // takes the reference
+  return s;
+}
+
+// str-list helper call -> (out_size, out_names) via thread-local store
+int list_call(const char *fn, SymbolHandle sym, mx_uint *out_size,
+              const char ***out_names) {
+  if (sym == nullptr || out_size == nullptr || out_names == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", as_sym(sym)->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  g_name_store.clear();
+  g_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    g_name_store.emplace_back(s != nullptr ? s : "");
+  }
+  Py_DECREF(r);
+  for (const std::string &s : g_name_store)
+    g_name_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(g_name_ptrs.size());
+  *out_names = g_name_ptrs.data();
+  return 0;
+}
+
+// build ([names...], [shape tuples...]) from the CSR triple
+bool build_shape_args(mx_uint num_args, const char **names,
+                      const mx_uint *ind, const mx_uint *data,
+                      PyObject **out_names, PyObject **out_shapes) {
+  PyObject *nl = PyList_New(num_args);
+  PyObject *sl = PyList_New(num_args);
+  if (nl == nullptr || sl == nullptr) {
+    Py_XDECREF(nl);
+    Py_XDECREF(sl);
+    return false;
+  }
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *n = PyUnicode_FromString(names[i]);
+    mx_uint lo = ind[i], hi = ind[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    if (n == nullptr || t == nullptr) {
+      Py_XDECREF(n);
+      Py_XDECREF(t);
+      Py_DECREF(nl);
+      Py_DECREF(sl);
+      return false;
+    }
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(data[j]));
+    PyList_SET_ITEM(nl, i, n);
+    PyList_SET_ITEM(sl, i, t);
+  }
+  *out_names = nl;
+  *out_shapes = sl;
+  return true;
+}
+
+// fill one CSR result category from a list of shape tuples
+bool store_shapes(PyObject *shape_list, ShapeStore *st,
+                  mx_uint *out_size, const mx_uint **out_ndim,
+                  const mx_uint ***out_data) {
+  st->shapes.clear();
+  st->ndims.clear();
+  st->ptrs.clear();
+  Py_ssize_t n = PyList_Size(shape_list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PyList_GET_ITEM(shape_list, i);
+    std::vector<mx_uint> dims;
+    for (Py_ssize_t j = 0; j < PyTuple_Size(t); ++j) {
+      dims.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, j))));
+    }
+    st->shapes.push_back(std::move(dims));
+  }
+  for (const auto &s : st->shapes) {
+    st->ndims.push_back(static_cast<mx_uint>(s.size()));
+    st->ptrs.push_back(s.data());
+  }
+  *out_size = static_cast<mx_uint>(st->shapes.size());
+  *out_ndim = st->ndims.data();
+  *out_data = st->ptrs.data();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXSymGetLastError(void) { return g_sym_last_error.c_str(); }
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (json == nullptr || out == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", json);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("create_from_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap_sym(r);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (fname == nullptr || out == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", fname);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("create_from_file", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap_sym(r);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  if (sym == nullptr || out_json == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", as_sym(sym)->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("save_to_json", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  const char *s = PyUnicode_AsUTF8(r);
+  g_json_store = s != nullptr ? s : "";
+  Py_DECREF(r);
+  *out_json = g_json_store.c_str();
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  if (sym == nullptr || fname == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Os)", as_sym(sym)->obj, fname);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("save_to_file", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (name == nullptr || out == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(s)", name);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("create_variable", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap_sym(r);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  if (op_name == nullptr || out == nullptr ||
+      (num_param > 0 && (keys == nullptr || vals == nullptr))) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *kl = PyList_New(num_param);
+  PyObject *vl = PyList_New(num_param);
+  bool ok = kl != nullptr && vl != nullptr;
+  for (mx_uint i = 0; ok && i < num_param; ++i) {
+    PyObject *k = PyUnicode_FromString(keys[i]);
+    PyObject *v = PyUnicode_FromString(vals[i]);
+    if (k == nullptr || v == nullptr) {
+      ok = false;
+      Py_XDECREF(k);
+      Py_XDECREF(v);
+      break;
+    }
+    PyList_SET_ITEM(kl, i, k);
+    PyList_SET_ITEM(vl, i, v);
+  }
+  PyObject *args = ok ? Py_BuildValue("(sOO)", op_name, kl, vl)
+                      : nullptr;
+  Py_XDECREF(kl);
+  Py_XDECREF(vl);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("create_atomic", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap_sym(r);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args_in) {
+  if (sym == nullptr || (num_args > 0 && args_in == nullptr)) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *kl = PyList_New(keys != nullptr ? num_args : 0);
+  PyObject *al = PyList_New(num_args);
+  bool ok = kl != nullptr && al != nullptr;
+  for (mx_uint i = 0; ok && keys != nullptr && i < num_args; ++i) {
+    PyObject *k = PyUnicode_FromString(keys[i]);
+    if (k == nullptr) { ok = false; break; }
+    PyList_SET_ITEM(kl, i, k);
+  }
+  for (mx_uint i = 0; ok && i < num_args; ++i) {
+    PyObject *o = as_sym(args_in[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(al, i, o);
+  }
+  PyObject *args = ok ? Py_BuildValue("(OsOO)", as_sym(sym)->obj,
+                                      name != nullptr ? name : "",
+                                      kl, al)
+                      : nullptr;
+  Py_XDECREF(kl);
+  Py_XDECREF(al);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("compose", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  // in-place semantics: rebind the handle to the composed symbol
+  Sym *s = as_sym(sym);
+  Py_XDECREF(s->obj);
+  s->obj = r;
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  if (sym == nullptr) return 0;
+  Sym *s = as_sym(sym);
+  if (Py_IsInitialized()) {
+    GIL gil;
+    Py_XDECREF(s->obj);
+  }
+  delete s;
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_names) {
+  return list_call("list_arguments", sym, out_size, out_names);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_names) {
+  return list_call("list_outputs", sym, out_size, out_names);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_names) {
+  return list_call("list_auxiliary_states", sym, out_size, out_names);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **arg_names, const mx_uint *arg_ind,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data) {
+  if (sym == nullptr ||
+      (num_args > 0 && (arg_names == nullptr || arg_ind == nullptr ||
+                        arg_shape_data == nullptr))) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *nl = nullptr, *sl = nullptr;
+  if (!build_shape_args(num_args, arg_names, arg_ind, arg_shape_data,
+                        &nl, &sl)) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(OOO)", as_sym(sym)->obj, nl, sl);
+  Py_DECREF(nl);
+  Py_DECREF(sl);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("infer_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  bool ok =
+      store_shapes(PyTuple_GET_ITEM(r, 0), &g_shape_store[0],
+                   in_shape_size, in_shape_ndim, in_shape_data) &&
+      store_shapes(PyTuple_GET_ITEM(r, 1), &g_shape_store[1],
+                   out_shape_size, out_shape_ndim, out_shape_data) &&
+      store_shapes(PyTuple_GET_ITEM(r, 2), &g_shape_store[2],
+                   aux_shape_size, aux_shape_ndim, aux_shape_data);
+  Py_DECREF(r);
+  return ok ? 0 : -1;
+}
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char *grad_req, mx_uint num_args,
+                         const char **arg_names, const mx_uint *arg_ind,
+                         const mx_uint *arg_shape_data,
+                         ExecutorHandle *out) {
+  (void)dev_type; (void)dev_id;
+  if (sym == nullptr || grad_req == nullptr || out == nullptr ||
+      (num_args > 0 && (arg_names == nullptr || arg_ind == nullptr ||
+                        arg_shape_data == nullptr))) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *nl = nullptr, *sl = nullptr;
+  if (!build_shape_args(num_args, arg_names, arg_ind, arg_shape_data,
+                        &nl, &sl)) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *args = Py_BuildValue("(OsOO)", as_sym(sym)->obj, grad_req,
+                                 nl, sl);
+  Py_DECREF(nl);
+  Py_DECREF(sl);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("simple_bind", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Exec *e = new Exec();
+  e->obj = r;
+  *out = e;
+  return 0;
+}
+
+int MXExecutorSetArg(ExecutorHandle exec, const char *name,
+                     NDArrayHandle arr) {
+  if (exec == nullptr || name == nullptr || arr == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(OsO)", as_exec(exec)->obj, name,
+                                 as_array(arr)->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("executor_set_arg", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int get_array_call(const char *fn, ExecutorHandle exec,
+                          const char *name, NDArrayHandle *out) {
+  if (exec == nullptr || name == nullptr || out == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Os)", as_exec(exec)->obj, name);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper(fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  *out = wrap_array(r);
+  return 0;
+}
+
+int MXExecutorGetArg(ExecutorHandle exec, const char *name,
+                     NDArrayHandle *out) {
+  return get_array_call("executor_get_arg", exec, name, out);
+}
+
+int MXExecutorGetGrad(ExecutorHandle exec, const char *name,
+                      NDArrayHandle *out) {
+  return get_array_call("executor_get_grad", exec, name, out);
+}
+
+int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  if (exec == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(Oi)", as_exec(exec)->obj, is_train);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("executor_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle exec, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  if (exec == nullptr || (len > 0 && head_grads == nullptr)) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *hl = PyList_New(len);
+  if (hl == nullptr) { set_error_from_python(); return -1; }
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *o = as_array(head_grads[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(hl, i, o);
+  }
+  PyObject *args = Py_BuildValue("(ON)", as_exec(exec)->obj, hl);
+  if (args == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *r = call_helper("executor_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  if (exec == nullptr || out_size == nullptr || out == nullptr) {
+    g_sym_last_error = "null argument";
+    return -1;
+  }
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *args = Py_BuildValue("(O)", as_exec(exec)->obj);
+  if (args == nullptr) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("executor_outputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  g_exec_out.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    g_exec_out.push_back(wrap_array(o));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(g_exec_out.size());
+  *out = g_exec_out.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle exec) {
+  if (exec == nullptr) return 0;
+  Exec *e = as_exec(exec);
+  if (Py_IsInitialized()) {
+    GIL gil;
+    Py_XDECREF(e->obj);
+  }
+  delete e;
+  return 0;
+}
+
+}  // extern "C"

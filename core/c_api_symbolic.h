@@ -1,0 +1,123 @@
+/*
+ * Symbolic/executor-tier C ABI — the MXSymbol* / MXExecutor* surface
+ * of the reference's include/mxnet/c_api.h† (implemented upstream in
+ * src/c_api/c_api_symbolic.cc† and c_api_executor.cc†), enough for a
+ * third-language frontend to load a -symbol.json, bind it, and train
+ * without embedding Python logic of its own (VERDICT r4 item 6).
+ *
+ * Implementation (c_api_symbolic.cc) embeds CPython and drives
+ * mxtpu.c_symbol; it shares the single embedded interpreter with the
+ * predict and ndarray tiers (link -lmxtpu_c).  All functions return 0
+ * on success, -1 on failure; message via MXSymGetLastError().
+ *
+ * Documented divergence from the reference ABI: upstream frontends
+ * mutate executor argument buffers in place (aliased device memory).
+ * XLA arrays are immutable, so argument updates use explicit
+ * MXExecutorSetArg rebinds — the same rebinding discipline
+ * MXNDArraySyncCopyFromCPU already uses at the imperative tier.
+ */
+#ifndef MXTPU_C_API_SYMBOLIC_H_
+#define MXTPU_C_API_SYMBOLIC_H_
+
+#include <stddef.h>
+
+#include "c_api_ndarray.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+const char *MXSymGetLastError(void);
+
+/* ---- symbol construction / serialization ------------------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+/* *out_json is thread-local, valid until the next call. */
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* Create an operator node awaiting inputs (reference
+ * MXSymbolCreateAtomicSymbol† takes an AtomicSymbolCreator; here the
+ * operator is resolved by registry name). */
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+/* Supply inputs to an atomic symbol (positional when keys == NULL,
+ * by argument name otherwise).  Mutates `sym` in place, exactly like
+ * the reference's MXSymbolCompose†. */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- introspection ----------------------------------------------- */
+
+/* String lists are thread-local, valid until the next MXSymbolList*
+ * call on this thread. */
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_names);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_names);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_names);
+
+/* Shape inference.  Provided shapes are named (arg_names) with a CSR
+ * layout: ind[i]..ind[i+1] indexes into shape_data.  Results are
+ * thread-local CSR triples, valid until the next call. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **arg_names, const mx_uint *arg_ind,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data);
+
+/* ---- executor tier ----------------------------------------------- */
+
+/* Infer shapes from the named input shapes (CSR layout as above),
+ * allocate zero-initialised argument/aux arrays, return an executor.
+ * grad_req: "write", "add" or "null" (applies to every argument,
+ * the reference's common case). */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char *grad_req, mx_uint num_args,
+                         const char **arg_names, const mx_uint *arg_ind,
+                         const mx_uint *arg_shape_data,
+                         ExecutorHandle *out);
+
+/* Rebind a named argument (or aux state) to a new array.  The
+ * executor takes its own reference; the caller keeps the handle. */
+int MXExecutorSetArg(ExecutorHandle exec, const char *name,
+                     NDArrayHandle arr);
+/* Get the current array bound to a named argument or aux state as a
+ * NEW handle (caller frees with MXNDArrayFree). */
+int MXExecutorGetArg(ExecutorHandle exec, const char *name,
+                     NDArrayHandle *out);
+/* Gradient of a named argument as a new handle; errors if grad_req
+ * was "null" for it or backward has not run. */
+int MXExecutorGetGrad(ExecutorHandle exec, const char *name,
+                      NDArrayHandle *out);
+
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+/* head_grads: one per output, or NULL/len 0 for the implicit
+ * ones-like head gradient (reference backward()† semantics). */
+int MXExecutorBackward(ExecutorHandle exec, mx_uint len,
+                       NDArrayHandle *head_grads);
+/* *out receives a thread-local array of new handles (valid until the
+ * next call on this thread; handles live until MXNDArrayFree). */
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle exec);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_SYMBOLIC_H_ */

@@ -1,0 +1,200 @@
+/*
+ * Symbolic-tier C ABI acceptance program (VERDICT r4 item 6): a C++
+ * frontend that loads a -symbol.json + .params checkpoint, binds the
+ * graph, and trains 10 SGD steps — entirely through the C ABI
+ * (MXSymbol* / MXExecutor* / MXNDArray* / MXImperativeInvoke), no
+ * Python logic on this side of the boundary.
+ *
+ * Reference workflow parity: src/c_api/c_api_symbolic.cc† +
+ * c_api_executor.cc† as driven by cpp-package/include/mxnet-cpp/†.
+ *
+ * Usage: train_symbolic <symbol.json> <init.params> <out.params>
+ * (tests/test_c_symbolic_abi.py generates the inputs and drives it.)
+ */
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api_ndarray.h"
+#include "c_api_symbolic.h"
+
+#define N 64
+#define D 4
+
+#define CHECK(call)                                               \
+  do {                                                            \
+    if ((call) != 0) {                                            \
+      std::fprintf(stderr, "FAIL %s: %s / %s\n", #call,           \
+                   MXSymGetLastError(), MXNDGetLastError());      \
+      return 1;                                                   \
+    }                                                             \
+  } while (0)
+
+static int invoke1(OpHandle op, int n_in, NDArrayHandle *in,
+                   int n_par, const char **pk, const char **pv,
+                   NDArrayHandle *out) {
+  int n_out = 0;
+  NDArrayHandle *outs = nullptr;
+  if (MXImperativeInvoke(op, n_in, in, &n_out, &outs, n_par, pk, pv)
+      != 0 || n_out < 1)
+    return -1;
+  *out = outs[0];
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <symbol.json> <init.params> <out.params>\n",
+                 argv[0]);
+    return 2;
+  }
+
+  /* ---- load the graph ------------------------------------------- */
+  SymbolHandle sym;
+  CHECK(MXSymbolCreateFromFile(argv[1], &sym));
+
+  mx_uint n_args = 0;
+  const char **arg_names = nullptr;
+  CHECK(MXSymbolListArguments(sym, &n_args, &arg_names));
+  std::vector<std::string> args(arg_names, arg_names + n_args);
+  std::printf("arguments:");
+  for (const std::string &a : args) std::printf(" %s", a.c_str());
+  std::printf("\n");
+
+  /* ---- bind: provide data/label shapes, infer the rest ---------- */
+  const char *in_names[] = {"data", "label"};
+  mx_uint ind[] = {0, 2, 4};
+  mx_uint shape_data[] = {N, D, N, 1};
+  ExecutorHandle exec;
+  CHECK(MXExecutorSimpleBind(sym, 1, 0, "write", 2, in_names, ind,
+                             shape_data, &exec));
+
+  /* ---- load the checkpoint into the executor -------------------- */
+  mx_uint n_loaded = 0, n_names = 0;
+  NDArrayHandle *loaded = nullptr;
+  const char **loaded_names = nullptr;
+  CHECK(MXNDArrayLoad(argv[2], &n_loaded, &loaded, &n_names,
+                      &loaded_names));
+  std::vector<std::string> param_names;
+  for (mx_uint i = 0; i < n_loaded; ++i) {
+    /* checkpoint convention: "arg:<name>" / "aux:<name>" prefixes */
+    std::string nm = loaded_names[i];
+    if (nm.rfind("arg:", 0) == 0 || nm.rfind("aux:", 0) == 0)
+      nm = nm.substr(4);
+    CHECK(MXExecutorSetArg(exec, nm.c_str(), loaded[i]));
+    param_names.push_back(nm);
+    CHECK(MXNDArrayFree(loaded[i]));  /* executor holds its own ref */
+  }
+  std::printf("loaded %u params\n", n_loaded);
+
+  /* ---- synthetic dataset: y = X w* ------------------------------ */
+  float xbuf[N * D], ybuf[N];
+  const float wstar[D] = {1.0f, 2.0f, -1.0f, 0.5f};
+  unsigned s = 12345u;
+  for (int i = 0; i < N * D; ++i) {
+    s = s * 1103515245u + 12345u;
+    xbuf[i] = ((float)(s >> 16 & 0x7fff) / 16384.0f) - 1.0f;
+  }
+  for (int i = 0; i < N; ++i) {
+    ybuf[i] = 0.0f;
+    for (int j = 0; j < D; ++j) ybuf[i] += xbuf[i * D + j] * wstar[j];
+  }
+  mx_uint xshape[2] = {N, D}, yshape[2] = {N, 1};
+  NDArrayHandle X, y;
+  CHECK(MXNDArrayCreate(xshape, 2, 1, 0, 0, 0, &X));
+  CHECK(MXNDArrayCreate(yshape, 2, 1, 0, 0, 0, &y));
+  CHECK(MXNDArraySyncCopyFromCPU(X, xbuf, N * D));
+  CHECK(MXNDArraySyncCopyFromCPU(y, ybuf, N));
+  CHECK(MXExecutorSetArg(exec, "data", X));
+  CHECK(MXExecutorSetArg(exec, "label", y));
+
+  OpHandle op_sgd;
+  CHECK(NNGetOpHandle("sgd_update", &op_sgd));
+  /* LinearRegressionOutput's head gradient is per-sample but SUMMED
+   * over the batch by the executor (reference semantics — no implicit
+   * 1/N), so the stable lr scales with 1/N. */
+  const char *lr_k[] = {"lr", "wd"};
+  const char *lr_v[] = {"0.008", "0.0"};
+
+  /* ---- 10 training steps ---------------------------------------- */
+  float first_loss = 0.0f, loss = 0.0f;
+  for (int step = 0; step < 10; ++step) {
+    CHECK(MXExecutorForward(exec, 1));
+    mx_uint n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    CHECK(MXExecutorOutputs(exec, &n_out, &outs));
+    float pred[N];
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], pred, N));
+    for (mx_uint i = 0; i < n_out; ++i) CHECK(MXNDArrayFree(outs[i]));
+    loss = 0.0f;
+    for (int i = 0; i < N; ++i) {
+      float d = pred[i] - ybuf[i];
+      loss += d * d;
+    }
+    loss /= N;
+    if (step == 0) first_loss = loss;
+    std::printf("step %d mse %.6f\n", step, loss);
+
+    CHECK(MXExecutorBackward(exec, 0, nullptr));
+    for (const std::string &nm : param_names) {
+      if (nm == "data" || nm == "label") continue;
+      NDArrayHandle wcur, grad, wnew;
+      CHECK(MXExecutorGetArg(exec, nm.c_str(), &wcur));
+      CHECK(MXExecutorGetGrad(exec, nm.c_str(), &grad));
+      NDArrayHandle in2[2] = {wcur, grad};
+      if (invoke1(op_sgd, 2, in2, 2, lr_k, lr_v, &wnew) != 0) {
+        std::fprintf(stderr, "sgd_update failed: %s\n",
+                     MXNDGetLastError());
+        return 1;
+      }
+      CHECK(MXExecutorSetArg(exec, nm.c_str(), wnew));
+      /* the executor holds its own references; drop ours (wnew's
+       * backing slot is thread-local to the invoke, but the wrapper
+       * must still be freed once the executor has rebound) */
+      CHECK(MXNDArrayFree(wcur));
+      CHECK(MXNDArrayFree(grad));
+      CHECK(MXNDArrayFree(wnew));
+    }
+  }
+  if (!(loss < first_loss * 0.5f) || !std::isfinite(loss)) {
+    std::fprintf(stderr, "loss did not converge: %f -> %f\n",
+                 first_loss, loss);
+    return 1;
+  }
+
+  /* ---- save the trained weights through the ABI ----------------- */
+  std::vector<NDArrayHandle> save_arrs;
+  std::vector<std::string> save_names_store;
+  std::vector<const char *> save_names;
+  for (const std::string &nm : param_names) {
+    if (nm == "data" || nm == "label") continue;
+    NDArrayHandle h;
+    CHECK(MXExecutorGetArg(exec, nm.c_str(), &h));
+    save_arrs.push_back(h);
+    save_names_store.push_back("arg:" + nm);
+  }
+  for (const std::string &nm : save_names_store)
+    save_names.push_back(nm.c_str());
+  CHECK(MXNDArraySave(argv[3], (mx_uint)save_arrs.size(),
+                      save_arrs.data(), save_names.data()));
+  for (NDArrayHandle h : save_arrs) CHECK(MXNDArrayFree(h));
+  CHECK(MXNDArrayFree(X));
+  CHECK(MXNDArrayFree(y));
+
+  /* round-trip the symbol JSON through the ABI as well */
+  const char *json = nullptr;
+  CHECK(MXSymbolSaveToJSON(sym, &json));
+  if (json == nullptr || std::strlen(json) < 10) {
+    std::fprintf(stderr, "symbol JSON round-trip failed\n");
+    return 1;
+  }
+
+  CHECK(MXExecutorFree(exec));
+  CHECK(MXSymbolFree(sym));
+  std::printf("C-ABI symbolic training OK (mse %.6f -> %.6f)\n",
+              first_loss, loss);
+  return 0;
+}

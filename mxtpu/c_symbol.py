@@ -1,0 +1,167 @@
+"""Python side of the symbolic/executor C ABI tier (VERDICT r4 item 6;
+reference ``src/c_api/c_api_symbolic.cc``† / ``c_api_executor.cc``†).
+
+``core/c_api_symbolic.cc`` embeds CPython and calls these helpers; the
+boundary follows the same conventions as ``c_ndarray.py`` — strings
+and string key/value attr pairs cross as C strings, tensors as
+NDArray handles from the imperative tier, shapes as flat int arrays.
+
+One deliberate divergence from the reference ABI, documented in
+``c_api_symbolic.h``: the reference lets frontends mutate executor
+argument arrays in place (aliased device buffers); XLA arrays are
+immutable, so argument updates go through explicit
+``MXExecutorSetArg`` rebinds instead (the same rebinding discipline
+``MXNDArraySyncCopyFromCPU`` already uses).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .symbol import Symbol, Variable, load_json
+
+
+class AtomicSymbol:
+    """An op + attrs awaiting composition (MXSymbolCreateAtomicSymbol
+    semantics: the reference creates the node first, then
+    MXSymbolCompose supplies its inputs)."""
+
+    def __init__(self, op_name: str, attrs):
+        self.op_name = op_name
+        self.attrs = dict(attrs)
+
+
+def create_from_json(json_str: str) -> Symbol:
+    return load_json(json_str)
+
+
+def create_from_file(fname: str) -> Symbol:
+    with open(fname, "r", encoding="utf-8") as f:
+        return load_json(f.read())
+
+
+def save_to_json(sym: Symbol) -> str:
+    return sym.tojson()
+
+
+def save_to_file(sym: Symbol, fname: str) -> None:
+    sym.save(fname)
+
+
+def create_variable(name: str) -> Symbol:
+    return Variable(name)
+
+
+def create_atomic(op_name: str, keys: Sequence[str],
+                  vals: Sequence[str]) -> AtomicSymbol:
+    from . import symbol as sym_mod
+    if not callable(getattr(sym_mod, op_name, None)):
+        raise MXNetError(f"unknown operator {op_name}")
+    return AtomicSymbol(op_name, zip(keys, vals))
+
+
+def compose(sym, name: str, keys: Sequence[str],
+            args: Sequence[Symbol]):
+    """MXSymbolCompose: supply inputs to an atomic symbol (positionally
+    when ``keys`` is empty, by name otherwise).  Returns the composed
+    Symbol — the C side rebinds the handle to it."""
+    from .symbol import _coerce_attr
+    from . import symbol as sym_mod
+    if isinstance(sym, AtomicSymbol):
+        op = getattr(sym_mod, sym.op_name, None)
+        if not callable(op):
+            raise MXNetError(f"unknown operator {sym.op_name}")
+        kwargs = {k: _coerce_attr(v) for k, v in sym.attrs.items()}
+        if name:
+            kwargs["name"] = name
+        if keys:
+            kwargs.update(dict(zip(keys, args)))
+            return op(**kwargs)
+        return op(*args, **kwargs)
+    # composing a full symbol: sym(**{input_name: replacement})
+    if keys:
+        return sym(**dict(zip(keys, args)))
+    return sym(*args)
+
+
+def list_arguments(sym: Symbol) -> List[str]:
+    return list(sym.list_arguments())
+
+
+def list_outputs(sym: Symbol) -> List[str]:
+    return list(sym.list_outputs())
+
+
+def list_auxiliary_states(sym: Symbol) -> List[str]:
+    return list(sym.list_auxiliary_states())
+
+
+def infer_shape(sym: Symbol, names: Sequence[str],
+                shapes: Sequence[Sequence[int]]):
+    """Returns (arg_shapes, out_shapes, aux_shapes) as tuple lists."""
+    kwargs = {n: tuple(int(d) for d in s)
+              for n, s in zip(names, shapes)}
+    arg_s, out_s, aux_s = sym.infer_shape(**kwargs)
+    conv = lambda ss: [tuple(int(d) for d in s) for s in ss]
+    return conv(arg_s), conv(out_s), conv(aux_s)
+
+
+# ---------------------------------------------------------------------
+# executor tier
+# ---------------------------------------------------------------------
+
+def simple_bind(sym: Symbol, grad_req: str, names: Sequence[str],
+                shapes: Sequence[Sequence[int]]):
+    """MXExecutorSimpleBind: infer shapes from the provided inputs and
+    allocate zero-initialised argument/aux arrays."""
+    from .executor import Executor
+    kwargs = {n: tuple(int(d) for d in s)
+              for n, s in zip(names, shapes)}
+    return Executor.simple_bind(sym, grad_req=grad_req, **kwargs)
+
+
+def executor_set_arg(ex, name: str, arr: NDArray) -> None:
+    if name in ex.arg_dict:
+        d = ex.arg_dict
+    elif name in ex.aux_dict:
+        d = ex.aux_dict
+    else:
+        raise MXNetError(f"executor has no argument '{name}'")
+    # reject shape mismatches at assignment time, as the reference ABI
+    # does — otherwise the failure surfaces as an opaque XLA error at
+    # the next forward, attributed to the wrong call
+    cur = d[name]
+    if tuple(cur.shape) != tuple(arr.shape):
+        raise MXNetError(
+            f"MXExecutorSetArg: '{name}' expects shape "
+            f"{tuple(cur.shape)}, got {tuple(arr.shape)}")
+    d[name] = arr
+
+
+def executor_get_arg(ex, name: str) -> NDArray:
+    if name in ex.arg_dict:
+        return ex.arg_dict[name]
+    if name in ex.aux_dict:
+        return ex.aux_dict[name]
+    raise MXNetError(f"executor has no argument '{name}'")
+
+
+def executor_get_grad(ex, name: str) -> NDArray:
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise MXNetError(f"no gradient bound for '{name}' "
+                         f"(grad_req null?)")
+    return g
+
+
+def executor_forward(ex, is_train: int) -> None:
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, head_grads: Sequence[NDArray]) -> None:
+    ex.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(ex) -> List[NDArray]:
+    return list(ex.outputs)
