@@ -170,6 +170,39 @@ def _bn(node, ctx):
             momentum=float(a.get("momentum", 0.9)))
 
 
+@_register("BatchNormRelu", "BatchNormAddRelu")
+def _bn_act(node, ctx):
+    # fused TPU ops decompose to the canonical ONNX sequence
+    # BatchNormalization (+ Add) + Relu — the importer of any runtime
+    # re-fuses as it sees fit
+    a = node.attrs
+    ins = ctx.ins(node)
+    has_add = node.op == "BatchNormAddRelu"
+    # fused input order: (data, [addend,] gamma, beta, mean, var)
+    addend = ins.pop(1) if has_add else None
+    gamma_idx = 2 if has_add else 1
+    if a.get("fix_gamma", True) in (True, "True", "true", 1):
+        gamma_name = node.inputs[gamma_idx][0].name
+        g = ctx.params.get(gamma_name)
+        if g is None:
+            raise MXNetError(
+                f"ONNX export: {node.op} {node.name} has "
+                f"fix_gamma=True and gamma {gamma_name!r} is not in "
+                f"params — cannot derive the ones scale shape")
+        ins[1] = ctx.const(f"{node.name}_fixed_gamma",
+                           np.ones_like(np.asarray(g)))
+    bn_out = f"{node.name}_bn_out"
+    ctx.add("BatchNormalization", f"{node.name}_bn", ins, [bn_out],
+            epsilon=float(a.get("eps", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)))
+    pre_relu = bn_out
+    if has_add:
+        pre_relu = f"{node.name}_sum"
+        ctx.add("Add", f"{node.name}_add", [bn_out, addend],
+                [pre_relu])
+    ctx.add("Relu", f"{node.name}_relu", [pre_relu], [ctx.out(node)])
+
+
 @_register("Flatten", "flatten")
 def _flatten(node, ctx):
     ctx.add("Flatten", node.name, ctx.ins(node), [ctx.out(node)],

@@ -30,7 +30,14 @@ def _bn_axis(layout):
 
 
 class BasicBlockV1(HybridBlock):
-    """Pre-pooling residual block (resnet18/34 v1; reference†)."""
+    """Pre-pooling residual block (resnet18/34 v1; reference†).
+
+    TPU note: the BN->relu pairs and the final BN->(+shortcut)->relu
+    go through the fused BatchNorm(Add)Relu ops — same math; XLA fuses
+    the epilogue into the apply pass by default, and the one-HBM-pass
+    Pallas kernel is opt-in via MXTPU_FUSED_BN=1 (measured verdict in
+    BASELINE.md "Fused-BN verdict"; reference's ``BatchNormAddRelu``
+    tier, SURVEY §2.1-N8)."""
 
     def __init__(self, channels, stride, downsample=False,
                  in_channels=0, layout="NCHW", **kwargs):
@@ -38,10 +45,9 @@ class BasicBlockV1(HybridBlock):
         ax = _bn_axis(layout)
         self.body = nn.HybridSequential(prefix="")
         self.body.add(_conv3x3(channels, stride, in_channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.BatchNorm(axis=ax, act_type="relu"))
         self.body.add(_conv3x3(channels, 1, channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        self.bn_out = nn.BatchNorm(axis=ax, act_type="relu")
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(
@@ -56,7 +62,7 @@ class BasicBlockV1(HybridBlock):
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+        return self.bn_out(x, residual)
 
 
 class BottleneckV1(HybridBlock):
@@ -69,14 +75,12 @@ class BottleneckV1(HybridBlock):
         self.body = nn.HybridSequential(prefix="")
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1,
                                 strides=stride, layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.BatchNorm(axis=ax, act_type="relu"))
         self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.BatchNorm(axis=ax, act_type="relu"))
         self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
                                 layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        self.bn_out = nn.BatchNorm(axis=ax, act_type="relu")
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(
@@ -91,7 +95,7 @@ class BottleneckV1(HybridBlock):
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
+        return self.bn_out(x, residual)
 
 
 class BasicBlockV2(HybridBlock):
@@ -101,9 +105,9 @@ class BasicBlockV2(HybridBlock):
                  in_channels=0, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = nn.BatchNorm(axis=ax)
+        self.bn1 = nn.BatchNorm(axis=ax, act_type="relu")
         self.conv1 = _conv3x3(channels, stride, in_channels, layout)
-        self.bn2 = nn.BatchNorm(axis=ax)
+        self.bn2 = nn.BatchNorm(axis=ax, act_type="relu")
         self.conv2 = _conv3x3(channels, 1, channels, layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride,
@@ -116,12 +120,10 @@ class BasicBlockV2(HybridBlock):
     def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
         if self.downsample is not None:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         return x + residual
 
@@ -133,13 +135,13 @@ class BottleneckV2(HybridBlock):
                  in_channels=0, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.bn1 = nn.BatchNorm(axis=ax)
+        self.bn1 = nn.BatchNorm(axis=ax, act_type="relu")
         self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
                                use_bias=False, layout=layout)
-        self.bn2 = nn.BatchNorm(axis=ax)
+        self.bn2 = nn.BatchNorm(axis=ax, act_type="relu")
         self.conv2 = _conv3x3(channels // 4, stride, channels // 4,
                               layout)
-        self.bn3 = nn.BatchNorm(axis=ax)
+        self.bn3 = nn.BatchNorm(axis=ax, act_type="relu")
         self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
                                use_bias=False, layout=layout)
         if downsample:
@@ -153,15 +155,12 @@ class BottleneckV2(HybridBlock):
     def hybrid_forward(self, F, x):
         residual = x
         x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
         if self.downsample is not None:
             residual = self.downsample(x)
         x = self.conv1(x)
         x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
         x = self.conv2(x)
         x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
         x = self.conv3(x)
         return x + residual
 
@@ -183,8 +182,7 @@ class ResNetV1(HybridBlock):
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
                                         use_bias=False, layout=layout))
-            self.features.add(nn.BatchNorm(axis=ax))
-            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.BatchNorm(axis=ax, act_type="relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
@@ -226,8 +224,7 @@ class ResNetV2(HybridBlock):
         else:
             self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
                                         use_bias=False, layout=layout))
-            self.features.add(nn.BatchNorm(axis=ax))
-            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.BatchNorm(axis=ax, act_type="relu"))
             self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         in_channels = channels[0]
         for i, num_layer in enumerate(layers):

@@ -173,15 +173,29 @@ class Dropout(HybridBlock):
 
 class BatchNorm(HybridBlock):
     """Batch normalization (reference ``nn.BatchNorm``† →
-    ``BatchNorm`` op†).  Running statistics update via the aux channel."""
+    ``BatchNorm`` op†).  Running statistics update via the aux channel.
+
+    TPU extension: ``act_type="relu"`` fuses the activation (and, when
+    a second ``residual`` input is passed at call time, the shortcut
+    add) into the BN op — the reference's fused ``BatchNormAddRelu``
+    tier (``src/operator/nn/batch_norm.cu``†).  Numerically identical
+    to BatchNorm -> (+residual) -> relu on every path; the epilogue is
+    XLA-fused by default, with the one-HBM-pass channel-blocked Pallas
+    kernel opt-in via MXTPU_FUSED_BN=1 (BASELINE.md "Fused-BN
+    verdict")."""
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False,
                  beta_initializer="zeros", gamma_initializer="ones",
                  running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0,
-                 prefix=None, params=None):
+                 act_type=None, prefix=None, params=None):
         super().__init__(prefix, params)
+        if act_type not in (None, "relu"):
+            raise MXNetError(
+                f"BatchNorm act_type must be None or 'relu', "
+                f"got {act_type!r}")
+        self._act_type = act_type
         self._axis = axis
         self._momentum = momentum
         self._eps = epsilon
@@ -212,14 +226,26 @@ class BatchNorm(HybridBlock):
             if p.shape and p.shape[0] == 0:
                 p.shape = (c,)
 
-    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+    def hybrid_forward(self, F, x, residual=None, gamma=None,
+                       beta=None, running_mean=None, running_var=None):
         training = autograd.is_training()
         use_global = self._use_global_stats or not training
-        out, mean, var = F.BatchNorm(
-            x, gamma, beta, running_mean, running_var,
-            eps=self._eps, momentum=self._momentum,
-            fix_gamma=not self._scale, use_global_stats=use_global,
-            axis=self._axis)
+        kw = dict(eps=self._eps, momentum=self._momentum,
+                  fix_gamma=not self._scale,
+                  use_global_stats=use_global, axis=self._axis)
+        if residual is not None:
+            if self._act_type != "relu":
+                raise MXNetError("BatchNorm residual input requires "
+                                 "act_type='relu'")
+            out, mean, var = F.BatchNormAddRelu(
+                x, residual, gamma, beta, running_mean, running_var,
+                **kw)
+        elif self._act_type == "relu":
+            out, mean, var = F.BatchNormRelu(
+                x, gamma, beta, running_mean, running_var, **kw)
+        else:
+            out, mean, var = F.BatchNorm(
+                x, gamma, beta, running_mean, running_var, **kw)
         if training and not self._use_global_stats:
             m = self._momentum
             _emit_aux_update(self.running_mean,

@@ -1066,6 +1066,11 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
             scale.reshape(shape) + \
             beta.astype(jnp.float32).reshape(shape)
         return out.astype(x.dtype), mean, var
+    if axis == 1 and x.ndim >= 3:
+        # one-HBM-pass Pallas kernel when the channel-block fits VMEM
+        # (falls back to _bn_train_core internally)
+        from ..kernels.batch_norm import fused_bn_act
+        return fused_bn_act(x, g, beta, eps=eps, act="none")
     out, mean, var = _bn_train_core(x, g, beta, axis, eps)
     return out, mean, var
 
@@ -1078,6 +1083,64 @@ register_op("BatchNorm", num_inputs=5, num_outputs=3,
                     Param("output_mean_var", bool, False),
                     Param("axis", int, 1)],
             aliases=("batch_norm", "BatchNorm_v1"))(_batch_norm)
+
+
+def _batch_norm_fused_act(x, gamma, beta, moving_mean, moving_var,
+                          residual=None, eps=1e-5, momentum=0.9,
+                          fix_gamma=True, use_global_stats=False,
+                          axis=1):
+    """BatchNorm with a fused ReLU (and optional residual-add)
+    epilogue — the reference's fused ``BatchNormAddRelu`` cuDNN/CUDA
+    tier (``src/operator/nn/batch_norm.cu``†, SURVEY §2.1-N8), rebuilt
+    as the channel-blocked Pallas kernel
+    (``mxtpu/kernels/batch_norm.py``).  Training mode runs stats +
+    normalize + add + relu in ONE HBM read of x (vs the composite's
+    two), and the backward recomputes the relu mask in-VMEM instead of
+    materializing it."""
+    axis = axis % x.ndim
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if use_global_stats:
+        shape = tuple(-1 if i == axis else 1 for i in range(x.ndim))
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+        scale = g.astype(jnp.float32) * lax.rsqrt(var + eps)
+        out = (x.astype(jnp.float32) - mean.reshape(shape)) * \
+            scale.reshape(shape) + \
+            beta.astype(jnp.float32).reshape(shape)
+        if residual is not None:
+            out = out + residual.astype(jnp.float32)
+        out = jnp.maximum(out, 0.0)
+        return out.astype(x.dtype), mean, var
+    if axis == 1 and x.ndim >= 3:
+        from ..kernels.batch_norm import fused_bn_act
+        return fused_bn_act(x, g, beta, eps=eps, act="relu",
+                            residual=residual)
+    out, mean, var = _bn_train_core(x, g, beta, axis, eps)
+    if residual is not None:
+        out = out + residual
+    out = jnp.maximum(out, jnp.zeros((), out.dtype))
+    return out, mean, var
+
+
+_BN_ACT_PARAMS = [Param("eps", float, 1e-5),
+                  Param("momentum", float, 0.9),
+                  Param("fix_gamma", bool, True),
+                  Param("use_global_stats", bool, False),
+                  Param("axis", int, 1)]
+
+register_op("BatchNormRelu", num_inputs=5, num_outputs=3,
+            params=_BN_ACT_PARAMS)(
+    lambda data, gamma, beta, moving_mean, moving_var, **kw:
+    _batch_norm_fused_act(data, gamma, beta, moving_mean, moving_var,
+                          None, **kw))
+
+# input order: (data, addend, gamma, beta, moving_mean, moving_var) —
+# the addend is the bottleneck's shortcut branch
+register_op("BatchNormAddRelu", num_inputs=6, num_outputs=3,
+            params=_BN_ACT_PARAMS)(
+    lambda data, addend, gamma, beta, moving_mean, moving_var, **kw:
+    _batch_norm_fused_act(data, gamma, beta, moving_mean, moving_var,
+                          addend, **kw))
 
 
 def _as_prng_key(key):

@@ -549,6 +549,11 @@ _INFER_HOOKS = {
     "Convolution": _conv_hook,
     "Deconvolution": _deconv_hook,
     "BatchNorm": _channel_hook,
+    "BatchNormRelu": _channel_hook,
+    # addend (input 1) is data-shaped, the rest are (C,)
+    "BatchNormAddRelu": lambda in_shapes, attrs: (
+        lambda full: [full[0], full[0]] + full[1:]
+    )(_channel_hook([in_shapes[0]] + list(in_shapes[2:]), attrs)),
     "InstanceNorm": _channel_hook,
     "LayerNorm": lambda in_shapes, attrs: _channel_hook(
         in_shapes, attrs, default_axis=-1),
@@ -755,6 +760,10 @@ _AUTO_VARS: Dict[str, List[str]] = {
     "Convolution": ["data", "weight", "bias"],
     "Deconvolution": ["data", "weight", "bias"],
     "BatchNorm": ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    "BatchNormRelu": ["data", "gamma", "beta", "moving_mean",
+                      "moving_var"],
+    "BatchNormAddRelu": ["data", "addend", "gamma", "beta",
+                         "moving_mean", "moving_var"],
     "LayerNorm": ["data", "gamma", "beta"],
     "InstanceNorm": ["data", "gamma", "beta"],
     "Embedding": ["data", "weight"],

@@ -1,0 +1,157 @@
+"""Measured evidence for the fused-BN Pallas dispatch (r5 item 1).
+
+Chains K BN(+ReLU) layers back-to-back (output feeds input — nothing
+can be DCE'd; see tools/microbench.py) and reports marginal per-layer
+time for the XLA composite vs the channel-blocked Pallas kernel, at
+each ResNet-50 stage shape (b256 bf16).  Also runs a conv+BN chain so
+any relayout cost XLA inserts around the pallas_call shows up.
+
+Usage: PYTHONPATH=.:... python tools/probe_bn_fusion.py [batch]
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxtpu.kernels.batch_norm import (_pick_cb, bn_act_reference,
+                                      fused_bn_act)
+from tools.microbench import sustained
+
+
+def bn_chain_time(shape, dtype, act, mode, K=8, grad=False):
+    """Marginal ms per BN layer: time(K layers) via sustained chain."""
+    N, C, H, W = shape
+    rng = np.random.RandomState(0)
+    x0 = jnp.array(rng.randn(*shape), dtype)
+    g = jnp.array(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(C).astype(np.float32))
+
+    if mode == "pallas":
+        os.environ["MXTPU_FUSED_BN"] = "1"
+        layer = lambda x: fused_bn_act(x, g, b, act=act)[0]
+    elif mode == "xla":
+        os.environ["MXTPU_FUSED_BN"] = "0"
+        layer = lambda x: fused_bn_act(x, g, b, act=act)[0]
+    else:  # oracle: plain jnp autodiff
+        layer = lambda x: bn_act_reference(x, g, b, act=act)[0]
+
+    if not grad:
+        def step(x):
+            for _ in range(K):
+                x = layer(x)
+            return x
+        t = sustained(step, x0, n=8, repeats=2)
+    else:
+        def loss(x):
+            for _ in range(K):
+                x = layer(x)
+            # quadratic loss -> the output cotangent is data-dependent
+            # (a linear loss gives a CONSTANT dy and XLA folds most of
+            # the BN backward away — the r3 DCE trap)
+            return jnp.sum(jnp.square(x.astype(jnp.float32))) * 1e-6
+
+        gf = jax.grad(loss)
+
+        def step(x):
+            dx = gf(x)
+            return x + dx.astype(x.dtype) * 1e-12
+        t = sustained(step, x0, n=8, repeats=2)
+    os.environ.pop("MXTPU_FUSED_BN", None)
+    return t * 1e3 / K
+
+
+def conv_bn_chain_time(shape, dtype, mode, K=6, grad=True):
+    """conv3x3(C->C) + BN + relu chain — the realistic fusion context."""
+    N, C, H, W = shape
+    rng = np.random.RandomState(0)
+    x0 = jnp.array(rng.randn(*shape), dtype)
+    w = jnp.array(rng.randn(C, C, 3, 3).astype(np.float32)
+                  * (1.0 / np.sqrt(9 * C)), dtype)
+    g = jnp.array(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.array(rng.randn(C).astype(np.float32))
+
+    def conv(x):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    if mode == "pallas":
+        os.environ["MXTPU_FUSED_BN"] = "1"
+        layer = lambda x: fused_bn_act(conv(x), g, b, act="relu")[0]
+    else:
+        os.environ["MXTPU_FUSED_BN"] = "0"
+        layer = lambda x: fused_bn_act(conv(x), g, b, act="relu")[0]
+
+    def loss(x):
+        y = x
+        for _ in range(K):
+            y = layer(y)
+        return jnp.sum(jnp.square(y.astype(jnp.float32))) * 1e-6
+
+    gf = jax.grad(loss)
+
+    def step(x):
+        dx = gf(x)
+        return x + dx.astype(x.dtype) * 1e-12
+
+    t = sustained(step, x0, n=8, repeats=2)
+    os.environ.pop("MXTPU_FUSED_BN", None)
+    return t * 1e3 / K
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    dtype = jnp.bfloat16
+    print(f"device={jax.devices()[0]} batch={batch} dtype=bfloat16")
+    stages = [  # (name, C, H)  — ResNet-50 stage shapes
+        ("stem112", 64, 112),
+        ("s1_56", 256, 56),
+        ("s2_28", 512, 28),
+        ("s3_14", 1024, 14),
+        ("s4_7", 2048, 7),
+    ]
+    only = sys.argv[2].split(",") if len(sys.argv) > 2 else None
+    if only:
+        stages = [s for s in stages if s[0] in only]
+    print(f"{'shape':>10} {'cb(f/b)':>9} {'xla f':>7} {'pal f':>7} "
+          f"{'xla f+b':>8} {'pal f+b':>8}  ms/layer")
+    for name, C, H in stages:
+        shape = (batch, C, H, H)
+        S = H * H
+        cbf = _pick_cb(batch, C, S, 2, 14)
+        xf = bn_chain_time(shape, dtype, "relu", "xla", grad=False)
+        xb = bn_chain_time(shape, dtype, "relu", "xla", grad=True)
+        try:
+            pf = bn_chain_time(shape, dtype, "relu", "pallas",
+                               grad=False)
+            pb = bn_chain_time(shape, dtype, "relu", "pallas",
+                               grad=True)
+            pf, pb = f"{pf:7.3f}", f"{pb:8.3f}"
+        except Exception as e:  # noqa: BLE001 — record Mosaic failures
+            pf, pb = "  FAIL", "  FAIL"
+            print(f"    [{name}] pallas error: {str(e)[:4000]}")
+        print(f"{name:>10} {str(cbf):>9} {xf:7.3f} {pf} "
+              f"{xb:8.3f} {pb}")
+
+    if os.environ.get("MXTPU_PROBE_CONV", "1") == "0":
+        return
+    print("\nconv3x3+BN+relu chain (fwd+bwd, marginal ms/layer):")
+    for name, C, H in stages[1:]:
+        shape = (batch, C // 4, H, H)   # bottleneck inner width
+        xc = conv_bn_chain_time(shape, dtype, "xla")
+        try:
+            pc = conv_bn_chain_time(shape, dtype, "pallas")
+            pc = f"{pc:8.3f}"
+        except Exception as e:  # noqa: BLE001
+            pc = "    FAIL"
+            print(f"    [{name}] pallas error: {str(e)[:120]}")
+        print(f"{name:>10} C={C // 4:<5} xla {xc:8.3f}  pallas {pc}")
+
+
+if __name__ == "__main__":
+    main()
