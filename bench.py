@@ -38,6 +38,7 @@ _PEAK_BF16 = (("TPU v5 lite", 197e12), ("TPU v5p", 459e12),
 # paths report the same key)
 _METRIC_NAMES = {
     "resnet50": "resnet50_imagenet_train_throughput",
+    "resnet50_pipeline": "resnet50_pipeline_fed_train_throughput",
     "bert": "bert_large_pretrain_throughput",
     "bert_s512": "bert_large_s512_pretrain_throughput",
     "lenet": "lenet_mnist_train_throughput",
@@ -51,6 +52,7 @@ _METRIC_NAMES = {
 # 3% of XLA's 2.063 GFLOP/token and is replaced by the measured value.)
 _TRAIN_FLOPS = {
     "resnet50": 22.49e9,      # XLA cost_analysis, fwd+bwd, b256
+    "resnet50_pipeline": 22.49e9,  # same model, pipeline-fed
     "bert": 2.063e9,          # XLA cost_analysis, fwd+bwd, b32 s128
     # s512: s128 measurement + analytic attention delta (4*T*d*L fwd,
     # x3 fwd+bwd; the flash-attention custom call hides its FLOPs from
@@ -145,6 +147,117 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
         _METRIC_NAMES["resnet50"], "samples/sec"
 
 
+def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
+                            repeats=3):
+    """Pipeline-fed ResNet-50 (VERDICT r4 item 2): trains from an
+    ImageRecordIter over a synthetic raw-record dataset — per-step
+    batches, NO reuse_batch — with background prefetch
+    (PrefetchingIter) and device-side normalization: uint8 crosses
+    the host->device link (~38 MB/batch at ~2 GB/s measured) and the
+    cast + mean/std fuse into the compiled train step.  This is the
+    rate a user's fit() loop achieves with the input pipeline in the
+    loop.
+
+    The raw-record tier is the honest rate-proof on THIS host: the
+    box has ONE CPU core (nproc=1), which caps cv2 JPEG decode at
+    ~380 img/s no matter the implementation — six times below the
+    chip's compute rate; a standard multi-core TPU host VM runs the
+    same threaded decode pool past the training rate (BASELINE.md
+    "Input pipeline").  Reference: iter_image_recordio_2.cc† +
+    iter_prefetcher.h†."""
+    import tempfile
+
+    from mxtpu import parallel
+    from mxtpu import recordio as rio
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.gluon import nn
+    from mxtpu.io import ImageRecordIter, PrefetchingIter
+    from mxtpu.models import resnet50
+
+    batch_size = batch_size or int(
+        os.environ.get("MXTPU_BENCH_BATCH", "256"))
+    d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
+    prefix = os.path.join(d, "synth")
+    rng = np.random.RandomState(0)
+    n_img = 8 * batch_size
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
+    for i in range(n_img):
+        # distinct images without n_img full RNG draws: roll + refresh
+        if i % 61 == 0:
+            base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 1000), i, 0),
+            np.roll(base, i % 224, axis=2).tobytes()))
+    rec.close()
+
+    compute_dtype = os.environ.get("MXTPU_BENCH_DTYPE",
+                                   "bfloat16") or "float32"
+
+    class _DeviceNormalize(nn.HybridBlock):
+        """uint8 -> (x - mean)/std on device; XLA fuses it into the
+        step (channel-mean simplification: ImageNet grand mean / std —
+        the arithmetic cost is identical to per-channel).  The 1/std
+        lives in a frozen parameter so the layer inherits the compute
+        dtype from the AMP cast machinery: eager shape-inference sees
+        f32, the compiled step sees bf16 — no hand-managed casts."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            from mxtpu import initializer
+            self.inv_std = self.params.get(
+                "inv_std", shape=(1,),
+                init=initializer.Constant(1.0 / 57.7), grad_req="null")
+
+        def hybrid_forward(self, F, x, inv_std):
+            return (x.astype(str(inv_std.dtype)) - 114.8) * inv_std
+
+    net = nn.HybridSequential(prefix="pipe_")
+    net.add(_DeviceNormalize(), resnet50(classes=1000))
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype=(compute_dtype if compute_dtype != "float32"
+                       else None),
+        cast_batch=False)
+
+    it = ImageRecordIter(prefix + ".rec", (3, 224, 224), batch_size,
+                         path_imgidx=prefix + ".idx", shuffle=True,
+                         rand_mirror=True, raw_records=True,
+                         dtype="uint8", preprocess_threads=2)
+    pit = PrefetchingIter(it)
+
+    def batches():
+        while True:
+            try:
+                yield pit.next()
+            except StopIteration:
+                pit.reset()
+
+    stream = batches()
+    loss = None
+    for _ in range(warmup):  # includes the compile
+        b = next(stream)
+        loss = step(b.data[0], b.label[0])
+    float(loss.asnumpy().mean())
+    vals = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            b = next(stream)
+            loss = step(b.data[0], b.label[0])  # async dispatch
+        float(loss.asnumpy().mean())  # sync
+        vals.append(batch_size * iters / (time.perf_counter() - t0))
+    vals.sort()
+    median = vals[len(vals) // 2] if len(vals) % 2 else \
+        0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+    stats = {"best": max(vals), "median": median, "n": len(vals),
+             "spread": round((max(vals) - min(vals)) / median, 4),
+             "runs": [round(v, 1) for v in vals]}
+    return stats, _METRIC_NAMES["resnet50_pipeline"], "samples/sec"
+
+
 def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
                metric_key="bert"):
     """BERT-Large MLM-style training step, tokens/sec (north-star #2).
@@ -185,6 +298,7 @@ def _mfu(model, value, peak):
 def main():
     which = os.environ.get("MXTPU_BENCH_MODEL", "all")
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
+             "resnet50_pipeline": bench_resnet50_pipeline,
              "bert": bench_bert,
              # long-context north-star row (VERDICT r3 item 4): at
              # s512 attention is a real fraction of the FLOPs, so the
@@ -204,7 +318,7 @@ def main():
             baseline = json.load(f).get("metrics", {})
 
     order = [which] if which != "all" else \
-        ["resnet50", "bert", "bert_s512", "lenet"]
+        ["resnet50", "resnet50_pipeline", "bert", "bert_s512", "lenet"]
     results = {}
     for model in order:
         # one workload failing (e.g. a transient tunnel error) must not

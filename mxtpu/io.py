@@ -541,9 +541,22 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, mean_r=0.0,
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  scale=1.0, label_width=1, round_batch=True,
-                 preprocess_threads=4, seed=0, **_ignored):
+                 preprocess_threads=4, seed=0, raw_records=False,
+                 dtype="float32", **_ignored):
         super().__init__(batch_size)
         from . import recordio as rio
+        # raw_records: records hold pre-decoded CHW pixel bytes at
+        # data_shape (no JPEG decode).  dtype="uint8" emits uint8
+        # batches WITHOUT host-side mean/std — pair with device-side
+        # normalization (the cast + normalize fuses into the first
+        # conv's XLA program; the TPU input-pipeline recipe for
+        # single-core hosts, BASELINE.md "Input pipeline").
+        self.raw_records = bool(raw_records)
+        self._out_dtype = np.dtype(dtype)
+        if self._out_dtype not in (np.dtype(np.float32),
+                                   np.dtype(np.uint8)):
+            raise MXNetError("ImageRecordIter dtype must be float32 "
+                             "or uint8")
         self.data_shape = tuple(data_shape)
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
@@ -617,6 +630,20 @@ class ImageRecordIter(DataIter):
         — drawn serially on the consumer thread so seeded runs are
         reproducible regardless of decode-pool scheduling."""
         from . import recordio as rio
+        if self.raw_records:
+            header, body = rio.unpack(raw)
+            arr = np.frombuffer(body, np.uint8).reshape(self.data_shape)
+            if self.rand_mirror and aug_u[2] < 0.5:
+                arr = arr[:, :, ::-1]
+            label = header.label
+            if isinstance(label, np.ndarray) and self.label_width == 1:
+                label = float(label[0])
+            if self._out_dtype == np.uint8:
+                return arr, label
+            img32 = (arr.astype(np.float32) -
+                     self.mean.reshape(3, 1, 1)) * self.scale / \
+                self.std.reshape(3, 1, 1)
+            return img32, label
         header, img = rio.unpack_img(raw, iscolor=1)
         c, h, w = self.data_shape
         ih, iw = img.shape[:2]
@@ -629,10 +656,15 @@ class ImageRecordIter(DataIter):
             img = cv2.resize(img, (w, h))
         if self.rand_mirror and aug_u[2] < 0.5:
             img = img[:, ::-1]
-        img = img[:, :, ::-1].astype(np.float32)  # BGR→RGB
-        # reference order (iter_image_recordio_2.cc†): mean subtraction
-        # happens in pixel units, THEN scale, then std division
-        img = (img - self.mean) * self.scale / self.std
+        img = img[:, :, ::-1]  # BGR→RGB
+        if self._out_dtype == np.uint8:
+            img = np.ascontiguousarray(img)
+        else:
+            # reference order (iter_image_recordio_2.cc†): mean
+            # subtraction happens in pixel units, THEN scale, then
+            # std division
+            img = (img.astype(np.float32) - self.mean) * self.scale / \
+                self.std
         label = header.label
         if isinstance(label, np.ndarray) and self.label_width == 1:
             label = float(label[0])
@@ -642,7 +674,7 @@ class ImageRecordIter(DataIter):
         if self._exhausted:
             raise StopIteration
         c, h, w = self.data_shape
-        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        data = np.zeros((self.batch_size, c, h, w), self._out_dtype)
         labels = np.zeros((self.batch_size, self.label_width), np.float32)
         raws = []
         while len(raws) < self.batch_size:

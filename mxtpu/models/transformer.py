@@ -17,12 +17,19 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 
 __all__ = ["MultiHeadAttention", "PositionwiseFFN",
-           "TransformerEncoderCell", "TransformerEncoder", "BERTModel",
-           "bert_base", "bert_large", "transformer_encoder"]
+           "TransformerEncoderCell", "TransformerEncoder",
+           "TransformerDecoderCell", "TransformerDecoder",
+           "TransformerModel", "BERTModel", "bert_base", "bert_large",
+           "transformer_encoder", "transformer_base",
+           "transformer_big"]
 
 
 class MultiHeadAttention(HybridBlock):
-    """Self-attention over (N, T, C) via the fused attention op."""
+    """Self- or cross-attention over (N, T, C) via the fused attention
+    op.  Pass a second input (``memory``) at call time for
+    cross-attention: queries come from ``x``, keys/values from
+    ``memory`` (the decoder->encoder path of the seq2seq
+    transformer)."""
 
     def __init__(self, units, num_heads, dropout=0.0, causal=False,
                  **kwargs):
@@ -37,19 +44,30 @@ class MultiHeadAttention(HybridBlock):
         self.proj = nn.Dense(units, flatten=False, use_bias=True)
         self.drop = nn.Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, x):
-        u, h = self._units, self._heads
-        qkv = self.qkv(x)
+    def _split_heads(self, F, t):
+        # (N, T, u) -> (N, h, T, u/h)
+        t = F.reshape(t, shape=(0, -1, self._heads,
+                                self._units // self._heads))
+        return F.transpose(t, axes=(0, 2, 1, 3))
 
-        def split_heads(t):
-            # (N, T, u) -> (N, h, T, u/h)
-            t = F.reshape(t, shape=(0, -1, h, u // h))
-            return F.transpose(t, axes=(0, 2, 1, 3))
-
-        q = split_heads(F.slice_axis(qkv, axis=-1, begin=0, end=u))
-        k = split_heads(F.slice_axis(qkv, axis=-1, begin=u, end=2 * u))
-        v = split_heads(F.slice_axis(qkv, axis=-1, begin=2 * u,
-                                     end=3 * u))
+    def hybrid_forward(self, F, x, memory=None):
+        u = self._units
+        split = lambda t: self._split_heads(F, t)
+        if memory is None:
+            qkv = self.qkv(x)
+            q = split(F.slice_axis(qkv, axis=-1, begin=0, end=u))
+            k = split(F.slice_axis(qkv, axis=-1, begin=u, end=2 * u))
+            v = split(F.slice_axis(qkv, axis=-1, begin=2 * u,
+                                   end=3 * u))
+        else:
+            # cross-attention reuses the fused qkv weights: the q rows
+            # project x, the kv rows project memory (one GEMM each)
+            qkv_x = self.qkv(x)
+            qkv_m = self.qkv(memory)
+            q = split(F.slice_axis(qkv_x, axis=-1, begin=0, end=u))
+            k = split(F.slice_axis(qkv_m, axis=-1, begin=u, end=2 * u))
+            v = split(F.slice_axis(qkv_m, axis=-1, begin=2 * u,
+                                   end=3 * u))
         out = F.flash_attention(q, k, v, causal=self._causal)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
                         shape=(0, -1, u))
@@ -112,6 +130,91 @@ class TransformerEncoder(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return self.layers(x)
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Post-LN decoder layer: causal self-attn, cross-attn over the
+    encoder memory, FFN — the WMT transformer decoder (Vaswani et al.
+    2017; capability class of the reference's ``example/nmt``†-era
+    seq2seq line)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.self_attn = MultiHeadAttention(units, num_heads, dropout,
+                                            causal=True)
+        self.cross_attn = MultiHeadAttention(units, num_heads, dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln1 = nn.LayerNorm()
+        self.ln2 = nn.LayerNorm()
+        self.ln3 = nn.LayerNorm()
+
+    def hybrid_forward(self, F, x, memory):
+        x = self.ln1(x + self.self_attn(x))
+        x = self.ln2(x + self.cross_attn(x, memory))
+        x = self.ln3(x + self.ffn(x))
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    """Stack of decoder cells (memory threaded to every layer)."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, remat=False, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            cell = TransformerDecoderCell(units, hidden_size,
+                                          num_heads, dropout)
+            if remat:
+                cell.set_remat(True)
+            self.layers.add(cell)
+
+    def hybrid_forward(self, F, x, memory):
+        for cell in self.layers:
+            x = cell(x, memory)
+        return x
+
+
+class TransformerModel(HybridBlock):
+    """Encoder-decoder transformer for translation (WMT config):
+    shared source/target vocabulary embedding, sinusoid-free learned
+    positions, tied output projection left separate (the reference
+    recipe's default)."""
+
+    def __init__(self, vocab_size, units=1024, hidden_size=4096,
+                 num_layers=6, num_heads=16, max_length=256,
+                 dropout=0.1, remat=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = self.params.get(
+            "pos_embed", shape=(max_length, units), init="normal")
+        self.embed_ln = nn.LayerNorm()
+        self.drop = nn.Dropout(dropout) if dropout else None
+        self.encoder = TransformerEncoder(num_layers, units,
+                                          hidden_size, num_heads,
+                                          dropout, remat=remat)
+        self.decoder = TransformerDecoder(num_layers, units,
+                                          hidden_size, num_heads,
+                                          dropout, remat=remat)
+        self.out_proj = nn.Dense(vocab_size, flatten=False)
+
+    def _embed(self, F, tokens, pos_embed, T):
+        x = self.embed(tokens) * float(np.sqrt(self._units))
+        pe = F.slice_axis(pos_embed, axis=0, begin=0, end=T)
+        x = x + F.expand_dims(pe, axis=0)
+        x = self.embed_ln(x)
+        if self.drop is not None:
+            x = self.drop(x)
+        return x
+
+    def hybrid_forward(self, F, src, tgt, pos_embed=None):
+        Ts = src.shape[1] if hasattr(src, "shape") else None
+        Tt = tgt.shape[1] if hasattr(tgt, "shape") else None
+        memory = self.encoder(self._embed(F, src, pos_embed, Ts))
+        dec = self.decoder(self._embed(F, tgt, pos_embed, Tt), memory)
+        return self.out_proj(dec)
 
 
 class BERTModel(HybridBlock):
@@ -177,3 +280,17 @@ def transformer_encoder(num_layers=6, units=512, hidden_size=2048,
     """Transformer-base encoder stack (WMT-style config 4)."""
     return TransformerEncoder(num_layers, units, hidden_size, num_heads,
                               dropout, causal)
+
+
+def transformer_big(vocab_size=32768, max_length=256, dropout=0.1,
+                    remat=False):
+    """Transformer-big WMT config (north-star workload 4, SURVEY M6):
+    6+6 layers, 1024 units, 16 heads, 4096 FFN."""
+    return TransformerModel(vocab_size, 1024, 4096, 6, 16, max_length,
+                            dropout, remat=remat)
+
+
+def transformer_base(vocab_size=32768, max_length=256, dropout=0.1):
+    """Transformer-base WMT config: 6+6 layers, 512 units, 8 heads."""
+    return TransformerModel(vocab_size, 512, 2048, 6, 8, max_length,
+                            dropout)

@@ -1,0 +1,182 @@
+"""Ablation profile of the BERT-Large training step on the real chip
+(VERDICT r4 item 3 — the profile_resnet.py treatment for BERT).
+
+Decomposes fwd+bwd time at b32 s128 (and b8 s512) by knocking out one
+component at a time and re-measuring the sustained chained step
+(tools/microbench.py methodology: a real data dependence threads the
+iterations, so nothing is DCE'd).  Components are ablated by
+monkeypatching the model module's class names before construction —
+the blocks resolve them at call time.
+
+Usage: PYTHONPATH=.:... python tools/profile_bert.py [batch] [seqlen]
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.microbench import sustained
+
+
+def sustained_ms(fn, x0, n=10, repeats=3):
+    return sustained(fn, x0, n=n, repeats=repeats) * 1e3
+
+
+def build_loss_fn(batch, seqlen, variant, dropout=0.1):
+    """Returns (loss_of(x_tokens_f32) -> scalar, token array)."""
+    import mxtpu.models.transformer as tr
+    from mxtpu import nd
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.gluon import nn
+    from mxtpu.gluon.block import HybridBlock, _traced_forward
+    from mxtpu.ndarray.ndarray import NDArray
+    from mxtpu.symbol import _is_aux_name
+
+    saved = {}
+
+    def patch(name, cls):
+        saved[name] = getattr(tr, name)
+        setattr(tr, name, cls)
+
+    class AttnCoreOnlyV(tr.MultiHeadAttention):
+        # flash-attention core replaced by the value passthrough:
+        # QKV/proj GEMMs stay (isolates the attention-core cost)
+        def hybrid_forward(self, F, x):
+            u, h = self._units, self._heads
+            qkv = self.qkv(x)
+            v = F.slice_axis(qkv, axis=-1, begin=2 * u, end=3 * u)
+            out = self.proj(v)
+            if self.drop is not None:
+                out = self.drop(out)
+            return out
+
+    class AttnIdentity(HybridBlock):
+        def __init__(self, *a, **k):
+            super().__init__()
+
+        def hybrid_forward(self, F, x):
+            return x
+
+    class FFNIdentity(HybridBlock):
+        def __init__(self, *a, **k):
+            super().__init__()
+
+        def hybrid_forward(self, F, x):
+            return x
+
+    class LNIdentity(HybridBlock):
+        def __init__(self, *a, **k):
+            super().__init__()
+
+        def hybrid_forward(self, F, x):
+            return x
+
+    if variant == "attn_core_ablated":
+        patch("MultiHeadAttention", AttnCoreOnlyV)
+    elif variant == "attn_ablated":
+        patch("MultiHeadAttention", AttnIdentity)
+    elif variant == "ffn_ablated":
+        patch("PositionwiseFFN", FFNIdentity)
+    elif variant == "ln_ablated":
+        saved["LayerNorm"] = nn.LayerNorm
+        nn.LayerNorm = LNIdentity
+
+    if variant == "no_dropout":
+        dropout = 0.0
+
+    try:
+        net = tr.bert_large(vocab_size=30522, max_length=seqlen,
+                            dropout=dropout)
+        if variant == "mlm_ablated":
+            net.mlm = nn.Dense(1024, flatten=False)
+            net.register_child(net.mlm)
+        net.initialize(init="xavier")
+    finally:
+        for k, v in saved.items():
+            if k == "LayerNorm":
+                nn.LayerNorm = v
+            else:
+                setattr(tr, k, v)
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 30522, (batch, seqlen))
+                       .astype(np.float32))
+
+    # collect params once (eager)
+    x_nd = NDArray(toks, None, _placed=True)
+    from mxtpu import autograd
+    with autograd.record():
+        net(x_nd)
+    params = net.collect_params()
+    plist = list(params.values())
+    pvals0 = [p.data().data for p in plist]
+    cdt = jnp.bfloat16
+
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    V = net.mlm._units if hasattr(net.mlm, "_units") else 30522
+
+    def loss_of(tv, xx):
+        pvals = [v.astype(cdt)
+                 if not _is_aux_name(plist[i].name)
+                 and jnp.issubdtype(v.dtype, jnp.floating) else v
+                 for i, v in enumerate(tv)]
+        raw_outs, _, _, _ = _traced_forward(
+            net, {p.name: p for p in plist}, pvals,
+            [NDArray(xx, None, _placed=True)], True,
+            jax.random.PRNGKey(0))
+        pred = NDArray(raw_outs[0], None, _placed=True)
+        l = lfn(pred.reshape((-1, pred.shape[-1])),
+                NDArray(xx.reshape(-1), None, _placed=True))
+        return jnp.mean(l.data.astype(jnp.float32))
+
+    return loss_of, toks, tuple(pvals0), plist
+
+
+def measure_variant(batch, seqlen, variant):
+    loss_of, toks, pvals, plist = build_loss_fn(batch, seqlen, variant)
+
+    grad_fn = jax.grad(lambda tv, xx: loss_of(tv, xx))
+
+    def chain(xx):
+        g = grad_fn(pvals, xx)
+        s = sum(jnp.sum(gi.astype(jnp.float32))
+                for gi in jax.tree_util.tree_leaves(g))
+        # fold the grad signal back into the token ids (kept valid by
+        # a tiny scale + floor) so iterations are data-dependent
+        return jnp.clip(xx + s * 1e-12, 0, 30521)
+
+    return sustained_ms(chain, toks, n=8, repeats=3)
+
+
+VARIANTS = ["full", "attn_core_ablated", "attn_ablated", "ffn_ablated",
+            "mlm_ablated", "ln_ablated", "no_dropout"]
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    seqlen = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    only = sys.argv[3].split(",") if len(sys.argv) > 3 else None
+    print(f"device={jax.devices()[0]} b{batch} s{seqlen} bf16 "
+          f"(fwd+bwd, chained)")
+    base = None
+    for v in VARIANTS:
+        if only and v not in only:
+            continue
+        t = measure_variant(batch, seqlen, v)
+        tok_s = batch * seqlen / t * 1e3
+        delta = f"  (component ~{base - t:6.1f} ms)" \
+            if base is not None and v != "full" else ""
+        if v == "full":
+            base = t
+        print(f"{v:>18}: {t:7.1f} ms/step  {tok_s:9.0f} tok/s{delta}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
