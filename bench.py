@@ -4,7 +4,8 @@ Prints ONE JSON line whose primary metric is the **ResNet-50 ImageNet
 training throughput** (north-star #1, BASELINE.md); the BERT-Large
 (north-star #2) and LeNet numbers ride along in ``extras`` so every
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
-MXTPU_BENCH_MODEL=lenet|resnet50|bert to run a single workload.
+MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512
+to run a single workload.
 
 The measured unit is the full compiled training step — forward,
 backward, fused optimizer (+BN aux writeback) — via
@@ -20,6 +21,17 @@ been empty every round (SURVEY.md provenance caveat), so the baseline
 is our own trend line; regression < 1.0 is failure unless
 ``within_noise`` (the shared-chip tunnel shows 5-15% run-to-run
 spread, recorded per metric in ``band``).
+
+Wall budget (r5 post-mortem: one ~12-minute workload cost the round
+its entire perf record, BENCH_r05.json rc=124): the run carries a
+global deadline (``MXTPU_BENCH_WALL_BUDGET`` seconds, default 780).
+Before each workload the remaining time is checked against that row's
+conservative estimate; a row that does not fit is recorded as
+``{"skipped": "budget"}`` instead of running — the JSON always prints
+and the process always exits 0 inside the window.  The pipeline row
+additionally self-limits: repeats stop when its own slice of the
+budget is spent.  Stale ``mxtpu_bench_rec_*`` temp dirs from killed
+runs are swept at startup.
 """
 import json
 import os
@@ -148,114 +160,140 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
 
 
 def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
-                            repeats=3):
-    """Pipeline-fed ResNet-50 (VERDICT r4 item 2): trains from an
+                            repeats=3, row_budget=None):
+    """Pipeline-fed ResNet-50 (VERDICT r5 item 2): trains from an
     ImageRecordIter over a synthetic raw-record dataset — per-step
-    batches, NO reuse_batch — with background prefetch
-    (PrefetchingIter) and device-side normalization: uint8 crosses
-    the host->device link (~38 MB/batch at ~2 GB/s measured) and the
-    cast + mean/std fuse into the compiled train step.  This is the
-    rate a user's fit() loop achieves with the input pipeline in the
-    loop.
+    batches, NO reuse_batch.  The full L6 pipeline:
+
+        disk → vectorized batch assembly (one read_batch_into +
+        blockwise mirror, worker thread via PrefetchingIter)
+             → double-buffered H2D (DeviceFeedIter: batch N+1's
+               non-blocking device_put issued while step N runs)
+             → compiled step (uint8 crosses the link; cast + mean/std
+               fuse into the first conv's XLA program).
 
     The raw-record tier is the honest rate-proof on THIS host: the
     box has ONE CPU core (nproc=1), which caps cv2 JPEG decode at
-    ~380 img/s no matter the implementation — six times below the
-    chip's compute rate; a standard multi-core TPU host VM runs the
-    same threaded decode pool past the training rate (BASELINE.md
-    "Input pipeline").  Reference: iter_image_recordio_2.cc† +
-    iter_prefetcher.h†."""
+    ~380 img/s no matter the implementation; raw records take decode
+    out and measure the framework's own assembly + feed architecture
+    (BASELINE.md "Input pipeline").  Reference:
+    iter_image_recordio_2.cc† + iter_prefetcher.h†.
+
+    Self-limiting (r5 post-mortem): measurement repeats stop when
+    ``row_budget`` seconds have elapsed in this row — a slow pipeline
+    produces a worse number, never a dead round."""
+    import shutil
     import tempfile
 
     from mxtpu import parallel
     from mxtpu import recordio as rio
     from mxtpu.gluon import loss as gloss
     from mxtpu.gluon import nn
-    from mxtpu.io import ImageRecordIter, PrefetchingIter
+    from mxtpu.io import (DeviceFeedIter, ImageRecordIter,
+                          PrefetchingIter)
     from mxtpu.models import resnet50
 
     batch_size = batch_size or int(
         os.environ.get("MXTPU_BENCH_BATCH", "256"))
+    row_budget = row_budget or float(
+        os.environ.get("MXTPU_BENCH_ROW_BUDGET", "90"))
+    t_row = time.perf_counter()
     d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
-    prefix = os.path.join(d, "synth")
-    rng = np.random.RandomState(0)
-    n_img = 8 * batch_size
-    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
-    base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
-    for i in range(n_img):
-        # distinct images without n_img full RNG draws: roll + refresh
-        if i % 61 == 0:
-            base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
-        rec.write_idx(i, rio.pack(
-            rio.IRHeader(0, float(i % 1000), i, 0),
-            np.roll(base, i % 224, axis=2).tobytes()))
-    rec.close()
+    try:
+        prefix = os.path.join(d, "synth")
+        rng = np.random.RandomState(0)
+        n_img = 4 * batch_size
+        rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                    "w")
+        base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
+        for i in range(n_img):
+            # distinct images without n_img full RNG draws: roll+refresh
+            if i % 61 == 0:
+                base = (rng.rand(3, 224, 224) * 255).astype(np.uint8)
+            rec.write_idx(i, rio.pack(
+                rio.IRHeader(0, float(i % 1000), i, 0),
+                np.roll(base, i % 224, axis=2).tobytes()))
+        rec.close()
 
-    compute_dtype = os.environ.get("MXTPU_BENCH_DTYPE",
-                                   "bfloat16") or "float32"
+        compute_dtype = os.environ.get("MXTPU_BENCH_DTYPE",
+                                       "bfloat16") or "float32"
 
-    class _DeviceNormalize(nn.HybridBlock):
-        """uint8 -> (x - mean)/std on device; XLA fuses it into the
-        step (channel-mean simplification: ImageNet grand mean / std —
-        the arithmetic cost is identical to per-channel).  The 1/std
-        lives in a frozen parameter so the layer inherits the compute
-        dtype from the AMP cast machinery: eager shape-inference sees
-        f32, the compiled step sees bf16 — no hand-managed casts."""
+        class _DeviceNormalize(nn.HybridBlock):
+            """uint8 -> (x - mean)/std on device; XLA fuses it into the
+            step (channel-mean simplification: ImageNet grand mean /
+            std — the arithmetic cost is identical to per-channel).
+            The 1/std lives in a frozen parameter so the layer inherits
+            the compute dtype from the AMP cast machinery: eager
+            shape-inference sees f32, the compiled step sees bf16 — no
+            hand-managed casts."""
 
-        def __init__(self, **kw):
-            super().__init__(**kw)
-            from mxtpu import initializer
-            self.inv_std = self.params.get(
-                "inv_std", shape=(1,),
-                init=initializer.Constant(1.0 / 57.7), grad_req="null")
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                from mxtpu import initializer
+                self.inv_std = self.params.get(
+                    "inv_std", shape=(1,),
+                    init=initializer.Constant(1.0 / 57.7),
+                    grad_req="null")
 
-        def hybrid_forward(self, F, x, inv_std):
-            return (x.astype(str(inv_std.dtype)) - 114.8) * inv_std
+            def hybrid_forward(self, F, x, inv_std):
+                return (x.astype(str(inv_std.dtype)) - 114.8) * inv_std
 
-    net = nn.HybridSequential(prefix="pipe_")
-    net.add(_DeviceNormalize(), resnet50(classes=1000))
-    net.initialize(init="xavier")
-    step = parallel.build_train_step(
-        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        compute_dtype=(compute_dtype if compute_dtype != "float32"
-                       else None),
-        cast_batch=False)
+        net = nn.HybridSequential(prefix="pipe_")
+        net.add(_DeviceNormalize(), resnet50(classes=1000))
+        net.initialize(init="xavier")
+        step = parallel.build_train_step(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+            compute_dtype=(compute_dtype if compute_dtype != "float32"
+                           else None),
+            cast_batch=False)
 
-    it = ImageRecordIter(prefix + ".rec", (3, 224, 224), batch_size,
-                         path_imgidx=prefix + ".idx", shuffle=True,
-                         rand_mirror=True, raw_records=True,
-                         dtype="uint8", preprocess_threads=2)
-    pit = PrefetchingIter(it)
+        # host_batches=True: the worker thread hands raw numpy across
+        # the queue; the single device_put per array happens one batch
+        # ahead in DeviceFeedIter, overlapping the running step
+        it = ImageRecordIter(prefix + ".rec", (3, 224, 224), batch_size,
+                             path_imgidx=prefix + ".idx", shuffle=True,
+                             rand_mirror=True, raw_records=True,
+                             dtype="uint8", preprocess_threads=2,
+                             host_batches=True)
+        feed = DeviceFeedIter(PrefetchingIter(it))
 
-    def batches():
-        while True:
-            try:
-                yield pit.next()
-            except StopIteration:
-                pit.reset()
+        def batches():
+            while True:
+                try:
+                    yield feed.next()
+                except StopIteration:
+                    feed.reset()
 
-    stream = batches()
-    loss = None
-    for _ in range(warmup):  # includes the compile
-        b = next(stream)
-        loss = step(b.data[0], b.label[0])
-    float(loss.asnumpy().mean())
-    vals = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(iters):
+        stream = batches()
+        loss = None
+        for _ in range(warmup):  # includes the compile
             b = next(stream)
-            loss = step(b.data[0], b.label[0])  # async dispatch
-        float(loss.asnumpy().mean())  # sync
-        vals.append(batch_size * iters / (time.perf_counter() - t0))
-    vals.sort()
-    median = vals[len(vals) // 2] if len(vals) % 2 else \
-        0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
-    stats = {"best": max(vals), "median": median, "n": len(vals),
-             "spread": round((max(vals) - min(vals)) / median, 4),
-             "runs": [round(v, 1) for v in vals]}
-    return stats, _METRIC_NAMES["resnet50_pipeline"], "samples/sec"
+            loss = step(b.data[0], b.label[0])
+        float(loss.asnumpy().mean())
+        vals = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                b = next(stream)
+                loss = step(b.data[0], b.label[0])  # async dispatch
+            float(loss.asnumpy().mean())  # sync
+            vals.append(batch_size * iters /
+                        (time.perf_counter() - t0))
+            # stop, don't die: the next repeat must fit what is left
+            # of this row's budget (r5's rc=124 lesson)
+            spent = time.perf_counter() - t_row
+            if spent + (time.perf_counter() - t0) > row_budget:
+                break
+        vals.sort()
+        median = vals[len(vals) // 2] if len(vals) % 2 else \
+            0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+        stats = {"best": max(vals), "median": median, "n": len(vals),
+                 "spread": round((max(vals) - min(vals)) / median, 4),
+                 "runs": [round(v, 1) for v in vals]}
+        return stats, _METRIC_NAMES["resnet50_pipeline"], "samples/sec"
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
@@ -295,6 +333,27 @@ def _mfu(model, value, peak):
     return round(per_unit * value / peak, 4)
 
 
+# Conservative per-row wall estimates (seconds, incl. compile on the
+# tunnel) used by the pre-flight gate: a row only STARTS if this much
+# time is left before the global deadline.  Overestimates drop rows
+# early (recorded, recoverable one-at-a-time via MXTPU_BENCH_MODEL=…);
+# underestimates risk rc=124 — err high.
+_ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
+            "bert_s512": 130, "lenet": 60}
+
+
+def _sweep_stale_tmpdirs():
+    """Remove mxtpu_bench_rec_* dirs left by killed/old runs — each
+    holds a ~150 MB record set (VERDICT r5 weak #6: ~1.8 GB had
+    accumulated)."""
+    import glob
+    import shutil
+    import tempfile
+    for d in glob.glob(os.path.join(tempfile.gettempdir(),
+                                    "mxtpu_bench_rec_*")):
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main():
     which = os.environ.get("MXTPU_BENCH_MODEL", "all")
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
@@ -309,6 +368,9 @@ def main():
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")
+    _sweep_stale_tmpdirs()
+    budget = float(os.environ.get("MXTPU_BENCH_WALL_BUDGET", "780"))
+    deadline = time.monotonic() + budget
     peak = _peak_flops()
     baseline = {}
     self_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -319,8 +381,25 @@ def main():
 
     order = [which] if which != "all" else \
         ["resnet50", "resnet50_pipeline", "bert", "bert_s512", "lenet"]
+    est_total = sum(_ROW_EST[m] for m in order)
+    if est_total > budget:
+        print(f"bench pre-flight: estimated {est_total}s for "
+              f"{order} exceeds MXTPU_BENCH_WALL_BUDGET={budget:.0f}s; "
+              f"tail rows will be skipped with a budget marker",
+              file=sys.stderr)
     results = {}
     for model in order:
+        remaining = deadline - time.monotonic()
+        if remaining < _ROW_EST[model]:
+            # r5 lesson: a row that cannot finish must be DROPPED ON
+            # RECORD, never allowed to run the process into rc=124
+            results[model] = {"metric": _METRIC_NAMES[model],
+                              "value": None, "unit": None, "mfu": None,
+                              "vs_baseline": None,
+                              "skipped": "budget",
+                              "est_seconds": _ROW_EST[model],
+                              "remaining_seconds": round(remaining, 1)}
+            continue
         # one workload failing (e.g. a transient tunnel error) must not
         # cost the round its benchmark line — record the error and move on
         try:
@@ -357,6 +436,11 @@ def main():
     if len(results) > 1:
         out["extras"] = {m: results[m] for m in order
                          if results[m] is not primary}
+    out["wall"] = {"budget_seconds": round(budget, 1),
+                   "elapsed_seconds": round(
+                       budget - (deadline - time.monotonic()), 1),
+                   "skipped": [m for m in order
+                               if results[m].get("skipped")]}
     print(json.dumps(out))
 
 

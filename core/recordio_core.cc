@@ -14,6 +14,17 @@
  *                                      validates magics (recovery scan)
  *   read_batch(path, offsets, lengths, n_threads=4)
  *                                   -> list of bytes; parallel pread()
+ *   read_batch_into(path, offsets, lengths, out, header_bytes,
+ *                   n_threads=4)    -> bytes (N*header_bytes of headers);
+ *                                      reads N EQUAL-PAYLOAD records,
+ *                                      writing payload[header_bytes:]
+ *                                      into row i of the writable
+ *                                      buffer `out` — the ImageRecordIter
+ *                                      raw-record fast path: framing,
+ *                                      header split, and batch assembly
+ *                                      all leave Python (one call per
+ *                                      batch, GIL released, parallel
+ *                                      pread)
  *   pack_header(flag,label,id,id2)  -> bytes (IRHeader wire format)
  *
  * Wire format (must match mxtpu/recordio.py): u32 magic 0xced7230a,
@@ -207,6 +218,140 @@ static PyObject *py_read_batch(PyObject *, PyObject *args) {
   return result;
 }
 
+/* Read one logical record, routing the first `hdr_len` payload bytes
+ * into `hdr` and the remaining `row_len` bytes into `row`.  The
+ * record's total payload must be exactly hdr_len + row_len. */
+static int read_record_split(int fd, int64_t off, int64_t hdr_len,
+                             char *hdr, int64_t row_len, char *row) {
+  int64_t written = 0;
+  int64_t total = hdr_len + row_len;
+  int64_t pos = off;
+  while (written < total) {
+    unsigned char header[8];
+    if (pread(fd, header, 8, pos) != 8) return -1;
+    uint32_t magic, lrec;
+    memcpy(&magic, header, 4);
+    memcpy(&lrec, header + 4, 4);
+    if (magic != kMagic) return -1;
+    int64_t len = lrec & ((1u << 29) - 1);
+    if (written + len > total) return -1;
+    int64_t src = pos + 8;
+    int64_t remain = len;
+    if (written < hdr_len) {
+      int64_t take = hdr_len - written < remain ? hdr_len - written
+                                                : remain;
+      if (pread(fd, hdr + written, (size_t)take, src) != (ssize_t)take)
+        return -1;
+      written += take;
+      src += take;
+      remain -= take;
+    }
+    if (remain > 0) {
+      if (pread(fd, row + (written - hdr_len), (size_t)remain, src) !=
+          (ssize_t)remain)
+        return -1;
+      written += remain;
+    }
+    pos += 8 + ((len + 3) & ~3ll);
+  }
+  return 0;
+}
+
+static PyObject *py_read_batch_into(PyObject *, PyObject *args) {
+  const char *path;
+  PyObject *offs_obj, *lens_obj;
+  Py_buffer out;
+  int header_bytes;
+  int n_threads = 4;
+  if (!PyArg_ParseTuple(args, "sOOw*i|i", &path, &offs_obj, &lens_obj,
+                        &out, &header_bytes, &n_threads))
+    return nullptr;
+  Py_ssize_t n = PySequence_Size(offs_obj);
+  if (n <= 0 || PySequence_Size(lens_obj) != n || header_bytes < 0) {
+    PyBuffer_Release(&out);
+    PyErr_SetString(PyExc_ValueError,
+                    "offsets/lengths mismatch or empty batch");
+    return nullptr;
+  }
+  std::vector<int64_t> offs(n);
+  int64_t payload = -1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PySequence_GetItem(offs_obj, i);
+    PyObject *l = PySequence_GetItem(lens_obj, i);
+    offs[i] = PyLong_AsLongLong(o);
+    int64_t li = PyLong_AsLongLong(l);
+    Py_XDECREF(o);
+    Py_XDECREF(l);
+    if (PyErr_Occurred()) {
+      PyBuffer_Release(&out);
+      return nullptr;
+    }
+    if (payload < 0) payload = li;
+    if (li != payload) {
+      PyBuffer_Release(&out);
+      PyErr_SetString(PyExc_ValueError,
+                      "read_batch_into needs equal record lengths");
+      return nullptr;
+    }
+  }
+  int64_t row = payload - header_bytes;
+  if (row < 0 || !PyBuffer_IsContiguous(&out, 'C') ||
+      (int64_t)out.len != row * n) {
+    PyBuffer_Release(&out);
+    PyErr_Format(PyExc_ValueError,
+                 "out buffer must be C-contiguous with %lld bytes "
+                 "(%lld records x %lld row bytes)",
+                 (long long)(row * n), (long long)n, (long long)row);
+    return nullptr;
+  }
+  PyObject *hdrs = PyBytes_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * (int64_t)header_bytes));
+  if (!hdrs) {
+    PyBuffer_Release(&out);
+    return nullptr;
+  }
+  char *hdr_base = PyBytes_AS_STRING(hdrs);
+  char *row_base = (char *)out.buf;
+  int failed = 0;
+  Py_BEGIN_ALLOW_THREADS {
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    if ((Py_ssize_t)n_threads > n) n_threads = (int)n;
+    std::vector<std::thread> workers;
+    std::vector<int> fails((size_t)n_threads, 0);
+    for (int t = 0; t < n_threads; ++t) {
+      workers.emplace_back([&, t]() {
+        int fd = open(path, O_RDONLY);
+        if (fd < 0) {
+          fails[t] = 1;
+          return;
+        }
+        for (Py_ssize_t i = t; i < n; i += n_threads) {
+          if (read_record_split(fd, offs[i], header_bytes,
+                                hdr_base + i * (int64_t)header_bytes,
+                                row, row_base + i * row) != 0) {
+            fails[t] = 1;
+            break;
+          }
+        }
+        close(fd);
+      });
+    }
+    for (auto &w : workers) w.join();
+    for (int t = 0; t < n_threads; ++t) failed |= fails[t];
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&out);
+  if (failed) {
+    Py_DECREF(hdrs);
+    PyErr_SetString(PyExc_IOError,
+                    "read_batch_into failed (record length mismatch, "
+                    "corrupt record, or unreadable file)");
+    return nullptr;
+  }
+  return hdrs;
+}
+
 static PyObject *py_pack_header(PyObject *, PyObject *args) {
   unsigned int flag;
   float label;
@@ -226,6 +371,9 @@ static PyMethodDef Methods[] = {
      "scan(path) -> (offsets, lengths): index all records at C speed"},
     {"read_batch", py_read_batch, METH_VARARGS,
      "read_batch(path, offsets, lengths, n_threads=4) -> list[bytes]"},
+    {"read_batch_into", py_read_batch_into, METH_VARARGS,
+     "read_batch_into(path, offsets, lengths, out, header_bytes, "
+     "n_threads=4) -> headers bytes; payloads land in rows of `out`"},
     {"pack_header", py_pack_header, METH_VARARGS,
      "pack_header(flag, label, id, id2) -> IRHeader bytes"},
     {nullptr, nullptr, 0, nullptr}};

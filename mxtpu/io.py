@@ -27,8 +27,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
-           "ResizeIter", "PrefetchingIter", "CSVIter", "LibSVMIter",
-           "MNISTIter", "ImageRecordIter"]
+           "ResizeIter", "PrefetchingIter", "DeviceFeedIter", "CSVIter",
+           "LibSVMIter", "MNISTIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -343,6 +343,88 @@ class PrefetchingIter(DataIter):
             pass
 
 
+class DeviceFeedIter(DataIter):
+    """Double-buffered host→device feed — the H2D half of the
+    reference's PrefetcherIter (``iter_prefetcher.h``†).
+
+    Keeps ONE staged batch in flight ahead of the consumer: when
+    ``next()`` hands back batch N, batch N+1's ``device_put`` has
+    already been issued.  jax transfers are asynchronous — the call
+    returns immediately with the host→HBM copy running in the
+    background, and the compiled step's own input dependency is the
+    sync point — so the copy for N+1 overlaps the step for N.
+
+    Compose with :class:`PrefetchingIter` for the full pipeline::
+
+        disk → assemble (worker thread) → H2D (in flight) → step
+
+    with ``host_batches=True`` on the inner :class:`ImageRecordIter`
+    so the worker thread hands over raw numpy and the single
+    ``device_put`` per array happens here, one batch ahead.
+    """
+
+    def __init__(self, data_iter: DataIter, ctx=None):
+        super().__init__(data_iter.batch_size)
+        import jax
+        self.data_iter = data_iter
+        self._device = ctx.jax_device if ctx is not None \
+            else jax.devices()[0]
+        self._pending: Optional[DataBatch] = None
+        self._done = False
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def _stage(self, batch: DataBatch) -> DataBatch:
+        import jax
+
+        def put(arrs):
+            out = []
+            for a in arrs or []:
+                raw = a.data if isinstance(a, NDArray) else a
+                out.append(NDArray(jax.device_put(raw, self._device),
+                                   None, _placed=True))
+            return out
+
+        return DataBatch(data=put(batch.data), label=put(batch.label),
+                         pad=batch.pad, index=batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _pull(self) -> Optional[DataBatch]:
+        try:
+            return self._stage(self.data_iter.next())
+        except StopIteration:
+            return None
+
+    def reset(self):
+        self.data_iter.reset()
+        self._pending = None
+        self._done = False
+
+    def next(self) -> DataBatch:
+        if self._pending is None:
+            if self._done:
+                self._done = False  # epoch boundary consumed
+                raise StopIteration
+            self._pending = self._pull()
+            if self._pending is None:
+                raise StopIteration
+        out = self._pending
+        self._pending = self._pull()  # issue N+1's H2D before handing N
+        if self._pending is None:
+            self._done = True
+        return out
+
+    def iter_next(self):
+        raise MXNetError("use next() on DeviceFeedIter")
+
+
 class CSVIter(DataIter):
     """CSV file iterator (reference C++ ``CSVIter``,
     ``src/io/iter_csv.cc``†) — host-side parse, padded final batch."""
@@ -542,7 +624,7 @@ class ImageRecordIter(DataIter):
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  scale=1.0, label_width=1, round_batch=True,
                  preprocess_threads=4, seed=0, raw_records=False,
-                 dtype="float32", **_ignored):
+                 dtype="float32", host_batches=False, **_ignored):
         super().__init__(batch_size)
         from . import recordio as rio
         # raw_records: records hold pre-decoded CHW pixel bytes at
@@ -551,7 +633,18 @@ class ImageRecordIter(DataIter):
         # normalization (the cast + normalize fuses into the first
         # conv's XLA program; the TPU input-pipeline recipe for
         # single-core hosts, BASELINE.md "Input pipeline").
+        # Raw batches are assembled VECTORIZED: the whole batch is read
+        # in one call (native read_batch_into when core/ is built),
+        # then one frombuffer + blockwise mirror/normalize — NumPy
+        # releases the GIL on the big copies, so assembly no longer
+        # serializes against training dispatch (VERDICT r5 item 2).
         self.raw_records = bool(raw_records)
+        # host_batches: yield numpy instead of NDArray — the producer
+        # side of a DeviceFeedIter pipeline, where the single
+        # device_put per array is issued one batch ahead
+        self.host_batches = bool(host_batches)
+        self._raw_batched = True      # drops to per-record on ragged files
+        self._raw_meta = None         # (header_bytes, flag), lazy
         self._out_dtype = np.dtype(dtype)
         if self._out_dtype not in (np.dtype(np.float32),
                                    np.dtype(np.uint8)):
@@ -670,7 +763,119 @@ class ImageRecordIter(DataIter):
             label = float(label[0])
         return img.transpose(2, 0, 1), label
 
+    # -- vectorized raw-record batch assembly --------------------------
+
+    def _raw_init_meta(self, first_raw: bytes):
+        """Derive (header_bytes, flag) from the first record; raw files
+        are homogeneous (fixed shape, fixed label flag) by contract."""
+        from . import recordio as rio
+        header, body = rio.unpack(first_raw)
+        nbytes = int(np.prod(self.data_shape))
+        if len(body) != nbytes:
+            raise MXNetError(
+                f"raw record payload is {len(body)} bytes but "
+                f"data_shape {self.data_shape} needs {nbytes}")
+        self._raw_meta = (len(first_raw) - nbytes, int(header.flag))
+
+    def _parse_raw_headers(self, hdrs: bytes, n: int) -> np.ndarray:
+        """Vectorized IRHeader parse → (n, label_width) float32."""
+        from . import recordio as rio
+        hdr_bytes, flag = self._raw_meta
+        h = np.frombuffer(hdrs, np.uint8).reshape(n, hdr_bytes)
+        if flag == 0:
+            lab = h[:, 4:8].copy().view(np.float32)
+            if self.label_width > 1:
+                lab = np.broadcast_to(lab, (n, self.label_width))
+        else:
+            if flag < self.label_width:
+                raise MXNetError(
+                    f"records carry {flag} labels, label_width is "
+                    f"{self.label_width}")
+            lab = h[:, rio._IR_SIZE:rio._IR_SIZE + 4 * flag].copy() \
+                .view(np.float32)[:, :self.label_width]
+        return np.ascontiguousarray(lab, np.float32)
+
+    def _next_raw_batch(self) -> DataBatch:
+        from . import recordio as rio
+        if self._exhausted:
+            raise StopIteration
+        B = self.batch_size
+        nbytes = int(np.prod(self.data_shape))
+        pix = np.empty((B,) + self.data_shape, np.uint8)
+        if self._keys is not None:
+            n = min(B, len(self._order) - self._pos)
+            keys = self._order[self._pos:self._pos + n]
+            self._pos += n
+            if n:
+                if self._raw_meta is None:
+                    self._raw_init_meta(self._rec.read_idx(keys[0]))
+                hdr_bytes, _ = self._raw_meta
+                try:
+                    hdrs = rio.read_batch_into(
+                        self._rec.uri, [self._rec.idx[k] for k in keys],
+                        [hdr_bytes + nbytes] * n, pix[:n], hdr_bytes,
+                        self._threads)
+                except (OSError, ValueError, MXNetError):
+                    # irregular records: rewind and let the per-record
+                    # path (which re-frames every record) handle them
+                    self._pos -= n
+                    self._raw_batched = False
+                    return self._next_per_record()
+        else:
+            raws = []
+            while len(raws) < B:
+                raw = self._rec.read()
+                if raw is None:
+                    break
+                raws.append(raw)
+            n = len(raws)
+            if n:
+                if self._raw_meta is None:
+                    self._raw_init_meta(raws[0])
+                hdr_bytes, _ = self._raw_meta
+                if any(len(r) != hdr_bytes + nbytes for r in raws):
+                    raise MXNetError(
+                        "ragged raw records (lengths differ); cannot "
+                        "batch-assemble")
+                rows = np.frombuffer(b"".join(raws), np.uint8) \
+                    .reshape(n, hdr_bytes + nbytes)
+                pix[:n].reshape(n, nbytes)[...] = rows[:, hdr_bytes:]
+                hdrs = rows[:, :hdr_bytes].tobytes()
+        if n == 0:
+            self._exhausted = True
+            raise StopIteration
+        labels = self._parse_raw_headers(hdrs, n)
+        aug = self._rng.rand(n, 3)
+        if self.rand_mirror:
+            m = np.nonzero(aug[:, 2] < 0.5)[0]
+            if m.size:
+                pix[m] = pix[m][..., ::-1]
+        pad = B - n
+        if pad:
+            self._exhausted = True
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            reps = np.arange(n, B) % n
+            pix[n:] = pix[reps]
+            labels = np.concatenate([labels, labels[reps]], axis=0)
+        if self._out_dtype == np.uint8:
+            data = pix
+        else:
+            data = (pix.astype(np.float32) -
+                    self.mean.reshape(1, 3, 1, 1)) * self.scale / \
+                self.std.reshape(1, 3, 1, 1)
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        wrap = (lambda a: a) if self.host_batches else array
+        return DataBatch(data=[wrap(data)], label=[wrap(lab)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
     def next(self) -> DataBatch:
+        if self.raw_records and self._raw_batched:
+            return self._next_raw_batch()
+        return self._next_per_record()
+
+    def _next_per_record(self) -> DataBatch:
         if self._exhausted:
             raise StopIteration
         c, h, w = self.data_shape
@@ -712,7 +917,8 @@ class ImageRecordIter(DataIter):
                 data[i] = data[i - n]
                 labels[i] = labels[i - n]
         lab = labels[:, 0] if self.label_width == 1 else labels
-        return DataBatch(data=[array(data)], label=[array(lab)], pad=pad,
+        wrap = (lambda a: a) if self.host_batches else array
+        return DataBatch(data=[wrap(data)], label=[wrap(lab)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
 

@@ -26,6 +26,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: strict/heavy variants excluded from the tier-1 "
+        "`-m 'not slow'` run")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Per-test deterministic seeding — the reference's @with_seed()

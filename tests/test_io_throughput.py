@@ -54,7 +54,9 @@ def test_imagerecorditer_throughput(tmp_path):
     rate = n_img / dt
     log.info("ImageRecordIter: %.0f images/sec (decode+augment, "
              "224^2)", rate)
-    assert rate > 50, rate
+    # measured ~435 img/s on the 1-core CI box; 120 keeps ~3.5x slack
+    # while still catching an order-of-magnitude regression
+    assert rate > 120, rate
 
 
 class _SquareDataset(Dataset):
@@ -135,3 +137,124 @@ def test_dataloader_process_pool_persists_across_epochs():
     assert dl._proc_pool is pool  # same workers, not respawned
     dl.close()
     assert dl._proc_pool is None
+
+
+# ----------------------------------------------------------------------
+# raw (pre-decoded) record fast path: batched assembly + uint8 output
+# ----------------------------------------------------------------------
+
+
+def _pack_raw(prefix, n=64, shape=(3, 32, 32)):
+    rng = np.random.RandomState(7)
+    imgs = (rng.rand(n, *shape) * 255).astype(np.uint8)
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        rec.write_idx(i, rio.pack(
+            rio.IRHeader(0, float(i % 10), i, 0), imgs[i].tobytes()))
+    rec.close()
+    return prefix + ".rec", prefix + ".idx", imgs
+
+
+def test_imagerecorditer_raw_uint8_roundtrip(tmp_path):
+    """raw_records=True + dtype='uint8' returns the packed pixels
+    bit-exactly, labels included, pad rows cycling from the batch
+    head."""
+    rec, idx, imgs = _pack_raw(str(tmp_path / "raw"), n=10)
+    it = ImageRecordIter(rec, (3, 32, 32), batch_size=4,
+                         path_imgidx=idx, shuffle=False,
+                         raw_records=True, dtype="uint8")
+    got, labels, pads = [], [], []
+    for b in it:
+        got.append(b.data[0].asnumpy())
+        labels.append(b.label[0].asnumpy())
+        pads.append(b.pad)
+    assert [g.dtype for g in got] == [np.uint8] * 3
+    assert pads == [0, 0, 2]
+    out = np.concatenate(got)
+    np.testing.assert_array_equal(out[:10], imgs)
+    np.testing.assert_array_equal(out[10:], imgs[8:10])  # pad cycles
+    lab = np.concatenate(labels)[:10]
+    np.testing.assert_array_equal(lab.ravel(),
+                                  np.arange(10, dtype=np.float32) % 10)
+
+
+def test_imagerecorditer_raw_batched_matches_per_record(tmp_path):
+    """The vectorized batch-assembly path must reproduce the
+    per-record loop bit-exactly under identical seeds — shuffle,
+    mirror, normalization, uint8 and float32 alike."""
+    rec, idx, _ = _pack_raw(str(tmp_path / "par"), n=22)
+
+    def epoch(batched, dtype):
+        kw = {}
+        if dtype == "float32":
+            kw = dict(mean_r=123.7, mean_g=116.3, mean_b=103.5,
+                      std_r=58.4, std_g=57.1, std_b=57.4)
+        it = ImageRecordIter(rec, (3, 32, 32), batch_size=8,
+                             path_imgidx=idx, shuffle=True,
+                             rand_mirror=True, seed=11,
+                             raw_records=True, dtype=dtype, **kw)
+        it._raw_batched = batched
+        out = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+               for b in it]
+        it.close()
+        return out
+
+    for dtype in ("uint8", "float32"):
+        fast = epoch(True, dtype)
+        slow = epoch(False, dtype)
+        assert len(fast) == len(slow)
+        for (fd, fl, fp), (sd, sl, sp) in zip(fast, slow):
+            assert fp == sp
+            np.testing.assert_array_equal(fd, sd,
+                                          err_msg=f"dtype={dtype}")
+            np.testing.assert_array_equal(fl, sl)
+
+
+def test_imagerecorditer_raw_sequential_no_index(tmp_path):
+    """Batched assembly also covers the no-index sequential path."""
+    rec, idx, imgs = _pack_raw(str(tmp_path / "seq"), n=12)
+    it = ImageRecordIter(rec, (3, 32, 32), batch_size=4,
+                         raw_records=True, dtype="uint8")
+    out = np.concatenate([b.data[0].asnumpy() for b in it])
+    np.testing.assert_array_equal(out, imgs)
+
+
+def _raw_rate(tmp_path, n=256, batch=64, epochs=3):
+    rec, idx, _ = _pack_raw(str(tmp_path / "rate"), n=n,
+                            shape=(3, 224, 224))
+    it = ImageRecordIter(rec, (3, 224, 224), batch_size=batch,
+                         path_imgidx=idx, shuffle=True,
+                         rand_mirror=True, raw_records=True,
+                         dtype="uint8", preprocess_threads=2)
+    for _ in it:  # warmup epoch
+        pass
+    n_img = 0
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        it.reset()
+        for batch_ in it:
+            n_img += batch_.data[0].shape[0] - batch_.pad
+    dt = time.perf_counter() - t0
+    it.close()
+    return n_img / dt
+
+
+def test_imagerecorditer_raw_batched_throughput(tmp_path):
+    """Floor for the batched raw path at ResNet shapes.  Measured
+    ~5,300 img/s on the 1-core CI box (vs ~2,800 per-record, ~170 for
+    the r5 per-record path under bench contention); 500 is a 10x
+    cushion that still catches a fall back to per-record Python."""
+    rate = _raw_rate(tmp_path)
+    log.info("raw batched ImageRecordIter: %.0f images/sec (uint8, "
+             "224^2)", rate)
+    assert rate > 500, rate
+
+
+@pytest.mark.slow
+def test_imagerecorditer_raw_batched_throughput_strict(tmp_path):
+    """Strict variant (excluded from tier-1): the vectorized path
+    should hold well above the per-record loop's ~2,800 img/s."""
+    rate = _raw_rate(tmp_path, n=512, epochs=5)
+    log.info("raw batched ImageRecordIter (strict): %.0f images/sec",
+             rate)
+    assert rate > 2000, rate

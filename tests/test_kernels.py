@@ -278,3 +278,82 @@ def test_flash_attention_op():
                               jnp.asarray(v), True)
     np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_unaligned_pads_not_falls_back():
+    """T not a multiple of 8 (e.g. the observed T=12) keeps the fused
+    kernel via exact pad-and-mask — no warning, reference parity."""
+    import warnings
+    rng = np.random.RandomState(9)
+    B, H, D = 2, 3, 16
+    cases = [(12, 12, True), (12, 12, False), (5, 5, True),
+             (7, 19, False), (12, 20, True)]  # Tq ≡ Tk mod 8 causal OK
+    for Tq, Tk, causal in cases:
+        q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
+        k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
+        v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = flash_attention(q, k, v, causal=causal)
+        assert not w, (Tq, Tk, causal, [str(x.message) for x in w])
+        ref = attention_reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"Tq={Tq} Tk={Tk} "
+                                           f"causal={causal}")
+
+
+def test_flash_attention_unaligned_causal_no_future_leak():
+    """Padded causal run stays causal: perturbing future keys/values
+    must not change earlier outputs."""
+    rng = np.random.RandomState(10)
+    B, H, T, D = 1, 2, 12, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    got = flash_attention(q, k, v, causal=True)
+    v2 = v.at[:, :, -1].set(v[:, :, -1] + 100.0)
+    got2 = flash_attention(q, k, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(got[:, :, :-1]),
+                               np.asarray(got2[:, :, :-1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_unaligned_grad():
+    """Gradients flow through the pad-and-mask path and match the
+    reference."""
+    rng = np.random.RandomState(11)
+    B, H, T, D = 1, 2, 12, 8
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    for causal in (True, False):
+        gp = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"{name} causal={causal}")
+
+
+def test_flash_attention_unaligned_causal_cross_falls_back():
+    """Causal cross lengths with Tq % 8 != Tk % 8 cannot be padded
+    exactly (the diagonal would shift) — pinned: warn + exact
+    reference fallback."""
+    import warnings
+    rng = np.random.RandomState(12)
+    B, H, Tq, Tk, D = 1, 2, 12, 16, 8
+    q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = flash_attention(q, k, v, causal=True)
+    assert w and "diagonal" in str(w[0].message)
+    ref = attention_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0, atol=0)

@@ -285,3 +285,66 @@ def test_set_remat_invalidates_hybridize_cache():
     out2 = net(x).asnumpy()
     assert len(net._cached_entries) == 2  # new generation, new entry
     np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# encoder-decoder TransformerModel (the translation config bench.py's
+# transformer rows instantiate — previously zero direct coverage)
+# ----------------------------------------------------------------------
+
+
+def test_transformer_model_smoke_train():
+    """Tiny encoder-decoder learns a copy task: loss drops and the
+    decoder path (cross-attention + causal self-attention at a
+    non-multiple-of-8 T) runs end to end."""
+    from mxtpu.models.transformer import TransformerModel
+    V = 16
+    net = TransformerModel(vocab_size=V, units=32, hidden_size=64,
+                           num_layers=2, num_heads=4, max_length=16,
+                           dropout=0.0)
+    net.initialize(init="xavier")
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(60):
+        toks = rng.randint(0, V, (8, 12)).astype(np.float32)
+        src, tgt = nd.array(toks), nd.array(toks)
+        with autograd.record():
+            out = net(src, tgt)
+            l = L(out.reshape((-1, V)), tgt.reshape((-1,)))
+        l.backward()
+        tr.step(8)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_transformer_big_config():
+    """transformer_big pins the WMT big config (north-star workload 4):
+    6+6 layers, 1024 units, 16 heads, 4096 FFN, shared embedding."""
+    from mxtpu.models.transformer import (TransformerModel,
+                                          transformer_big)
+    net = transformer_big(vocab_size=512, max_length=32)
+    assert isinstance(net, TransformerModel)
+    assert len(net.encoder.layers._children) == 6
+    assert len(net.decoder.layers._children) == 6
+    enc0 = list(net.encoder.layers._children.values())[0]
+    assert enc0.attn._heads == 16 and enc0.attn._units == 1024
+    assert enc0.ffn.ffn1._units == 4096  # FFN up-projection width
+    assert net.pos_embed.shape == (32, 1024)
+
+
+@pytest.mark.slow
+def test_transformer_big_smoke_forward():
+    """transformer_big (full width, small vocab) runs a forward pass
+    and produces finite logits of the right shape."""
+    from mxtpu.models.transformer import transformer_big
+    net = transformer_big(vocab_size=64, max_length=16)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 64, (2, 8)).astype(np.float32)
+    out = net(nd.array(toks), nd.array(toks))
+    assert out.shape == (2, 8, 64)
+    assert np.isfinite(out.asnumpy()).all()
