@@ -20,7 +20,8 @@ from ..block import Block, HybridBlock, _emit_aux_update
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "Embedding",
+           "BatchNorm", "InstanceNorm", "LayerNorm",
+           "FusedResidualLayerNorm", "Embedding",
            "Flatten", "Lambda", "HybridLambda"]
 
 
@@ -316,6 +317,44 @@ class LayerNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._eps)
+
+
+class FusedResidualLayerNorm(HybridBlock):
+    """Transformer post-LN epilogue as one layer:
+    ``LN(residual + dropout(x + bias))`` over the last axis, lowered to
+    the fused ``FusedResidualLayerNorm`` op (Pallas kernel on TPU).
+
+    Owns the bias that the preceding projection would otherwise apply —
+    build that ``Dense`` with ``use_bias=False`` and let this layer
+    fold the bias into the epilogue kernel.  Call as
+    ``layer(x, residual)``."""
+
+    def __init__(self, dropout=0.1, epsilon=1e-5,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(prefix, params)
+        self._p = dropout
+        self._eps = epsilon
+        self.bias = self.params.get(
+            "bias", shape=(in_channels,), init=bias_initializer,
+            allow_deferred_init=True)
+        self.gamma = self.params.get(
+            "gamma", shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def _infer_params(self, x, *args):
+        c = int(x.shape[-1])
+        for p in (self.bias, self.gamma, self.beta):
+            if p.shape and p.shape[0] == 0:
+                p.shape = (c,)
+
+    def hybrid_forward(self, F, x, residual, bias, gamma, beta):
+        return F.FusedResidualLayerNorm(x, bias, residual, gamma, beta,
+                                        p=self._p, eps=self._eps)
 
 
 class Embedding(HybridBlock):

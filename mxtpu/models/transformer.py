@@ -32,7 +32,7 @@ class MultiHeadAttention(HybridBlock):
     transformer)."""
 
     def __init__(self, units, num_heads, dropout=0.0, causal=False,
-                 **kwargs):
+                 proj_bias=True, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by "
@@ -41,7 +41,9 @@ class MultiHeadAttention(HybridBlock):
         self._heads = num_heads
         self._causal = causal
         self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True)
-        self.proj = nn.Dense(units, flatten=False, use_bias=True)
+        # proj_bias=False when a FusedResidualLayerNorm epilogue folds
+        # the output bias (and dropout) into its fused kernel
+        self.proj = nn.Dense(units, flatten=False, use_bias=proj_bias)
         self.drop = nn.Dropout(dropout) if dropout else None
 
     def _split_heads(self, F, t):
@@ -80,10 +82,11 @@ class MultiHeadAttention(HybridBlock):
 class PositionwiseFFN(HybridBlock):
     """Dense → gelu → Dense (the transformer MLP)."""
 
-    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+    def __init__(self, units, hidden_size, dropout=0.0, out_bias=True,
+                 **kwargs):
         super().__init__(**kwargs)
         self.ffn1 = nn.Dense(hidden_size, flatten=False)
-        self.ffn2 = nn.Dense(units, flatten=False)
+        self.ffn2 = nn.Dense(units, flatten=False, use_bias=out_bias)
         self.drop = nn.Dropout(dropout) if dropout else None
 
     def hybrid_forward(self, F, x):
@@ -100,15 +103,19 @@ class TransformerEncoderCell(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  causal=False, **kwargs):
         super().__init__(**kwargs)
-        self.attn = MultiHeadAttention(units, num_heads, dropout,
-                                       causal)
-        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
-        self.ln1 = nn.LayerNorm()
-        self.ln2 = nn.LayerNorm()
+        # output bias + dropout + residual + LN run as ONE fused
+        # epilogue (kernels/layer_norm.py), so the sub-blocks emit the
+        # raw GEMM output: no proj bias, no separate Dropout
+        self.attn = MultiHeadAttention(units, num_heads, 0.0, causal,
+                                       proj_bias=False)
+        self.ffn = PositionwiseFFN(units, hidden_size, 0.0,
+                                   out_bias=False)
+        self.ln1 = nn.FusedResidualLayerNorm(dropout)
+        self.ln2 = nn.FusedResidualLayerNorm(dropout)
 
     def hybrid_forward(self, F, x):
-        x = self.ln1(x + self.attn(x))
-        x = self.ln2(x + self.ffn(x))
+        x = self.ln1(self.attn(x), x)
+        x = self.ln2(self.ffn(x), x)
         return x
 
 
@@ -141,18 +148,21 @@ class TransformerDecoderCell(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  **kwargs):
         super().__init__(**kwargs)
-        self.self_attn = MultiHeadAttention(units, num_heads, dropout,
-                                            causal=True)
-        self.cross_attn = MultiHeadAttention(units, num_heads, dropout)
-        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
-        self.ln1 = nn.LayerNorm()
-        self.ln2 = nn.LayerNorm()
-        self.ln3 = nn.LayerNorm()
+        self.self_attn = MultiHeadAttention(units, num_heads, 0.0,
+                                            causal=True,
+                                            proj_bias=False)
+        self.cross_attn = MultiHeadAttention(units, num_heads, 0.0,
+                                             proj_bias=False)
+        self.ffn = PositionwiseFFN(units, hidden_size, 0.0,
+                                   out_bias=False)
+        self.ln1 = nn.FusedResidualLayerNorm(dropout)
+        self.ln2 = nn.FusedResidualLayerNorm(dropout)
+        self.ln3 = nn.FusedResidualLayerNorm(dropout)
 
     def hybrid_forward(self, F, x, memory):
-        x = self.ln1(x + self.self_attn(x))
-        x = self.ln2(x + self.cross_attn(x, memory))
-        x = self.ln3(x + self.ffn(x))
+        x = self.ln1(self.self_attn(x), x)
+        x = self.ln2(self.cross_attn(x, memory), x)
+        x = self.ln3(self.ffn(x), x)
         return x
 
 

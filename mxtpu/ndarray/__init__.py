@@ -164,6 +164,26 @@ def Dropout(data, p=0.5, mode=None, axes=()):  # noqa: N802
 setattr(_THIS_MODULE, "Dropout", Dropout)
 setattr(_THIS_MODULE, "dropout", Dropout)
 
+# FusedResidualLayerNorm convenience: auto key + mode, like Dropout
+_raw_frln = getattr(_THIS_MODULE, "FusedResidualLayerNorm")
+
+
+def FusedResidualLayerNorm(data, bias, residual, gamma, beta, p=0.1,  # noqa: N802
+                           eps=1e-5, mode=None):
+    """LN(residual + dropout(data + bias)) — the fused transformer
+    epilogue; key drawn from the global RNG stream in training mode."""
+    from .. import autograd
+    from . import random as _rnd
+    if mode is None:
+        mode = "training" if autograd.is_training() else "always_off"
+    training = mode == "training" and p > 0.0
+    key = _rnd._next_key_nd() if training else zeros((2,), dtype="uint32")
+    return _raw_frln(data, bias, residual, gamma, beta, key, p=p,
+                     eps=eps, mode="training" if training else "always_off")
+
+
+setattr(_THIS_MODULE, "FusedResidualLayerNorm", FusedResidualLayerNorm)
+
 # shuffle convenience: auto key (reference mx.nd.shuffle draws from
 # the global RNG)
 _raw_shuffle = getattr(_THIS_MODULE, "shuffle")

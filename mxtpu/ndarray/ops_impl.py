@@ -1170,6 +1170,23 @@ register_op("Dropout", num_inputs=2,
             aliases=("dropout",))(_dropout)
 
 
+def _fused_residual_ln(h, bias, res, gamma, beta, key, p=0.1, eps=1e-5,
+                       mode="training"):
+    from ..kernels.layer_norm import fused_residual_layer_norm
+    key_data = jax.random.key_data(_as_prng_key(key))
+    return fused_residual_layer_norm(
+        h, bias, res, gamma, beta, key_data, p=p, eps=eps,
+        training=(mode == "training"))
+
+
+# the transformer post-LN epilogue — y = LN(res + dropout(h + bias)) —
+# as one op so the Pallas kernel sees it whole (kernels/layer_norm.py)
+register_op("FusedResidualLayerNorm", num_inputs=6,
+            params=[Param("p", float, 0.1), Param("eps", float, 1e-5),
+                    Param("mode", str, "training")])(
+    _fused_residual_ln)
+
+
 def _lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     sq = jnp.square(x)
     half = nsize // 2

@@ -818,3 +818,6 @@ for _op in list(OP_REGISTRY._entries.values()):
 # sym.Dropout omits the key input (drawn at eval time by nd.Dropout)
 setattr(_THIS, "Dropout", _make_sym_fn("Dropout"))
 setattr(_THIS, "dropout", getattr(_THIS, "Dropout"))
+# same for the fused transformer epilogue
+setattr(_THIS, "FusedResidualLayerNorm",
+        _make_sym_fn("FusedResidualLayerNorm"))

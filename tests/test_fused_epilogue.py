@@ -1,0 +1,224 @@
+"""Fused residual epilogue — LN(res + dropout(h + bias)) — parity
+tests (ISSUE 2 tentpole 1).  The Pallas kernel and the lax composite
+share one threefry mask helper, so parity is exact seeded-mask
+equality, not a statistical check.  On CPU the kernel runs in
+interpreter mode via MXTPU_PALLAS=interpret."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxtpu import autograd, nd
+from mxtpu.gluon import nn
+from mxtpu.kernels.layer_norm import (
+    _keep_thresh, _mask_bits, _threefry2x32,
+    fused_residual_layer_norm, fused_residual_ln_reference,
+    layer_norm_reference)
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setenv("MXTPU_PALLAS", "interpret")
+
+
+def _inputs(seed=0, shape=(2, 16, 256), dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    C = shape[-1]
+    h = jnp.asarray(rng.randn(*shape).astype(dtype))
+    res = jnp.asarray(rng.randn(*shape).astype(dtype))
+    bias = jnp.asarray(rng.randn(C).astype(dtype))
+    g = jnp.asarray(rng.uniform(0.5, 1.5, C).astype(dtype))
+    b = jnp.asarray(rng.randn(C).astype(dtype))
+    kd = jnp.asarray([123, 456], jnp.uint32)
+    return h, bias, res, g, b, kd
+
+
+def test_threefry_known_answer_vectors():
+    # official Random123 KAT: key=(0,0), ctr=(0,0) and the pi-digit
+    # vector — guards the hand-rolled implementation against drift
+    y0, y1 = _threefry2x32(jnp.uint32(0), jnp.uint32(0),
+                           jnp.uint32(0), jnp.uint32(0))
+    assert (int(y0), int(y1)) == (0x6B200159, 0x99BA4EFE)
+    y0, y1 = _threefry2x32(jnp.uint32(0x13198A2E), jnp.uint32(0x03707344),
+                           jnp.uint32(0x243F6A88), jnp.uint32(0x85A308D3))
+    assert (int(y0), int(y1)) == (0xC4923A9C, 0x483DF7A0)
+
+
+def test_forward_parity_seeded_mask():
+    h, bias, res, g, b, kd = _inputs()
+    y_p = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1)
+    y_r = fused_residual_ln_reference(h, bias, res, g, b, kd, p=0.1)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_r),
+                               rtol=1e-5, atol=1e-5)
+    # deterministic in the key, sensitive to it
+    y_p2 = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1)
+    assert np.array_equal(np.asarray(y_p), np.asarray(y_p2))
+    kd2 = jnp.asarray([124, 456], jnp.uint32)
+    y_k2 = fused_residual_layer_norm(h, bias, res, g, b, kd2, p=0.1)
+    assert not np.array_equal(np.asarray(y_p), np.asarray(y_k2))
+
+
+def test_mask_fraction_matches_p():
+    bits = _mask_bits(jnp.uint32(7), jnp.uint32(11), jnp.uint32(0),
+                      512, 1024)
+    dropped = float((bits >= jnp.uint32(_keep_thresh(0.9))).mean())
+    assert abs(dropped - 0.1) < 0.01
+
+
+def test_grad_parity_all_operands():
+    h, bias, res, g, b, kd = _inputs(seed=1)
+
+    def loss(fn):
+        return lambda h, bias, res, g, b: jnp.sum(
+            jnp.sin(fn(h, bias, res, g, b, kd, p=0.1)))
+
+    gp = jax.grad(loss(fused_residual_layer_norm),
+                  argnums=(0, 1, 2, 3, 4))(h, bias, res, g, b)
+    gr = jax.grad(loss(fused_residual_ln_reference),
+                  argnums=(0, 1, 2, 3, 4))(h, bias, res, g, b)
+    for name, a, c in zip(("dh", "dbias", "dres", "dgamma", "dbeta"),
+                          gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_eval_mode_is_plain_ln_of_sum():
+    h, bias, res, g, b, kd = _inputs(seed=2)
+    y = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1,
+                                  training=False)
+    ref = layer_norm_reference(res + h + bias, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_path():
+    h, bias, res, g, b, kd = _inputs(seed=3)
+    h16, res16 = h.astype(jnp.bfloat16), res.astype(jnp.bfloat16)
+    y_p = fused_residual_layer_norm(h16, bias, res16, g, b, kd, p=0.1)
+    y_r = fused_residual_ln_reference(h16, bias, res16, g, b, kd, p=0.1)
+    assert y_p.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y_p, np.float32), np.asarray(y_r, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_kill_switch_uses_composite(monkeypatch):
+    h, bias, res, g, b, kd = _inputs(seed=4)
+    y_on = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1)
+    monkeypatch.setenv("MXTPU_FUSED_LN_EPILOGUE", "0")
+    y_off = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1)
+    # identical numerics either way (shared mask helper)
+    np.testing.assert_allclose(np.asarray(y_on), np.asarray(y_off),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_odd_rows_fall_back():
+    # 3 rows: no Pallas row block — must still work via the composite
+    h, bias, res, g, b, kd = _inputs(seed=5, shape=(3, 128))
+    y = fused_residual_layer_norm(h, bias, res, g, b, kd, p=0.1)
+    ref = fused_residual_ln_reference(h, bias, res, g, b, kd, p=0.1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# op / layer wiring
+# ----------------------------------------------------------------------
+
+def test_nd_op_training_vs_predict():
+    rng = np.random.RandomState(6)
+    C = 64
+    h = nd.array(rng.randn(4, 8, C).astype(np.float32))
+    res = nd.array(rng.randn(4, 8, C).astype(np.float32))
+    bias = nd.array(rng.randn(C).astype(np.float32))
+    g = nd.array(np.ones(C, np.float32))
+    b = nd.array(np.zeros(C, np.float32))
+    # outside autograd.record: eval mode, deterministic LN(res+h+bias)
+    y = nd.FusedResidualLayerNorm(h, bias, res, g, b)
+    ref = layer_norm_reference(res._data + h._data + bias._data,
+                               g._data, b._data)
+    np.testing.assert_allclose(np.asarray(y._data), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # under training: elements actually drop
+    with autograd.record(train_mode=True):
+        yt = nd.FusedResidualLayerNorm(h, bias, res, g, b, p=0.5)
+    assert not np.allclose(np.asarray(yt._data), np.asarray(ref))
+
+
+def test_gluon_layer_deferred_init_and_eval_parity():
+    rng = np.random.RandomState(7)
+    layer = nn.FusedResidualLayerNorm(dropout=0.1)
+    layer.initialize()
+    x = nd.array(rng.randn(2, 8, 32).astype(np.float32))
+    r = nd.array(rng.randn(2, 8, 32).astype(np.float32))
+    y = layer(x, r)
+    assert y.shape == (2, 8, 32)
+    assert layer.gamma.data().shape == (32,)
+    # eval mode == LN(res + x + bias) with the layer's params
+    ref = layer_norm_reference(
+        r._data + x._data + layer.bias.data()._data,
+        layer.gamma.data()._data, layer.beta.data()._data)
+    np.testing.assert_allclose(np.asarray(y._data), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_encoder_cell_eval_matches_unfused_composition():
+    """The rewired cell (bias folded into the epilogue) must compute
+    the same function as the textbook post-LN composition."""
+    from mxtpu.models.transformer import TransformerEncoderCell
+    rng = np.random.RandomState(8)
+    cell = TransformerEncoderCell(32, 64, 4, dropout=0.1)
+    cell.initialize()
+    x = nd.array(rng.randn(2, 8, 32).astype(np.float32))
+    y = cell(x)  # eval mode: dropout off
+
+    # manual unfused recomputation from the cell's own params
+    def dense(t, w, b=None):
+        out = jnp.dot(t, w._data.T)
+        return out + b._data if b is not None else out
+
+    xj = x._data
+    qkv = dense(xj, cell.attn.qkv.weight.data(),
+                cell.attn.qkv.bias.data())
+    u = 32
+    q, k, v = qkv[..., :u], qkv[..., u:2 * u], qkv[..., 2 * u:]
+
+    def split(t):
+        return jnp.transpose(t.reshape(2, 8, 4, 8), (0, 2, 1, 3))
+
+    q, k, v = split(q), split(k), split(v)
+    s = jnp.einsum("nhtd,nhsd->nhts", q, k) / np.sqrt(8.0)
+    a = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("nhts,nhsd->nhtd", a, v)
+    o = jnp.transpose(o, (0, 2, 1, 3)).reshape(2, 8, 32)
+    attn = dense(o, cell.attn.proj.weight.data())
+    h1 = layer_norm_reference(
+        xj + attn + cell.ln1.bias.data()._data,
+        cell.ln1.gamma.data()._data, cell.ln1.beta.data()._data)
+    ff = dense(jax.nn.gelu(dense(h1, cell.ffn.ffn1.weight.data(),
+                                 cell.ffn.ffn1.bias.data()),
+                           approximate=False),
+               cell.ffn.ffn2.weight.data())
+    h2 = layer_norm_reference(
+        h1 + ff + cell.ln2.bias.data()._data,
+        cell.ln2.gamma.data()._data, cell.ln2.beta.data()._data)
+    np.testing.assert_allclose(np.asarray(y._data), np.asarray(h2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_traced_train_step_with_epilogue():
+    """Mini encoder trains through the fused epilogue in the compiled
+    train step (the traced path feeds fold_in keys to the kernel)."""
+    from mxtpu.models.transformer import TransformerEncoder
+    from mxtpu import parallel
+    net = TransformerEncoder(2, 32, 64, 4, dropout=0.1)
+    net.initialize(init="xavier")
+    step = parallel.build_train_step(
+        net, lambda pred, y: ((pred - y) ** 2).mean(),
+        "sgd", {"learning_rate": 0.05})
+    rng = np.random.RandomState(9)
+    x = nd.array(rng.randn(2, 8, 32).astype(np.float32))
+    y = nd.array(rng.randn(2, 8, 32).astype(np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
