@@ -1309,6 +1309,51 @@ register_op("rmsprop_update", num_inputs=3, num_outputs=2,
             differentiable=False)(_rmsprop)
 
 
+def _lamb(weight, grad, mean, var, t, lr=0.001, beta1=0.9, beta2=0.999,
+          epsilon=1e-6, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+          bias_correction=True, stacked=False):
+    """LAMB (You et al. 2020): Adam moments + per-tensor trust ratio.
+    ``t`` is the step count as a traced input (scalar, or (n,) when
+    ``stacked``) so schedules never recompile; ``stacked=True`` treats
+    axis 0 as a bundle of independent parameters and computes the trust
+    ratio per slice (the batched optimizer path)."""
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None)
+    m_new = beta1 * mean + (1 - beta1) * g
+    v_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    mhat, vhat = m_new, v_new
+    if bias_correction:
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") \
+            else jnp.float32(t)
+        if stacked and getattr(tf, "ndim", 0) == 1:
+            tf = tf.reshape((-1,) + (1,) * (weight.ndim - 1))
+        mhat = m_new / (1.0 - beta1 ** tf)
+        vhat = v_new / (1.0 - beta2 ** tf)
+    r = mhat / (jnp.sqrt(vhat) + epsilon)
+    # wd may be traced (train-step schedule arg) — no bool() on it
+    r = r + wd * weight
+    axes = tuple(range(1, weight.ndim)) if stacked else None
+    wnorm = jnp.sqrt(jnp.sum(jnp.square(weight), axis=axes,
+                             keepdims=stacked))
+    rnorm = jnp.sqrt(jnp.sum(jnp.square(r), axis=axes,
+                             keepdims=stacked))
+    trust = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
+    return weight - lr * trust * r, m_new, v_new
+
+
+register_op("lamb_update", num_inputs=5, num_outputs=3,
+            params=[Param("lr", float),
+                    Param("beta1", float, 0.9),
+                    Param("beta2", float, 0.999),
+                    Param("epsilon", float, 1e-6),
+                    Param("wd", float, 0.0),
+                    Param("rescale_grad", float, 1.0),
+                    Param("clip_gradient", float, -1.0),
+                    Param("bias_correction", bool, True),
+                    Param("stacked", bool, False)],
+            differentiable=False)(_lamb)
+
+
 def _rmspropalex(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                  gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                  clip_gradient=-1.0):

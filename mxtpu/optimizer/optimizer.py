@@ -21,7 +21,7 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["Optimizer", "Updater", "get_updater", "register", "create",
            "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "Adamax", "Nadam",
-           "RMSProp", "Ftrl", "Signum", "SGLD", "LBSGD", "Test"]
+           "RMSProp", "Ftrl", "Signum", "SGLD", "LBSGD", "LAMB", "Test"]
 
 _REGISTRY: Registry["type"] = Registry("optimizer")
 
@@ -460,6 +460,42 @@ class RMSProp(Optimizer):
             _assign(n, n2)
             _assign(g, g2)
             _assign(delta, d2)
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB (You et al. 2020, "Large Batch Optimization for Deep
+    Learning") → ``lamb_update`` op: Adam moments with a per-tensor
+    trust ratio, the large-batch BERT pretraining optimizer."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        dtype = str(weight.data.dtype)
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        w, m, v = nd.lamb_update(
+            weight, grad, mean, var, nd.array(np.asarray(t, np.int32)),
+            lr=lr, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self._clip(),
+            bias_correction=self.bias_correction)
+        _assign(weight, w)
+        _assign(mean, m)
+        _assign(var, v)
 
 
 @register
