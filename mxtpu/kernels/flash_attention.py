@@ -80,7 +80,7 @@ def _block(n: int, prefer: int) -> int:
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                acc_scr, *, sm_scale, causal, bq, bk, nk, delta,
-               precision):
+               valid_kv, precision):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -113,6 +113,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + \
                 j * bk
             s = jnp.where(col <= row, s, _NEG_INF)
+        if valid_kv is not None:
+            # static pad-mask bound: key columns >= valid_kv are
+            # zero-padding, not data — sentinel them out before the
+            # online softmax so they carry exactly zero weight
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + \
+                j * bk
+            s = jnp.where(col < valid_kv, s, _NEG_INF)
         m_prev = m_scr[:]
         l_prev = l_scr[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -154,7 +161,8 @@ def _precision_for(dtype):
         if jnp.dtype(dtype) == jnp.float32 else None
 
 
-def _flash_forward(q3, k3, v3, causal, sm_scale, interpret):
+def _flash_forward(q3, k3, v3, causal, sm_scale, interpret,
+                   valid_kv=None, delta=None):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
     # 512-blocks: r4 measurement — 128-blocks made the grid 16x finer
@@ -165,7 +173,8 @@ def _flash_forward(q3, k3, v3, causal, sm_scale, interpret):
     nq, nk = Tq // bq, Tk // bk
     kernel = functools.partial(_fa_kernel, sm_scale=sm_scale,
                                causal=causal, bq=bq, bk=bk, nk=nk,
-                               delta=Tk - Tq,
+                               delta=Tk - Tq if delta is None else delta,
+                               valid_kv=valid_kv,
                                precision=_precision_for(q3.dtype))
     return pl.pallas_call(
         kernel,
@@ -205,7 +214,7 @@ def _flash_forward(q3, k3, v3, causal, sm_scale, interpret):
 # dk/dv with q innermost; p recomputed from q,k and the saved lse
 # ----------------------------------------------------------------------
 def _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal, bq, bk,
-                 i, j, delta, precision):
+                 i, j, delta, valid_kv, precision):
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(
@@ -217,12 +226,15 @@ def _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal, bq, bk,
             i * bq + delta
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
         s = jnp.where(col <= row, s, _NEG_INF)
+    if valid_kv is not None:
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        s = jnp.where(col < valid_kv, s, _NEG_INF)
     return jnp.exp(s - lse_ref[0])  # lse block is (bq, 1) — broadcasts
 
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref, dq_ref,
                   dq_scr, *, sm_scale, causal, bq, bk, nk, delta,
-                  precision):
+                  valid_kv, precision):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -237,7 +249,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref, dq_ref,
     @pl.when(run)
     def _step():
         p = _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal,
-                         bq, bk, i, j, delta, precision)
+                         bq, bk, i, j, delta, valid_kv, precision)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -254,7 +266,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref, dq_ref,
 
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref,
                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                   bq, bk, nq, delta, precision):
+                   bq, bk, nq, delta, valid_kv, precision):
     j = pl.program_id(1)  # kv block (outer)
     i = pl.program_id(2)  # q block (inner)
 
@@ -270,7 +282,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref,
     @pl.when(run)
     def _step():
         p = _recompute_p(q_ref, k_ref, lse_ref, sm_scale, causal,
-                         bq, bk, i, j, delta, precision)
+                         bq, bk, i, j, delta, valid_kv, precision)
         do = do_ref[0].astype(jnp.float32)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -290,13 +302,13 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dt_ref,
 
 
 def _flash_backward(q3, k3, v3, do3, lse, delta_rows, causal, sm_scale,
-                    interpret):
+                    interpret, valid_kv=None, delta=None):
     BH, Tq, D = q3.shape
     Tk = k3.shape[1]
     bq = _block(Tq, 512)
     bk = _block(Tk, 512)
     nq, nk = Tq // bq, Tk // bk
-    d = Tk - Tq
+    d = Tk - Tq if delta is None else delta
 
     q_spec_i = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
@@ -307,6 +319,7 @@ def _flash_backward(q3, k3, v3, do3, lse, delta_rows, causal, sm_scale,
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, sm_scale=sm_scale,
                           causal=causal, bq=bq, bk=bk, nk=nk, delta=d,
+                          valid_kv=valid_kv,
                           precision=_precision_for(q3.dtype)),
         grid=(BH, nq, nk),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
@@ -327,6 +340,7 @@ def _flash_backward(q3, k3, v3, do3, lse, delta_rows, causal, sm_scale,
     dk, dv = pl.pallas_call(
         functools.partial(_fa_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, bq=bq, bk=bk, nq=nq, delta=d,
+                          valid_kv=valid_kv,
                           precision=_precision_for(q3.dtype)),
         grid=(BH, nk, nq),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
@@ -341,31 +355,32 @@ def _flash_backward(q3, k3, v3, do3, lse, delta_rows, causal, sm_scale,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_pallas(q, k, v, causal, sm_scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_pallas(q, k, v, causal, sm_scale, valid_kv=None,
+                            delta=None):
     from . import interpret_mode
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     o, _ = _flash_forward(q.reshape(B * H, Tq, D),
                           k.reshape(B * H, Tk, D),
                           v.reshape(B * H, Tk, D), causal, sm_scale,
-                          interpret_mode())
+                          interpret_mode(), valid_kv, delta)
     return o.reshape(B, H, Tq, D)
 
 
-def _fa_fwd(q, k, v, causal, sm_scale):
+def _fa_fwd(q, k, v, causal, sm_scale, valid_kv=None, delta=None):
     from . import interpret_mode
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     o, lse = _flash_forward(q.reshape(B * H, Tq, D),
                             k.reshape(B * H, Tk, D),
                             v.reshape(B * H, Tk, D), causal, sm_scale,
-                            interpret_mode())
+                            interpret_mode(), valid_kv, delta)
     return o.reshape(B, H, Tq, D), (q, k, v, o.reshape(B, H, Tq, D),
                                     lse)
 
 
-def _fa_bwd(causal, sm_scale, res, do):
+def _fa_bwd(causal, sm_scale, valid_kv, delta, res, do):
     q, k, v, o, lse = res
     import os
     B, H, Tq, D = q.shape
@@ -380,9 +395,13 @@ def _fa_bwd(causal, sm_scale, res, do):
     # T=1024 (2.9 vs 4.0 ms; 2.2x at 2048, 3.8x at 4096) — and is the
     # only option when the score matrix would blow HBM.  (The r3
     # threshold of 4096 came from the retracted per-dispatch harness.)
-    use_pallas = mode == "pallas" or (
-        mode == "auto" and (max(Tq, Tk) >= 1024
-                            or B * H * Tq * Tk * 4 > 2 ** 31))
+    # Padded runs (valid_kv/delta set) always take the blockwise
+    # kernels: attention_reference knows neither the pad-mask bound
+    # nor a diagonal offset different from its own Tk - Tq.
+    use_pallas = (mode == "pallas" or valid_kv is not None
+                  or delta is not None
+                  or (mode == "auto" and (max(Tq, Tk) >= 1024
+                      or B * H * Tq * Tk * 4 > 2 ** 31)))
     if not use_pallas:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: attention_reference(q_, k_, v_, causal,
@@ -396,7 +415,7 @@ def _fa_bwd(causal, sm_scale, res, do):
         q.reshape(B * H, Tq, D), k.reshape(B * H, Tk, D),
         v.reshape(B * H, Tk, D), do.reshape(B * H, Tq, D),
         lse, delta_rows.reshape(B * H, Tq, 1), causal, sm_scale,
-        interpret_mode())
+        interpret_mode(), valid_kv, delta)
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 
@@ -406,69 +425,44 @@ _flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
 
 _warned_fallback = set()
 
-#: score offset for masked (padding) keys: large enough that
-#: exp(s - max) underflows to exactly 0.0 in f32 after any realistic
-#: real-score range, small enough to survive a bf16 round-trip
-_MASK_BIAS = -1e9
-
 
 def _padded_flash(q, k, v, causal, scale):
     """Run the Pallas kernel on T-padded inputs, exactly.
 
     Sequence lengths are zero-padded up to the 8-multiple the TPU
     lowering needs, then the padded rows are sliced off the output.
-    Padded KEY columns must not receive softmax weight; two exact
-    constructions cover the cases:
-
-    - ``causal`` with ``Tk - Tq`` preserved (equal pad on both sides,
-      possible iff Tq ≡ Tk mod 8): the kernel's own causal mask does
-      the work — a padded key at index j ≥ Tk is visible to real query
-      i only if j ≤ i + (Tk - Tq), i.e. never.  Plain pad + slice.
-    - non-causal: append ONE feature column — 1.0 to every query, 0.0
-      to real keys, ``_MASK_BIAS`` to padded keys — so the dot product
-      picks up the bias exactly for padded keys and the softmax weight
-      underflows to 0.  ``sm_scale`` is pinned to the ORIGINAL head
-      dim's scale before the append.
-
-    Returns None when neither construction is exact (causal cross
-    lengths with Tq ≢ Tk mod 8) — caller falls back with a warning.
+    Padded KEY columns are masked *inside* the kernels: the static
+    ``valid_kv`` bound turns their scores into the ``_NEG_INF``
+    sentinel before the online softmax, so they carry exactly zero
+    weight forward and contribute exactly zero dk/dv backward.  The
+    causal diagonal keeps the ORIGINAL ``delta = Tk - Tq`` (passed
+    statically), so cross-length causal attention — including
+    Tq % 8 != Tk % 8, which the earlier plain-pad construction could
+    not align — pads exactly too.  Padded QUERY rows compute values
+    that are sliced off here; their cotangents are zero (jnp.pad's
+    VJP), so no gradient leaks either direction.
     """
-    B, H, Tq, D = q.shape
+    Tq = q.shape[2]
     Tk = k.shape[2]
     pq = (-Tq) % 8
     pk = (-Tk) % 8
-    if causal:
-        if pq != pk:
-            # padding would shift the kernel's diagonal alignment
-            # (delta = Tk - Tq): no exact plain pad exists
-            return None
-        pad = [(0, 0), (0, 0), (0, pq), (0, 0)]
-        out = _flash_attention_pallas(
-            jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-            True, scale)
-        return out[:, :, :Tq]
-    ones = jnp.ones((B, H, Tq + pq, 1), q.dtype)
-    kbias = jnp.concatenate(
-        [jnp.zeros((B, H, Tk, 1), k.dtype),
-         jnp.full((B, H, pk, 1), _MASK_BIAS, k.dtype)], axis=2)
-    qp = jnp.concatenate(
-        [jnp.pad(q, [(0, 0), (0, 0), (0, pq), (0, 0)]), ones], axis=-1)
-    kp = jnp.concatenate(
-        [jnp.pad(k, [(0, 0), (0, 0), (0, pk), (0, 0)]), kbias], axis=-1)
-    # v gets a zero feature column so q/k/v head dims stay equal; the
-    # matching output column is all-zero and sliced off below
-    vp = jnp.pad(v, [(0, 0), (0, 0), (0, pk), (0, 1)])
-    out = _flash_attention_pallas(qp, kp, vp, False, scale)
-    return out[:, :, :Tq, :D]
+    padq = [(0, 0), (0, 0), (0, pq), (0, 0)]
+    padk = [(0, 0), (0, 0), (0, pk), (0, 0)]
+    out = _flash_attention_pallas(
+        jnp.pad(q, padq), jnp.pad(k, padk), jnp.pad(v, padk),
+        causal, scale, Tk if pk else None, Tk - Tq)
+    return out[:, :, :Tq]
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None):
     """Fused attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D).
-    Pallas on TPU, lax reference elsewhere or for awkward shapes.
-    Sequence lengths that are not multiples of 8 are padded-and-masked
-    to the block multiple (exactly — see ``_padded_flash``), so
-    e.g. T=12 keeps the fused kernel's memory bound instead of
-    silently dropping to the O(T²) reference path (VERDICT r5 weak #3).
+    Pallas on TPU, lax reference elsewhere.
+    Sequence lengths that are not multiples of 8 are padded to the
+    block multiple and the pad keys masked statically inside the
+    kernels (exactly — see ``_padded_flash``), so EVERY model-layer
+    sequence length — odd T, causal, cross-length decoding — keeps the
+    fused kernel's memory bound; the only remaining fallback is
+    head_dim > 512.
     """
     import warnings
 
@@ -479,33 +473,18 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     if not pallas_enabled():
         # CPU / interpret-off: the reference path IS the intended path
         return attention_reference(q, k, v, causal, scale)
-    needs_pad = bool(Tq % 8 or Tk % 8)
-    # the non-causal pad path appends one feature column, so its head
-    # dim bound is 511; the causal pad path keeps D unchanged
-    d_bound = 511 if (needs_pad and not causal) else 512
-    if D > d_bound:
-        why = (f"head_dim {D} > {d_bound}"
-               + (" (512 kernel bound minus the pad-mask bias column)"
-                  if d_bound == 511 else ""))
-        out = None
-    elif needs_pad:
-        why = (f"causal cross-attention lengths Tq={Tq}, Tk={Tk} with "
-               f"Tq % 8 != Tk % 8 (padding would shift the causal "
-               f"diagonal)")
-        out = _padded_flash(q, k, v, bool(causal), scale)
-    else:
-        return _flash_attention_pallas(q, k, v, bool(causal), scale)
-    if out is not None:
-        return out
-    # warn once per shape class: the O(T^2)-memory fallback silently
-    # losing the flash memory guarantee at e.g. T=4097 is exactly the
-    # failure mode a user needs to hear about
-    sig = (why, D)
-    if sig not in _warned_fallback:
-        _warned_fallback.add(sig)
-        warnings.warn(
-            f"flash_attention falling back to the O(T^2) reference "
-            f"path ({why}); pad sequence lengths to a multiple of 8 "
-            f"(keeping Tq ≡ Tk mod 8 when causal) to keep the fused "
-            f"kernel's memory bound", stacklevel=2)
-    return attention_reference(q, k, v, causal, scale)
+    if D > 512:
+        # warn once per shape class: the O(T^2)-memory fallback
+        # silently losing the flash memory guarantee is exactly the
+        # failure mode a user needs to hear about
+        sig = ("head_dim", D)
+        if sig not in _warned_fallback:
+            _warned_fallback.add(sig)
+            warnings.warn(
+                f"flash_attention falling back to the O(T^2) reference "
+                f"path (head_dim {D} > 512 kernel bound)", stacklevel=2)
+        return attention_reference(q, k, v, causal, scale)
+    if Tq % 8 or Tk % 8:
+        return _padded_flash(q, k, v, bool(causal), scale)
+    return _flash_attention_pallas(q, k, v, bool(causal), scale,
+                                   None, None)

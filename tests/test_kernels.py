@@ -287,7 +287,8 @@ def test_flash_attention_unaligned_pads_not_falls_back():
     rng = np.random.RandomState(9)
     B, H, D = 2, 3, 16
     cases = [(12, 12, True), (12, 12, False), (5, 5, True),
-             (7, 19, False), (12, 20, True)]  # Tq ≡ Tk mod 8 causal OK
+             (7, 19, False), (12, 20, True),
+             (12, 16, True), (13, 7, True)]  # incl. Tq ≢ Tk mod 8
     for Tq, Tk, causal in cases:
         q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
         k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
@@ -340,20 +341,51 @@ def test_flash_attention_unaligned_grad():
                                        err_msg=f"{name} causal={causal}")
 
 
-def test_flash_attention_unaligned_causal_cross_falls_back():
-    """Causal cross lengths with Tq % 8 != Tk % 8 cannot be padded
-    exactly (the diagonal would shift) — pinned: warn + exact
-    reference fallback."""
+def test_flash_attention_unaligned_causal_cross_hits_kernel():
+    """Causal cross lengths with Tq % 8 != Tk % 8 used to warn and
+    fall back (plain padding would shift the diagonal); the static
+    valid_kv mask + explicit delta now keep them on the fused kernel:
+    no warning, reference parity for values AND grads."""
     import warnings
     rng = np.random.RandomState(12)
-    B, H, Tq, Tk, D = 1, 2, 12, 16, 8
-    q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
-    k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
-    v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+    B, H, D = 1, 2, 8
+    for Tq, Tk in ((12, 16), (13, 7), (5, 30)):
+        q = jnp.asarray(rng.randn(B, H, Tq, D).astype(np.float32)) * 0.5
+        k = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32)) * 0.5
+        v = jnp.asarray(rng.randn(B, H, Tk, D).astype(np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = flash_attention(q, k, v, causal=True)
+        assert not w, [str(x.message) for x in w]
+        ref = attention_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        gp = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            attention_reference(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, e, name in zip(gp, gr, ["dq", "dk", "dv"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4,
+                err_msg=f"{name} Tq={Tq} Tk={Tk}")
+
+
+def test_transformer_model_odd_seq_hits_kernel():
+    """Model-layer guarantee: an encoder forward at an odd sequence
+    length emits no fallback warning and matches the reference
+    attention semantics (ISSUE 2 tentpole 3)."""
+    import warnings
+    from mxtpu import nd
+    from mxtpu.models.transformer import TransformerEncoder
+    rng = np.random.RandomState(13)
+    net = TransformerEncoder(1, 32, 64, 4, dropout=0.0)
+    net.initialize()
+    x = nd.array(rng.randn(2, 13, 32).astype(np.float32))
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
-        got = flash_attention(q, k, v, causal=True)
-    assert w and "diagonal" in str(w[0].message)
-    ref = attention_reference(q, k, v, True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               rtol=0, atol=0)
+        y = net(x)
+    fallback = [x for x in w if "falling back" in str(x.message)]
+    assert not fallback, [str(x.message) for x in fallback]
+    assert y.shape == (2, 13, 32)
