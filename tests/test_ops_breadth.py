@@ -511,26 +511,35 @@ def test_flash_attention_fallback_warns_once(monkeypatch):
     # shape-based fallback triggers its warning; with pallas disabled
     # (plain CPU) the reference path is intended and must stay silent
     monkeypatch.setenv("MXTPU_PALLAS", "interpret")
-    # unaligned T is now padded-and-masked, NOT a fallback: silent
+    # unaligned T is padded-and-masked, NOT a fallback: silent
     q = jnp.asarray(_rand(1, 2, 9, 16))  # T=9 not a multiple of 8
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         fa.flash_attention(q, q, q)
     assert not [x for x in w if "falling back" in str(x.message)]
-    # causal cross lengths with Tq % 8 != Tk % 8 can't be padded
-    # exactly — that fallback still warns, once per shape class
+    # causal cross lengths (Tq % 8 != Tk % 8) hit the kernel too now:
+    # static valid_kv masking + the explicit diagonal keep it fused
     k = jnp.asarray(_rand(1, 2, 16, 16))
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         fa.flash_attention(q, k, k, causal=True)
-        fa.flash_attention(q, k, k, causal=True)
+    assert not [x for x in w if "falling back" in str(x.message)]
+    # the one remaining fallback is head_dim > 512 — warns once per
+    # shape class
+    wide = jnp.asarray(_rand(1, 2, 8, 520))
+    fa._warned_fallback.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fa.flash_attention(wide, wide, wide)
+        fa.flash_attention(wide, wide, wide)
     msgs = [x for x in w if "flash_attention falling back"
             in str(x.message)]
     assert len(msgs) == 1  # once per shape class
     monkeypatch.setenv("MXTPU_PALLAS", "0")
+    fa._warned_fallback.clear()
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
-        fa.flash_attention(q, k, k, causal=True)
+        fa.flash_attention(wide, wide, wide)
     assert not [x for x in w if "falling back" in str(x.message)]
 
 
