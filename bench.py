@@ -4,8 +4,12 @@ Prints ONE JSON line whose primary metric is the **ResNet-50 ImageNet
 training throughput** (north-star #1, BASELINE.md); the BERT-Large
 (north-star #2) and LeNet numbers ride along in ``extras`` so every
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
-MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512
-to run a single workload.
+MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
+transformer|moe_ffn|ssd to run a single workload (moe_ffn and ssd are
+on-demand only — not part of the default ``all`` sweep, which is sized
+to the wall budget).  ``bench.py --preflight`` prints the per-row wall
+estimates for the selected sweep and exits non-zero if it would not
+fit MXTPU_BENCH_WALL_BUDGET — check this BEFORE burning a TPU run.
 
 The measured unit is the full compiled training step — forward,
 backward, fused optimizer (+BN aux writeback) — via
@@ -53,6 +57,9 @@ _METRIC_NAMES = {
     "resnet50_pipeline": "resnet50_pipeline_fed_train_throughput",
     "bert": "bert_large_pretrain_throughput",
     "bert_s512": "bert_large_s512_pretrain_throughput",
+    "transformer": "transformer_big_wmt_train_throughput",
+    "moe_ffn": "moe_ffn_microbench_throughput",
+    "ssd": "ssd300_voc_train_throughput",
     "lenet": "lenet_mnist_train_throughput",
 }
 
@@ -70,6 +77,14 @@ _TRAIN_FLOPS = {
     # x3 fwd+bwd; the flash-attention custom call hides its FLOPs from
     # cost_analysis, so the analytic form is the honest one here)
     "bert_s512": 2.18e9,
+    # TrainStep.cost_analysis on the CPU lowering (r6), fwd+bwd+adam,
+    # transformer_big b16 s64+s64, per src+tgt token.  The CPU count
+    # INCLUDES the attention einsums the TPU Pallas custom call hides,
+    # so it is the complete denominator (1.489e12 FLOPs / 2048 tokens).
+    "transformer": 0.727e9,
+    "moe_ffn": None,          # microbench reports its own details
+    "ssd": None,              # anchor machinery dominates op count,
+                              # MFU would flatter the conv backbone
     "lenet": None,            # too small for MFU to mean anything
 }
 
@@ -326,8 +341,202 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
     return value, _METRIC_NAMES[metric_key], "tokens/sec"
 
 
-def _mfu(model, value, peak):
-    per_unit = _TRAIN_FLOPS.get(model)
+def bench_transformer(batch_size=16, src_len=64, tgt_len=64, warmup=3,
+                      iters=16):
+    """Transformer-big WMT-shaped seq2seq training step, tokens/sec
+    over src+tgt tokens (north-star #4 / M6 bench presence).  Sized to
+    fit the wall budget: b16 s64/s64 keeps the compile + 5 measurement
+    repeats inside the row estimate while every GEMM is already
+    MXU-shaped (the per-token cost is sequence-length-flat until
+    attention dominates)."""
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.gluon.block import HybridBlock
+    from mxtpu.models.transformer import transformer_big
+
+    V = 32768
+
+    class _MTWrap(HybridBlock):
+        """TrainStep feeds ONE batch array: src|tgt ride concatenated
+        on the time axis and split here."""
+
+        def __init__(self, split, **kw):
+            super().__init__(**kw)
+            self._split = split
+            self.model = transformer_big(vocab_size=V, max_length=256,
+                                         dropout=0.1)
+
+        def hybrid_forward(self, F, x):
+            src = F.slice_axis(x, axis=1, begin=0, end=self._split)
+            tgt = F.slice_axis(x, axis=1, begin=self._split, end=None)
+            return self.model(src, tgt)
+
+    net = _MTWrap(src_len)
+    net.initialize(init="xavier")
+    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+
+    def mt_loss(pred, y):
+        return gloss.SoftmaxCrossEntropyLoss()(
+            pred.reshape((-1, V)), y.reshape((-1,)))
+
+    # cast_batch=False: token ids must not be rounded through bf16
+    step = parallel.build_train_step(
+        net, mt_loss, "adam", {"learning_rate": 1e-4},
+        compute_dtype=dtype, cast_batch=False)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, V, (batch_size, src_len + tgt_len))
+                 .astype(np.float32))
+    y = nd.array(rng.randint(0, V, (batch_size, tgt_len))
+                 .astype(np.float32))
+    tokens_per_batch = batch_size * (src_len + tgt_len)
+    value = _measure(step, x, y, warmup, iters, tokens_per_batch)
+    return value, _METRIC_NAMES["transformer"], "tokens/sec"
+
+
+def bench_ssd(batch_size=8, size=300, num_classes=20, warmup=3,
+              iters=10):
+    """SSD-300 VOC-shaped training throughput (VERDICT r5 item 5): the
+    full detection step — backbone, multi-scale heads, MultiBoxTarget
+    assignment, SSDLoss — compiled via build_train_step on synthetic
+    VOC-shaped batches (3x300x300, up to 3 boxes/image)."""
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.models.ssd import SSDLoss, ssd_300
+
+    net = ssd_300(num_classes=num_classes)
+    net.initialize(init="xavier")
+    loss_fn = SSDLoss()
+
+    def det_loss(pred, labels):
+        anchors, cls_preds, box_preds = pred
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+        return nd.mean(loss_fn(cls_preds, box_preds, ct, bt, bm))
+
+    # cast_batch only touches x (the image) — labels reach
+    # MultiBoxTarget in f32, so class ids and box coords never round
+    # through bf16
+    step = parallel.build_train_step(
+        net, det_loss, "sgd",
+        {"learning_rate": 5e-3, "momentum": 0.9, "wd": 5e-4},
+        compute_dtype=os.environ.get("MXTPU_BENCH_DTYPE",
+                                     "bfloat16") or None)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch_size, 3, size, size)
+                 .astype(np.float32))
+    # VOC-shaped labels: (B, 3, 5) [cls, x0, y0, x1, y1], -1 pads
+    labels = np.full((batch_size, 3, 5), -1.0, np.float32)
+    for b in range(batch_size):
+        for o in range(1 + b % 3):
+            x0, y0 = rng.uniform(0, 0.6, 2)
+            labels[b, o] = [rng.randint(num_classes), x0, y0,
+                            x0 + rng.uniform(0.2, 0.4),
+                            y0 + rng.uniform(0.2, 0.4)]
+    y = nd.array(labels)
+    value = _measure(step, x, y, warmup, iters, batch_size)
+    return value, _METRIC_NAMES["ssd"], "samples/sec"
+
+
+def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
+                  repeats=3):
+    """Switch-MoE FFN microbench at the honesty point VERDICT r5
+    item 6 names: T=8192 tokens, E=8 experts, D=1024, bf16, fwd+bwd.
+    Reports tokens/sec plus ``details``: the dense-FFN equivalent
+    (same D→H→D at the same token count), HBM high-water from XLA's
+    memory_analysis, and the router+dispatch share (time not spent in
+    the expert GEMMs themselves, measured by running the expert FFN on
+    pre-dispatched (E, C, D) activations)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxtpu.parallel.moe import MoEFFN
+
+    layer = MoEFFN(D, H, E, capacity_factor=1.25)
+    params = layer.params()
+    C = int(np.ceil(T / E * 1.25))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+
+    def _chain(fn, x0, n, label):
+        """Sustained fwd+bwd: each iteration's grad signal feeds the
+        next input, so nothing is DCE'd or hoisted
+        (tools/microbench.py methodology)."""
+        grad_fn = jax.grad(
+            lambda xx: fn(xx).astype(jnp.float32).sum() * 1e-3)
+
+        @jax.jit
+        def run(xx):
+            return jax.lax.fori_loop(
+                0, n, lambda i, v: (v + 1e-3 * grad_fn(v)
+                                    .astype(v.dtype)), xx)
+
+        out = run(x0)
+        float(jnp.sum(out.astype(jnp.float32)))  # compile + drain
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = run(x0)
+            float(jnp.sum(out.astype(jnp.float32)))
+            best = min(best, (time.perf_counter() - t0) / n)
+        # HBM high-water of the compiled loop program
+        try:
+            ma = run.lower(x0).compile().memory_analysis()
+            hbm = int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                      + ma.output_size_in_bytes)
+        except Exception:
+            hbm = None
+        return best, hbm
+
+    def moe_out(xx):
+        # aux (load-balance loss) is dropped: the router itself stays
+        # live through the dispatch/combine einsums y depends on
+        return layer.apply(params, xx)[0]
+
+    t_moe, hbm_moe = _chain(moe_out, x, iters, "moe")
+
+    # dense-FFN equivalent: one D→H→D over the same tokens
+    k = jax.random.PRNGKey(1)
+    w1 = (jax.random.normal(k, (D, H)) / np.sqrt(D)).astype(jnp.bfloat16)
+    w2 = (jax.random.normal(k, (H, D)) / np.sqrt(H)).astype(jnp.bfloat16)
+    t_dense, hbm_dense = _chain(
+        lambda xx: jax.nn.relu(xx @ w1) @ w2, x, iters, "dense")
+
+    # experts-only: the same per-expert GEMMs on pre-dispatched
+    # activations — the difference to t_moe is the router + the two
+    # dispatch/combine einsums
+    _, w1e, b1e, w2e, b2e = params
+    w1c = w1e.astype(jnp.bfloat16)
+    w2c = w2e.astype(jnp.bfloat16)
+    xe = jnp.asarray(rng.randn(E, C, D).astype(np.float32),
+                     dtype=jnp.bfloat16)
+
+    def experts_only(v):
+        h = jnp.einsum("ecd,edh->ech", v, w1c) \
+            + b1e.astype(jnp.bfloat16)[:, None, :]
+        return jnp.einsum("ech,ehd->ecd", jax.nn.relu(h), w2c) \
+            + b2e.astype(jnp.bfloat16)[:, None, :]
+
+    t_exp, _ = _chain(experts_only, xe, iters, "experts")
+
+    vals = [T / t_moe]
+    stats = {"best": max(vals), "median": vals[0], "n": 1,
+             "spread": 0.0, "runs": [round(v, 1) for v in vals],
+             "info": {
+                 "shape": {"T": T, "E": E, "D": D, "H": H,
+                           "capacity": C, "dtype": "bfloat16"},
+                 "dense_ffn_tokens_per_sec": round(T / t_dense, 1),
+                 "vs_dense_ffn": round(t_dense / t_moe, 3),
+                 "hbm_highwater_bytes": hbm_moe,
+                 "dense_hbm_highwater_bytes": hbm_dense,
+                 "router_dispatch_share": round(
+                     max(0.0, (t_moe - t_exp)) / t_moe, 3),
+             }}
+    return stats, _METRIC_NAMES["moe_ffn"], "tokens/sec"
+
+
+def _mfu(model, value, peak, per_unit=None):
+    per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
         return None
     return round(per_unit * value / peak, 4)
@@ -339,7 +548,8 @@ def _mfu(model, value, peak):
 # early (recorded, recoverable one-at-a-time via MXTPU_BENCH_MODEL=…);
 # underestimates risk rc=124 — err high.
 _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
-            "bert_s512": 130, "lenet": 60}
+            "bert_s512": 130, "lenet": 60, "transformer": 120,
+            "moe_ffn": 60, "ssd": 90}
 
 
 def _sweep_stale_tmpdirs():
@@ -364,12 +574,33 @@ def main():
              # flash-attention kernel shows up in a recorded number
              "bert_s512": lambda: bench_bert(
                  batch_size=8, seq_len=512,
-                 metric_key="bert_s512")}
+                 metric_key="bert_s512"),
+             "transformer": bench_transformer,
+             # on-demand rows (MXTPU_BENCH_MODEL=moe_ffn / ssd): each
+             # fits the budget on its own but the default sweep is
+             # already near the wall, so they are not in "all"
+             "moe_ffn": bench_moe_ffn,
+             "ssd": bench_ssd}
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")
-    _sweep_stale_tmpdirs()
     budget = float(os.environ.get("MXTPU_BENCH_WALL_BUDGET", "780"))
+    order = [which] if which != "all" else \
+        ["resnet50", "resnet50_pipeline", "bert", "bert_s512",
+         "transformer", "lenet"]
+    est_total = sum(_ROW_EST[m] for m in order)
+    if "--preflight" in sys.argv[1:]:
+        # Answer "will the selected sweep fit the wall budget?" without
+        # touching the TPU.  Non-zero exit = the sweep as configured
+        # would drop rows — fix the budget or the row list BEFORE
+        # burning a run.
+        for m in order:
+            print(f"  {m:<20} est {_ROW_EST[m]:>4}s")
+        verdict = "FITS" if est_total <= budget else "EXCEEDS"
+        print(f"preflight: {len(order)} rows, estimated {est_total}s "
+              f"{verdict} MXTPU_BENCH_WALL_BUDGET={budget:.0f}s")
+        sys.exit(0 if est_total <= budget else 1)
+    _sweep_stale_tmpdirs()
     deadline = time.monotonic() + budget
     peak = _peak_flops()
     baseline = {}
@@ -379,9 +610,6 @@ def main():
         with open(self_path) as f:
             baseline = json.load(f).get("metrics", {})
 
-    order = [which] if which != "all" else \
-        ["resnet50", "resnet50_pipeline", "bert", "bert_s512", "lenet"]
-    est_total = sum(_ROW_EST[m] for m in order)
     if est_total > budget:
         print(f"bench pre-flight: estimated {est_total}s for "
               f"{order} exceeds MXTPU_BENCH_WALL_BUDGET={budget:.0f}s; "
@@ -429,6 +657,9 @@ def main():
             "band": {"median": round(stats["median"], 1),
                      "n": stats["n"], "spread": stats["spread"]},
         }
+        if stats.get("info"):
+            # row-specific context (e.g. moe_ffn's dense-FFN envelope)
+            results[model]["details"] = stats["info"]
     primary = next((results[m] for m in order
                     if results[m]["value"] is not None),
                    results[order[0]])

@@ -592,6 +592,42 @@ class TrainStep:
         self._opt_state = st
         return NDArray(losses, None, _placed=True)
 
+    # -- introspection ----------------------------------------------------
+    def cost_analysis(self, x, y):
+        """XLA ``cost_analysis`` of the ONE-STEP compiled program for
+        this batch signature: {'flops', 'bytes accessed', ...} as
+        reported by the backend.  This is the provenance of every
+        MFU denominator in bench.py/BASELINE.md (fwd+bwd+optimizer,
+        XLA's own count — not an analytic 6N estimate).  Note Pallas
+        custom calls (flash attention, fused LN) hide their FLOPs from
+        XLA, so on TPU the count is a floor; the CPU lowering runs the
+        lax reference paths and counts everything.  Compiles the
+        program if this signature has not stepped yet."""
+        x_raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        y_raw = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        self._collect(x if isinstance(x, NDArray)
+                      else NDArray(x_raw, None, _placed=True))
+        sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
+               str(y_raw.dtype))
+        key = _rnd._next_key(None)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            entry = self._build(key, x_raw, y_raw)
+            self._compiled[sig] = entry
+        lrs, wds = self._lrs_wds()
+        params = self._params
+        train_vals = tuple(params[i]._data._data
+                           for i in self._train_idx)
+        frozen_vals = tuple(params[i]._data._data
+                            for i in entry["frozen_idx"])
+        compiled = entry["fn"].lower(
+            train_vals, frozen_vals, self._opt_state,
+            jax.random.key_data(key), lrs, wds, x_raw,
+            y_raw).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        return dict(ca)
+
     # -- checkpoint/resume (SURVEY §5.4: preemption-safe from day one) --
     def save_states(self, fname: str) -> None:
         """Serialize optimizer state + step counter.  Pair with

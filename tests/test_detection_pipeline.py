@@ -139,7 +139,11 @@ def test_ssd_trains_from_rec_and_reaches_map(tmp_path):
     loss_fn = SSDLoss()
     trainer = None
     losses = []
-    for _ in range(10):
+    # 25 epochs: the loss bottoms out near ep 10 but detection quality
+    # keeps climbing as the box head sharpens (mAP ~0.18 at ep 10,
+    # ~0.5 at ep 15, >0.9 by ep 25) — stopping at 10 made the floor
+    # a coin flip on the RNG stream
+    for _ in range(25):
         it.reset()
         for batch in it:
             x, labels = batch.data[0], batch.label[0]
@@ -163,10 +167,10 @@ def test_ssd_trains_from_rec_and_reaches_map(tmp_path):
         out = net.detect(batch.data[0])
         metric.update([batch.label[0]], [out])
     name, value = metric.get()
-    # tiny net + tiny data: the bar proves the pipeline learns signal
-    # (top detections localize and classify; pooled low-score false
-    # positives cap toy mAP well below 1), not detection SOTA
-    assert value > 0.15, value
+    # tiny net + tiny data: the bar proves the pipeline learns real
+    # detection signal (converged runs sit >0.9; half that is the
+    # flake margin), not detection SOTA
+    assert value > 0.45, value
 
 
 def test_voc07_map_difficult_neutral():
