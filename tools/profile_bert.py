@@ -8,7 +8,26 @@ iterations, so nothing is DCE'd).  Components are ablated by
 monkeypatching the model module's class names before construction —
 the blocks resolve them at call time.
 
-Usage: PYTHONPATH=.:... python tools/profile_bert.py [batch] [seqlen]
+r6 additions, covering the hot-path work this profile motivated:
+- ``epilogue_lax``     — MXTPU_FUSED_LN_EPILOGUE=0: the fused
+  bias+dropout+add+LN Pallas epilogue replaced by the lax composite
+  (same numerics, unfused memory traffic).
+- ``loop_floor``       — the chained loop on an identity-cost body:
+  dispatch + loop overhead that no model change can remove; subtract
+  from every other row before computing component shares.
+- ``step_batched`` /
+  ``step_perparam``    — the FULL TrainStep (fwd+bwd+optimizer) via
+  build_train_step with MXTPU_BATCHED_OPT=1/0; their difference is
+  the shape/dtype-bucketed optimizer saving, and step_batched minus
+  ``full`` is the whole optimizer+writeback share.
+- ``--cost``           — also print TrainStep.cost_analysis() FLOPs /
+  bytes for the step program (on TPU the Pallas custom calls hide
+  their FLOPs; the CPU lowering counts everything — see
+  bench.py _TRAIN_FLOPS provenance notes).
+
+Usage: python tools/profile_bert.py [batch] [seqlen] [only,csv] [--cost]
+(MXTPU_PROFILE_BERT_MODEL=tiny|base|large swaps the model so the
+harness itself can be smoke-tested on a CPU box.)
 """
 import os
 import sys
@@ -26,6 +45,22 @@ from tools.microbench import sustained
 
 def sustained_ms(fn, x0, n=10, repeats=3):
     return sustained(fn, x0, n=n, repeats=repeats) * 1e3
+
+
+def _build_bert(seqlen, dropout=0.1):
+    """bert_large unless MXTPU_PROFILE_BERT_MODEL overrides — the
+    tiny/base tiers exist so the harness itself can be smoke-tested on
+    a CPU box where a Large compile takes minutes."""
+    import mxtpu.models.transformer as tr
+    kind = os.environ.get("MXTPU_PROFILE_BERT_MODEL", "large")
+    if kind == "tiny":
+        return tr.BERTModel(30522, 128, 512, 2, 2, max_length=seqlen,
+                            dropout=dropout)
+    if kind == "base":
+        return tr.bert_base(vocab_size=30522, max_length=seqlen,
+                            dropout=dropout)
+    return tr.bert_large(vocab_size=30522, max_length=seqlen,
+                         dropout=dropout)
 
 
 def build_loss_fn(batch, seqlen, variant, dropout=0.1):
@@ -91,8 +126,7 @@ def build_loss_fn(batch, seqlen, variant, dropout=0.1):
         dropout = 0.0
 
     try:
-        net = tr.bert_large(vocab_size=30522, max_length=seqlen,
-                            dropout=dropout)
+        net = _build_bert(seqlen, dropout)
         if variant == "mlm_ablated":
             net.mlm = nn.Dense(1024, flatten=False)
             net.register_child(net.mlm)
@@ -138,32 +172,105 @@ def build_loss_fn(batch, seqlen, variant, dropout=0.1):
     return loss_of, toks, tuple(pvals0), plist
 
 
+class _env:
+    """Set env overrides for the duration of one variant build+measure
+    (the kill switches are read at trace time, and every measurement
+    jits afresh)."""
+
+    def __init__(self, **kv):
+        self._kv = kv
+
+    def __enter__(self):
+        self._old = {k: os.environ.get(k) for k in self._kv}
+        os.environ.update(self._kv)
+
+    def __exit__(self, *a):
+        for k, v in self._old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def measure_train_step(batch, seqlen, batched):
+    """Full compiled TrainStep (fwd+bwd+optimizer+writeback) per-step
+    ms — the number bench.py's BERT row is made of."""
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import loss as gloss
+
+    with _env(MXTPU_BATCHED_OPT="1" if batched else "0"):
+        net = _build_bert(seqlen)
+        net.initialize(init="xavier")
+
+        def mlm_loss(pred, y):
+            return gloss.SoftmaxCrossEntropyLoss()(
+                pred.reshape((-1, pred.shape[-1])), y.reshape((-1,)))
+
+        step = parallel.build_train_step(
+            net, mlm_loss, "adam", {"learning_rate": 1e-4},
+            compute_dtype="bfloat16", cast_batch=False)
+        rng = np.random.RandomState(0)
+        toks = nd.array(rng.randint(0, 30522, (batch, seqlen))
+                        .astype(np.float32))
+        last = step.run_steps(toks, toks, 2, reuse_batch=True)
+        float(last.asnumpy()[-1])  # compile + drain
+        n, best = 8, float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            last = step.run_steps(toks, toks, n, reuse_batch=True)
+            float(last.asnumpy()[-1])
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e3, step, toks
+
+
 def measure_variant(batch, seqlen, variant):
-    loss_of, toks, pvals, plist = build_loss_fn(batch, seqlen, variant)
+    if variant in ("step_batched", "step_perparam"):
+        t, _, _ = measure_train_step(batch, seqlen,
+                                     variant == "step_batched")
+        return t
+    if variant == "loop_floor":
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, 30522, (batch, seqlen))
+                           .astype(np.float32))
+        # identity-cost body: what remains is the chained-loop +
+        # dispatch floor every other row also pays
+        return sustained_ms(
+            lambda xx: jnp.clip(xx + jnp.sum(xx) * 0.0 + 1e-12,
+                                0, 30521),
+            toks, n=8, repeats=3)
 
-    grad_fn = jax.grad(lambda tv, xx: loss_of(tv, xx))
+    env = {"epilogue_lax": {"MXTPU_FUSED_LN_EPILOGUE": "0"}} \
+        .get(variant, {})
+    with _env(**env):
+        loss_of, toks, pvals, plist = build_loss_fn(
+            batch, seqlen, variant)
 
-    def chain(xx):
-        g = grad_fn(pvals, xx)
-        s = sum(jnp.sum(gi.astype(jnp.float32))
-                for gi in jax.tree_util.tree_leaves(g))
-        # fold the grad signal back into the token ids (kept valid by
-        # a tiny scale + floor) so iterations are data-dependent
-        return jnp.clip(xx + s * 1e-12, 0, 30521)
+        grad_fn = jax.grad(lambda tv, xx: loss_of(tv, xx))
 
-    return sustained_ms(chain, toks, n=8, repeats=3)
+        def chain(xx):
+            g = grad_fn(pvals, xx)
+            s = sum(jnp.sum(gi.astype(jnp.float32))
+                    for gi in jax.tree_util.tree_leaves(g))
+            # fold the grad signal back into the token ids (kept valid
+            # by a tiny scale + floor) so iterations are data-dependent
+            return jnp.clip(xx + s * 1e-12, 0, 30521)
+
+        return sustained_ms(chain, toks, n=8, repeats=3)
 
 
 VARIANTS = ["full", "attn_core_ablated", "attn_ablated", "ffn_ablated",
-            "mlm_ablated", "ln_ablated", "no_dropout"]
+            "mlm_ablated", "ln_ablated", "no_dropout", "epilogue_lax",
+            "loop_floor", "step_batched", "step_perparam"]
 
 
 def main():
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
-    seqlen = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    only = sys.argv[3].split(",") if len(sys.argv) > 3 else None
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    want_cost = "--cost" in sys.argv[1:]
+    batch = int(argv[0]) if len(argv) > 0 else 32
+    seqlen = int(argv[1]) if len(argv) > 1 else 128
+    only = argv[2].split(",") if len(argv) > 2 else None
     print(f"device={jax.devices()[0]} b{batch} s{seqlen} bf16 "
-          f"(fwd+bwd, chained)")
+          f"(fwd+bwd, chained; step_* rows add the optimizer)")
     base = None
     for v in VARIANTS:
         if only and v not in only:
@@ -171,11 +278,36 @@ def main():
         t = measure_variant(batch, seqlen, v)
         tok_s = batch * seqlen / t * 1e3
         delta = f"  (component ~{base - t:6.1f} ms)" \
-            if base is not None and v != "full" else ""
+            if base is not None and not v.startswith("step_") \
+            and v != "loop_floor" else ""
         if v == "full":
             base = t
         print(f"{v:>18}: {t:7.1f} ms/step  {tok_s:9.0f} tok/s{delta}",
               flush=True)
+    if want_cost:
+        from mxtpu import nd, parallel
+        from mxtpu.gluon import loss as gloss
+        net = _build_bert(seqlen)
+        net.initialize(init="xavier")
+
+        def mlm_loss(pred, y):
+            return gloss.SoftmaxCrossEntropyLoss()(
+                pred.reshape((-1, pred.shape[-1])), y.reshape((-1,)))
+
+        step = parallel.build_train_step(
+            net, mlm_loss, "adam", {"learning_rate": 1e-4},
+            compute_dtype="bfloat16", cast_batch=False)
+        rng = np.random.RandomState(0)
+        toks = nd.array(rng.randint(0, 30522, (batch, seqlen))
+                        .astype(np.float32))
+        ca = step.cost_analysis(toks, toks)
+        flops = ca.get("flops")
+        toks_n = batch * seqlen
+        print(f"cost_analysis: flops={flops:.3e} "
+              f"({flops / toks_n:.3e}/token)  "
+              f"bytes={ca.get('bytes accessed', float('nan')):.3e}  "
+              f"(Pallas custom calls hide their FLOPs on TPU; the CPU "
+              f"lowering counts everything)", flush=True)
 
 
 if __name__ == "__main__":
