@@ -5,9 +5,12 @@ training throughput** (north-star #1, BASELINE.md); the BERT-Large
 (north-star #2) and LeNet numbers ride along in ``extras`` so every
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
 MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
-transformer|moe_ffn|ssd to run a single workload (moe_ffn and ssd are
-on-demand only — not part of the default ``all`` sweep, which is sized
-to the wall budget).  ``bench.py --preflight`` prints the per-row wall
+transformer|moe_ffn|ssd|bert_zero to run a single workload (moe_ffn,
+ssd and bert_zero are on-demand only — not part of the default ``all``
+sweep, which is sized to the wall budget).  Every row's ``details``
+carries ``hbm_peak`` — the per-device resident high-water
+(temp + argument bytes) of the compiled program, from XLA's
+memory_analysis.  ``bench.py --preflight`` prints the per-row wall
 estimates for the selected sweep and exits non-zero if it would not
 fit MXTPU_BENCH_WALL_BUDGET — check this BEFORE burning a TPU run.
 
@@ -29,8 +32,13 @@ spread, recorded per metric in ``band``).
 Wall budget (r5 post-mortem: one ~12-minute workload cost the round
 its entire perf record, BENCH_r05.json rc=124): the run carries a
 global deadline (``MXTPU_BENCH_WALL_BUDGET`` seconds, default 780).
-Before each workload the remaining time is checked against that row's
-conservative estimate; a row that does not fit is recorded as
+When the selected sweep's TOTAL estimate already exceeds the budget,
+the sweep is auto-trimmed UP FRONT: rows that don't fit the cumulative
+estimate are recorded as ``{"skipped": "budget"}`` before anything
+runs — the same arithmetic ``--preflight`` prints, applied instead of
+merely warned about.  Before each remaining workload the leftover time
+is re-checked against that row's conservative estimate as a backstop;
+a row that does not fit is likewise recorded as
 ``{"skipped": "budget"}`` instead of running — the JSON always prints
 and the process always exits 0 inside the window.  The pipeline row
 additionally self-limits: repeats stop when its own slice of the
@@ -60,6 +68,7 @@ _METRIC_NAMES = {
     "transformer": "transformer_big_wmt_train_throughput",
     "moe_ffn": "moe_ffn_microbench_throughput",
     "ssd": "ssd300_voc_train_throughput",
+    "bert_zero": "bert_large_zero1_train_throughput",
     "lenet": "lenet_mnist_train_throughput",
 }
 
@@ -83,6 +92,8 @@ _TRAIN_FLOPS = {
     # so it is the complete denominator (1.489e12 FLOPs / 2048 tokens).
     "transformer": 0.727e9,
     "moe_ffn": None,          # microbench reports its own details
+    "bert_zero": None,        # ablation row — the throughput delta and
+                              # opt-state bytes are the result, not MFU
     "ssd": None,              # anchor machinery dominates op count,
                               # MFU would flatter the conv backbone
     "lenet": None,            # too small for MFU to mean anything
@@ -125,9 +136,13 @@ def _measure(step, x, y, warmup, iters, batch_size, repeats=5):
     # outlier would make every future delta "within noise").  Normal
     # run-to-run variance on this chip is +-5-15% (VERDICT r3 weak-2).
     core = vals[1:] if len(vals) >= 4 else vals
+    # per-device resident high-water (temp + argument bytes) of the
+    # compiled scan program — rides into every row's ``details``
+    mem = step.last_memory_analysis()
     return {"best": max(vals), "median": median, "n": len(vals),
             "spread": round((max(core) - min(core)) / median, 4),
-            "runs": [round(v, 1) for v in vals]}
+            "runs": [round(v, 1) for v in vals],
+            "info": {"hbm_peak": mem["hbm_peak"] if mem else None}}
 
 
 def bench_lenet(batch_size=512, warmup=5, iters=30):
@@ -303,9 +318,12 @@ def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
         vals.sort()
         median = vals[len(vals) // 2] if len(vals) % 2 else \
             0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+        mem = step.last_memory_analysis()
         stats = {"best": max(vals), "median": median, "n": len(vals),
                  "spread": round((max(vals) - min(vals)) / median, 4),
-                 "runs": [round(v, 1) for v in vals]}
+                 "runs": [round(v, 1) for v in vals],
+                 "info": {"hbm_peak": mem["hbm_peak"] if mem
+                          else None}}
         return stats, _METRIC_NAMES["resnet50_pipeline"], "samples/sec"
     finally:
         shutil.rmtree(d, ignore_errors=True)
@@ -479,27 +497,31 @@ def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
             out = run(x0)
             float(jnp.sum(out.astype(jnp.float32)))
             best = min(best, (time.perf_counter() - t0) / n)
-        # HBM high-water of the compiled loop program
+        # HBM high-water of the compiled loop program (``hbm`` keeps
+        # the historical temp+arg+output accounting; ``peak`` is the
+        # sweep-wide hbm_peak convention, temp+arg only)
         try:
             ma = run.lower(x0).compile().memory_analysis()
             hbm = int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
                       + ma.output_size_in_bytes)
+            peak = int(ma.temp_size_in_bytes
+                       + ma.argument_size_in_bytes)
         except Exception:
-            hbm = None
-        return best, hbm
+            hbm = peak = None
+        return best, hbm, peak
 
     def moe_out(xx):
         # aux (load-balance loss) is dropped: the router itself stays
         # live through the dispatch/combine einsums y depends on
         return layer.apply(params, xx)[0]
 
-    t_moe, hbm_moe = _chain(moe_out, x, iters, "moe")
+    t_moe, hbm_moe, peak_moe = _chain(moe_out, x, iters, "moe")
 
     # dense-FFN equivalent: one D→H→D over the same tokens
     k = jax.random.PRNGKey(1)
     w1 = (jax.random.normal(k, (D, H)) / np.sqrt(D)).astype(jnp.bfloat16)
     w2 = (jax.random.normal(k, (H, D)) / np.sqrt(H)).astype(jnp.bfloat16)
-    t_dense, hbm_dense = _chain(
+    t_dense, hbm_dense, _ = _chain(
         lambda xx: jax.nn.relu(xx @ w1) @ w2, x, iters, "dense")
 
     # experts-only: the same per-expert GEMMs on pre-dispatched
@@ -517,12 +539,13 @@ def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
         return jnp.einsum("ech,ehd->ecd", jax.nn.relu(h), w2c) \
             + b2e.astype(jnp.bfloat16)[:, None, :]
 
-    t_exp, _ = _chain(experts_only, xe, iters, "experts")
+    t_exp, _, _ = _chain(experts_only, xe, iters, "experts")
 
     vals = [T / t_moe]
     stats = {"best": max(vals), "median": vals[0], "n": 1,
              "spread": 0.0, "runs": [round(v, 1) for v in vals],
              "info": {
+                 "hbm_peak": peak_moe,
                  "shape": {"T": T, "E": E, "D": D, "H": H,
                            "capacity": C, "dtype": "bfloat16"},
                  "dense_ffn_tokens_per_sec": round(T / t_dense, 1),
@@ -533,6 +556,80 @@ def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
                      max(0.0, (t_moe - t_exp)) / t_moe, 3),
              }}
     return stats, _METRIC_NAMES["moe_ffn"], "tokens/sec"
+
+
+def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
+    """ZeRO-1 ablation (on-demand, MXTPU_BENCH_MODEL=bert_zero): the
+    BERT-Large adam step replicated vs ZeRO-1 sharded optimizer states
+    (``mxtpu.parallel`` TrainStep docs) on a dp mesh over every local
+    device, dp = min(8, devices).  The primary value is the ZeRO
+    variant's tokens/sec when a dp mesh exists (else the replicated
+    number); ``details`` carries both variants' step rates and
+    per-device optimizer-state bytes.  When fewer than 8 devices are
+    attached the dp=8 footprint is additionally PLANNED from
+    ``plan_zero_buckets`` geometry — pure arithmetic, the same
+    provenance as BASELINE.md's optimizer-memory table."""
+    import jax
+
+    from mxtpu import nd
+    from mxtpu import parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.models.transformer import bert_large
+
+    V = 30522
+    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+    rng = np.random.RandomState(0)
+    toks = nd.array(rng.randint(0, V, (batch_size, seq_len))
+                    .astype(np.float32))
+    tokens_per_batch = batch_size * seq_len
+
+    def mlm_loss(pred, y):
+        return gloss.SoftmaxCrossEntropyLoss()(
+            pred.reshape((-1, V)), y.reshape((-1,)))
+
+    def _variant(mesh, zero):
+        net = bert_large(vocab_size=V, max_length=seq_len, dropout=0.1)
+        net.initialize(init="xavier")
+        step = parallel.build_train_step(
+            net, mlm_loss, "adam", {"learning_rate": 1e-4}, mesh=mesh,
+            compute_dtype=dtype, cast_batch=False, zero=zero)
+        stats = _measure(step, toks, toks, warmup, iters,
+                         tokens_per_batch, repeats=3)
+        return stats, step
+
+    dp = min(8, jax.device_count())
+    repl, rstep = _variant(None, None)
+    info = {
+        "dp": dp,
+        "hbm_peak": (repl.get("info") or {}).get("hbm_peak"),
+        "replicated_hbm_peak": (repl.get("info") or {}).get("hbm_peak"),
+        "replicated_tokens_per_sec": round(repl["best"], 1),
+        "replicated_opt_state_bytes": rstep.opt_state_bytes(),
+    }
+    stats = repl
+    if dp > 1:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:dp]), ("dp",))
+        zstats, zstep = _variant(mesh, 1)
+        info.update({
+            # hbm_peak reports the primary (ZeRO) program
+            "hbm_peak": (zstats.get("info") or {}).get("hbm_peak"),
+            "zero_tokens_per_sec": round(zstats["best"], 1),
+            "zero_opt_state_bytes_per_device": zstep.opt_state_bytes(),
+            "zero_vs_replicated": round(zstats["best"] / repl["best"],
+                                        3),
+        })
+        stats = zstats
+    if dp < 8:
+        sigs = [(tuple(rstep._params[i]._data._data.shape),
+                 str(rstep._params[i]._data._data.dtype))
+                for i in rstep._train_idx]
+        planned = parallel.plan_zero_buckets(sigs, 8)
+        # adam: two f32 state leaves (m, v) per bucket, dp-sharded
+        info["zero_dp8_planned_opt_state_bytes_per_device"] = sum(
+            2 * b["padded_bytes"] // 8 for b in planned)
+    stats = dict(stats)
+    stats["info"] = info
+    return stats, _METRIC_NAMES["bert_zero"], "tokens/sec"
 
 
 def _mfu(model, value, peak, per_unit=None):
@@ -549,7 +646,7 @@ def _mfu(model, value, peak, per_unit=None):
 # underestimates risk rc=124 — err high.
 _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             "bert_s512": 130, "lenet": 60, "transformer": 120,
-            "moe_ffn": 60, "ssd": 90}
+            "moe_ffn": 60, "ssd": 90, "bert_zero": 150}
 
 
 def _sweep_stale_tmpdirs():
@@ -576,11 +673,13 @@ def main():
                  batch_size=8, seq_len=512,
                  metric_key="bert_s512"),
              "transformer": bench_transformer,
-             # on-demand rows (MXTPU_BENCH_MODEL=moe_ffn / ssd): each
-             # fits the budget on its own but the default sweep is
-             # already near the wall, so they are not in "all"
+             # on-demand rows (MXTPU_BENCH_MODEL=moe_ffn / ssd /
+             # bert_zero): each fits the budget on its own but the
+             # default sweep is already near the wall, so they are
+             # not in "all"
              "moe_ffn": bench_moe_ffn,
-             "ssd": bench_ssd}
+             "ssd": bench_ssd,
+             "bert_zero": bench_bert_zero}
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")
@@ -610,13 +709,31 @@ def main():
         with open(self_path) as f:
             baseline = json.load(f).get("metrics", {})
 
+    results = {}
     if est_total > budget:
+        # r5's rc=124 must never recur: when the sweep as configured
+        # cannot fit, trim it UP FRONT by the same arithmetic
+        # --preflight prints — each row whose estimate does not fit
+        # the cumulative total is dropped on record before anything
+        # runs.  The per-row runtime check below stays as the
+        # backstop for rows that overrun their estimate.
+        cum = 0.0
+        for m in order:
+            if cum + _ROW_EST[m] <= budget:
+                cum += _ROW_EST[m]
+                continue
+            results[m] = {"metric": _METRIC_NAMES[m], "value": None,
+                          "unit": None, "mfu": None,
+                          "vs_baseline": None, "skipped": "budget",
+                          "est_seconds": _ROW_EST[m],
+                          "remaining_seconds": round(budget - cum, 1)}
         print(f"bench pre-flight: estimated {est_total}s for "
               f"{order} exceeds MXTPU_BENCH_WALL_BUDGET={budget:.0f}s; "
-              f"tail rows will be skipped with a budget marker",
+              f"auto-trimmed {sorted(results)} onto the record",
               file=sys.stderr)
-    results = {}
     for model in order:
+        if model in results:
+            continue
         remaining = deadline - time.monotonic()
         if remaining < _ROW_EST[model]:
             # r5 lesson: a row that cannot finish must be DROPPED ON

@@ -474,15 +474,17 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
         # CPU / interpret-off: the reference path IS the intended path
         return attention_reference(q, k, v, causal, scale)
     if D > 512:
-        # warn once per shape class: the O(T^2)-memory fallback
-        # silently losing the flash memory guarantee is exactly the
-        # failure mode a user needs to hear about
-        sig = ("head_dim", D)
+        # warn once per full (q, k) shape tuple: the O(T^2)-memory
+        # fallback silently losing the flash memory guarantee is
+        # exactly the failure mode a user needs to hear about — once
+        # per distinct call shape, not once per step of a long epoch
+        sig = ("head_dim", tuple(q.shape), tuple(k.shape))
         if sig not in _warned_fallback:
             _warned_fallback.add(sig)
             warnings.warn(
                 f"flash_attention falling back to the O(T^2) reference "
-                f"path (head_dim {D} > 512 kernel bound)", stacklevel=2)
+                f"path (head_dim {D} > 512 kernel bound) for "
+                f"q{tuple(q.shape)} k{tuple(k.shape)}", stacklevel=2)
         return attention_reference(q, k, v, causal, scale)
     if Tq % 8 or Tk % 8:
         return _padded_flash(q, k, v, bool(causal), scale)

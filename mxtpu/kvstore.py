@@ -161,7 +161,14 @@ class KVStore:
     # ------------------------------------------------------------------
     def set_optimizer(self, optimizer) -> None:
         """Run the optimizer "server-side" on push (reference
-        ``kvstore_dist_server.h``† behavior, `update_on_kvstore`)."""
+        ``kvstore_dist_server.h``† behavior, `update_on_kvstore`).
+
+        The in-graph form of this contract is ``mxtpu.parallel``'s
+        ZeRO-1 mode (``TrainStep`` on a dp mesh): the ``dist_sync``
+        server that owns a parameter shard and updates it where it
+        lives becomes a reduce-scatter to the shard's device, a
+        shard-local optimizer update, and an all-gather of the fresh
+        params — same placement semantics, compiled into the step."""
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
 

@@ -3,3 +3,4 @@
 from .optimizer import *          # noqa: F401,F403
 from .optimizer import Optimizer, Updater, get_updater, register, create
 from . import lr_scheduler        # noqa: F401
+from .functional import adam_bias_correction, opt_rule  # noqa: F401

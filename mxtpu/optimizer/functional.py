@@ -1,0 +1,119 @@
+"""Functional (init, update) optimizer rules for the compiled train
+step — the stateless form of ``kvstore_dist_server.h``†'s server-side
+updates, shared by ``mxtpu.parallel.TrainStep`` and
+``PipelineTrainStep``.
+
+Every rule reuses the fused registry ops ("optimizers are ops") and
+accepts ``stacked=True``: same-shape parameters ride stacked on a new
+axis 0 and ONE update call handles the bundle.  ``init`` mirrors that:
+``init(w, stacked=True)`` treats ``w``'s axis 0 as the stack axis, so
+scalar per-parameter state (LAMB's step count ``t``) becomes a
+``(n,)`` vector — one slot per stacked row.  The ZeRO-1 sharded path
+(``mxtpu.parallel``) carries these stacked states dp-sharded and feeds
+each device its local rows; all rules are elementwise in (w, g, state)
+so the shard-local apply is exact, and LAMB's per-slice trust-ratio
+norms reduce within a bucket row, which ZeRO keeps device-local by
+sharding LAMB buckets on the stack axis only.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import get_op
+from . import optimizer as _opt
+
+
+def adam_bias_correction(opt, t: int) -> float:
+    """The raw ``adam_update`` op does not bias-correct; fold the
+    correction into the lr (single source for TrainStep AND
+    PipelineTrainStep)."""
+    if isinstance(opt, _opt.Adam) and t > 0:
+        return float(np.sqrt(1.0 - opt.beta2 ** t) /
+                     (1.0 - opt.beta1 ** t))
+    return 1.0
+
+
+def opt_rule(optimizer):
+    """Return ``(init_state(w, stacked=False) -> tuple,
+    update(w, g, state, lr, wd, stacked=False) -> (w, state))``.
+
+    All rules are elementwise in (w, g, state) — numerically identical
+    stacked or not — except LAMB, whose per-tensor trust-ratio norms
+    reduce per axis-0 slice when stacked."""
+    if isinstance(optimizer, _opt.LAMB):
+        fn = get_op("lamb_update").fn
+
+        def init(w, stacked=False):
+            # per-param step count rides in the state (traced, so lr
+            # schedules and resume never recompile); stacked buckets
+            # carry one counter per row
+            t0 = jnp.zeros((w.shape[0],) if stacked else (), jnp.int32)
+            return (jnp.zeros_like(w), jnp.zeros_like(w), t0)
+
+        def update(w, g, state, lr, wd, stacked=False):
+            t = state[2] + 1
+            w2, m, v = fn(w, g, state[0], state[1], t, lr=lr,
+                          beta1=optimizer.beta1, beta2=optimizer.beta2,
+                          epsilon=optimizer.epsilon, wd=wd,
+                          rescale_grad=optimizer.rescale_grad,
+                          clip_gradient=optimizer._clip(),
+                          bias_correction=optimizer.bias_correction,
+                          stacked=stacked)
+            return w2, (m, v, t)
+        return init, update
+    if isinstance(optimizer, _opt.Adam):
+        fn = get_op("adam_update").fn
+
+        def init(w, stacked=False):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, state, lr, wd, stacked=False):
+            w2, m, v = fn(w, g, state[0], state[1], lr=lr,
+                          beta1=optimizer.beta1, beta2=optimizer.beta2,
+                          epsilon=optimizer.epsilon, wd=wd,
+                          rescale_grad=optimizer.rescale_grad,
+                          clip_gradient=optimizer._clip())
+            return w2, (m, v)
+        return init, update
+    if isinstance(optimizer, _opt.RMSProp) and not optimizer.centered:
+        fn = get_op("rmsprop_update").fn
+
+        def init(w, stacked=False):
+            return (jnp.zeros_like(w),)
+
+        def update(w, g, state, lr, wd, stacked=False):
+            w2, n = fn(w, g, state[0], lr=lr, gamma1=optimizer.gamma1,
+                       epsilon=optimizer.epsilon, wd=wd,
+                       rescale_grad=optimizer.rescale_grad,
+                       clip_gradient=optimizer._clip())
+            return w2, (n,)
+        return init, update
+    if isinstance(optimizer, _opt.SGD):
+        if optimizer.momentum:
+            fn = get_op("sgd_mom_update").fn
+
+            def init(w, stacked=False):
+                return (jnp.zeros_like(w),)
+
+            def update(w, g, state, lr, wd, stacked=False):
+                w2, m = fn(w, g, state[0], lr=lr,
+                           momentum=optimizer.momentum, wd=wd,
+                           rescale_grad=optimizer.rescale_grad,
+                           clip_gradient=optimizer._clip())
+                return w2, (m,)
+            return init, update
+        fn = get_op("sgd_update").fn
+
+        def init(w, stacked=False):
+            return ()
+
+        def update(w, g, state, lr, wd, stacked=False):
+            return fn(w, g, lr=lr, wd=wd,
+                      rescale_grad=optimizer.rescale_grad,
+                      clip_gradient=optimizer._clip()), ()
+        return init, update
+    raise MXNetError(
+        f"compiled train step supports SGD/Adam/RMSProp/LAMB; got "
+        f"{type(optimizer).__name__} (use gluon.Trainer eager path)")

@@ -13,6 +13,15 @@ schedules them over ICI (SURVEY.md §2.4, §5.8).
 
 ``KVStore`` (``mxtpu.kvstore``) remains as the API-parity facade; this
 module is the mechanism.
+
+ZeRO-1 (default on single-process ``dp`` meshes, kill switch
+``MXTPU_ZERO=0``): instead of all-reducing full gradients and keeping
+a replicated optimizer-state copy per device, the step reduce-scatters
+each (shape, dtype) bucket's gradients, updates the 1/dp state shard
+the device owns, and all-gathers the fresh params — the in-graph form
+of the reference ``dist_sync`` server-side update
+(``kvstore_dist_server.h``†), cutting optimizer HBM ~dp× at equal
+total comm bytes (rs + ag == ar).
 """
 from __future__ import annotations
 
@@ -34,7 +43,8 @@ from ..ndarray.ndarray import NDArray
 from ..ops.registry import get_op
 
 __all__ = ["make_mesh", "shard_batch", "replicate", "TrainStep",
-           "build_train_step", "Mesh", "PartitionSpec", "P",
+           "build_train_step", "plan_zero_buckets",
+           "Mesh", "PartitionSpec", "P",
            "spmd_pipeline", "stack_stage_params", "PipelineTrainStep",
            "build_pipeline_train_step", "snapshot_params",
            "restore_params", "moe"]
@@ -150,113 +160,134 @@ def replicate(mesh: Mesh, arr):
         else out
 
 
-def _adam_bias_correction(opt, t: int) -> float:
-    """The raw ``adam_update`` op does not bias-correct; fold the
-    correction into the lr (single source for TrainStep AND
-    PipelineTrainStep)."""
-    if isinstance(opt, opt_mod.Adam) and t > 0:
-        return float(np.sqrt(1.0 - opt.beta2 ** t) /
-                     (1.0 - opt.beta1 ** t))
-    return 1.0
+# functional optimizer rules for the compiled step now live in
+# ``mxtpu.optimizer.functional`` (the ZeRO-1 sharded path needs their
+# stacked state-init shapes); the underscored aliases remain this
+# package's internal import surface (pipeline.py).
+from ..optimizer.functional import (adam_bias_correction as  # noqa: E402
+                                    _adam_bias_correction,
+                                    opt_rule as _opt_rule)
 
 
-# ----------------------------------------------------------------------
-# functional optimizer rules for the compiled step
-# (reuse the fused registry ops — "optimizers are ops")
-# ----------------------------------------------------------------------
-def _opt_rule(optimizer: opt_mod.Optimizer):
-    """Return (init_state(w)->tuple, update(w,g,state,lr,wd)->(w,state)).
+def plan_zero_buckets(sigs, dp: int, stack_axis_only: bool = False):
+    """Plan the ZeRO-1 bucket layout for one optimizer step — pure
+    geometry, no arrays (also the provenance of BASELINE.md's
+    optimizer-memory table and the bench accounting).
 
-    Every ``update`` accepts ``stacked=False``: the batched optimizer
-    path stacks same-shape parameters on a new axis 0 and applies ONE
-    update to the bundle.  All rules are elementwise in (w, g, state)
-    — numerically identical stacked or not — except LAMB, whose
-    per-tensor trust-ratio norms reduce per axis-0 slice when stacked."""
-    if isinstance(optimizer, opt_mod.LAMB):
-        fn = get_op("lamb_update").fn
+    ``sigs`` is a list of ``(shape, dtype_str)`` per trainable
+    parameter, in step order.  Parameters bucket by (shape, dtype) —
+    the same buckets MXTPU_BATCHED_OPT stacks — and each bucket picks
+    ONE axis of its stacked ``(n,) + shape`` array to shard over
+    ``dp``: the axis minimizing relative zero-padding (ties prefer the
+    stack axis, whose lr/wd bookkeeping is simplest).  Singleton
+    buckets (n=1, e.g. an embedding table) would waste (dp-1)/dp of a
+    full row if only the stack axis were allowed — axis choice is what
+    makes the ≤ replicated/dp × 1.15 footprint hold.  LAMB buckets are
+    pinned to the stack axis (``stack_axis_only=True``): its per-slice
+    trust-ratio norms reduce within a bucket row, which stays
+    device-local only when whole rows live on one device.
 
-        def init(w):
-            # per-param step count rides in the state (traced, so lr
-            # schedules and resume never recompile)
-            return (jnp.zeros_like(w), jnp.zeros_like(w),
-                    jnp.zeros((), jnp.int32))
+    Zero-padding is numerically inert for every supported rule: a
+    padded region starts with w = g = state = 0 and every rule maps
+    zeros to zeros (LAMB's padded rows see wnorm = rnorm = 0 → trust
+    ratio 1.0, still updating 0 by 0).
 
-        def update(w, g, state, lr, wd, stacked=False):
-            t = state[2] + 1
-            w2, m, v = fn(w, g, state[0], state[1], t, lr=lr,
-                          beta1=optimizer.beta1, beta2=optimizer.beta2,
-                          epsilon=optimizer.epsilon, wd=wd,
-                          rescale_grad=optimizer.rescale_grad,
-                          clip_gradient=optimizer._clip(),
-                          bias_correction=optimizer.bias_correction,
-                          stacked=stacked)
-            return w2, (m, v, t)
-        return init, update
-    if isinstance(optimizer, opt_mod.Adam):
-        fn = get_op("adam_update").fn
+    Returns a list of dicts: ``jidx`` (positions within the trainable
+    tuple), ``shape``/``dtype`` (per param), ``stacked_shape``,
+    ``axis`` (shard axis of the stacked array; 0 = stack axis),
+    ``pad`` (zero rows appended on that axis), ``padded_shape``,
+    ``rows`` (local extent per device), ``param_bytes`` (logical,
+    unpadded) and ``padded_bytes``."""
+    if dp < 1:
+        raise MXNetError(f"plan_zero_buckets needs dp >= 1, got {dp}")
+    by_sig: Dict[Tuple, List[int]] = {}
+    for j, (shape, dt) in enumerate(sigs):
+        by_sig.setdefault((tuple(shape), str(dt)), []).append(j)
+    buckets = []
+    for (shape, dt), js in by_sig.items():
+        stacked_shape = (len(js),) + shape
+        best = None
+        cands = [0] if stack_axis_only else range(len(stacked_shape))
+        for ax in cands:
+            size = stacked_shape[ax]
+            pad = (-size) % dp
+            key = (pad / size, ax)
+            if best is None or key < best[0]:
+                best = (key, ax, pad)
+        _, axis, pad = best
+        padded = list(stacked_shape)
+        padded[axis] += pad
+        itemsize = jnp.dtype(dt).itemsize
+        buckets.append({
+            "jidx": js, "shape": shape, "dtype": dt,
+            "stacked_shape": stacked_shape, "axis": axis, "pad": pad,
+            "padded_shape": tuple(padded),
+            "rows": padded[axis] // dp,
+            "param_bytes": int(np.prod(stacked_shape, dtype=np.int64))
+            * itemsize,
+            "padded_bytes": int(np.prod(padded, dtype=np.int64))
+            * itemsize,
+        })
+    return buckets
 
-        def init(w):
-            return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-        def update(w, g, state, lr, wd, stacked=False):
-            w2, m, v = fn(w, g, state[0], state[1], lr=lr,
-                          beta1=optimizer.beta1, beta2=optimizer.beta2,
-                          epsilon=optimizer.epsilon, wd=wd,
-                          rescale_grad=optimizer.rescale_grad,
-                          clip_gradient=optimizer._clip())
-            return w2, (m, v)
-        return init, update
-    if isinstance(optimizer, opt_mod.RMSProp) and not optimizer.centered:
-        fn = get_op("rmsprop_update").fn
-
-        def init(w):
-            return (jnp.zeros_like(w),)
-
-        def update(w, g, state, lr, wd, stacked=False):
-            w2, n = fn(w, g, state[0], lr=lr, gamma1=optimizer.gamma1,
-                       epsilon=optimizer.epsilon, wd=wd,
-                       rescale_grad=optimizer.rescale_grad,
-                       clip_gradient=optimizer._clip())
-            return w2, (n,)
-        return init, update
-    if isinstance(optimizer, opt_mod.SGD):
-        if optimizer.momentum:
-            fn = get_op("sgd_mom_update").fn
-
-            def init(w):
-                return (jnp.zeros_like(w),)
-
-            def update(w, g, state, lr, wd, stacked=False):
-                w2, m = fn(w, g, state[0], lr=lr,
-                           momentum=optimizer.momentum, wd=wd,
-                           rescale_grad=optimizer.rescale_grad,
-                           clip_gradient=optimizer._clip())
-                return w2, (m,)
-            return init, update
-        fn = get_op("sgd_update").fn
-
-        def init(w):
-            return ()
-
-        def update(w, g, state, lr, wd, stacked=False):
-            return fn(w, g, lr=lr, wd=wd,
-                      rescale_grad=optimizer.rescale_grad,
-                      clip_gradient=optimizer._clip()), ()
-        return init, update
-    raise MXNetError(
-        f"compiled train step supports SGD/Adam/RMSProp/LAMB; got "
-        f"{type(optimizer).__name__} (use gluon.Trainer eager path)")
+def _mem_stats(compiled):
+    """``memory_analysis()`` of a compiled program as a plain dict
+    (None when the backend doesn't report).  ``hbm_peak`` is
+    temp + argument bytes — the resident high-water the program needs
+    beyond its outputs."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    out["hbm_peak"] = (out.get("temp_size_in_bytes", 0) +
+                       out.get("argument_size_in_bytes", 0))
+    return out
 
 
 class TrainStep:
     """One fused XLA executable per (shape signature): fwd + bwd +
     collectives + optimizer + aux writeback.  Call with (x, y) batches;
-    parameters update in place (rebound buffers)."""
+    parameters update in place (rebound buffers).
+
+    **ZeRO-1** (``zero``): on a single-process mesh whose ``dp_axis``
+    has size > 1 (and no ``param_spec_fn``), the step defaults to
+    ZeRO-1 sharded optimizer states: gradients are reduce-scattered
+    per (shape, dtype) bucket (see :func:`plan_zero_buckets`), each
+    device updates only the 1/dp state shard it owns, and the fresh
+    params are all-gathered back to replicated — optimizer HBM drops
+    ~dp× at the same total comm bytes as the all-reduce it replaces.
+    ``zero=0`` (or ``MXTPU_ZERO=0`` in the environment) restores the
+    replicated GSPMD path; ``zero=1`` insists and raises where ZeRO
+    can't apply.  The ZeRO step is an explicit ``shard_map`` over
+    ``dp_axis``, with three contract changes vs the GSPMD path:
+
+    * the batch dim must divide the dp size (error otherwise);
+    * the loss must reduce as a mean over examples (the gluon losses
+      do): the global loss is the mean of per-shard means.  BatchNorm
+      accumulates per-shard batch statistics (averaged into the
+      running stats — the reference's non-sync DDP behaviour) and
+      dropout draws an independent stream per shard;
+    * optimizer updates always run bucket-stacked (the ZeRO exchange
+      is per bucket), regardless of ``MXTPU_BATCHED_OPT``.
+
+    ``save_states`` always writes the canonical per-parameter layout
+    (gather-on-save), so checkpoints are interchangeable between ZeRO
+    and replicated steps in both directions."""
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", batch_axis: int = 0,
                  param_spec_fn: Optional[Callable] = None, donate=True,
-                 compute_dtype=None, cast_batch=True):
+                 compute_dtype=None, cast_batch=True, zero=None):
         from ..gluon.block import _traced_forward
         self._traced_forward = _traced_forward
         self.net = net
@@ -282,6 +313,41 @@ class TrainStep:
         self._compiled = {}
         self._params: Optional[List] = None
         self._t = 0
+        self._last_mem: Optional[Dict[str, int]] = None
+        self.zero = self._decide_zero(zero)
+
+    def _decide_zero(self, zero) -> bool:
+        """Resolve the ZeRO-1 mode: ``MXTPU_ZERO=0`` is the global
+        kill switch, ``zero=0/1`` the per-step override, and the auto
+        default is ON exactly when the mechanism applies — a
+        single-process mesh with a >1-sized ``dp_axis`` and no
+        tensor-parallel ``param_spec_fn``."""
+        env = os.environ.get("MXTPU_ZERO", "").strip().lower()
+        if env in ("0", "off", "false"):
+            return False
+        if zero is not None and not zero:
+            return False
+        forced = bool(zero)
+        if self.mesh is None or self.dp_axis not in self.mesh.shape \
+                or self.mesh.shape[self.dp_axis] <= 1:
+            if forced:
+                raise MXNetError(
+                    "zero=1 needs a mesh whose dp axis "
+                    f"({self.dp_axis!r}) has size > 1")
+            return False
+        if self.param_spec_fn is not None:
+            if forced:
+                raise MXNetError(
+                    "zero=1 does not compose with param_spec_fn "
+                    "(tensor parallelism) yet — drop one of the two")
+            return False
+        if _mesh_is_multiprocess(self.mesh):
+            if forced:
+                raise MXNetError(
+                    "zero=1 needs a single-process mesh (multi-host "
+                    "ZeRO is pending transport validation)")
+            return False
+        return True
 
     # -- parameter bookkeeping -----------------------------------------
     def _collect(self, x):
@@ -309,13 +375,63 @@ class TrainStep:
                     p._data._data = _device_put_global(
                         p._data._data, self.mesh,
                         spec if spec is not None else P())
-            self._opt_state = tuple(
-                self._opt_init(self._params[i]._data._data)
-                for i in self._train_idx)
-            if self.mesh is not None:
-                self._opt_state = jax.tree_util.tree_map(
-                    lambda v: _device_put_global(v, self.mesh, P()),
-                    self._opt_state)
+            if self.zero:
+                self._init_zero_state()
+            else:
+                self._opt_state = tuple(
+                    self._opt_init(self._params[i]._data._data)
+                    for i in self._train_idx)
+                if self.mesh is not None:
+                    self._opt_state = jax.tree_util.tree_map(
+                        lambda v: _device_put_global(v, self.mesh, P()),
+                        self._opt_state)
+
+    def _init_zero_state(self):
+        """ZeRO-1 state: one stacked, padded array per (shape, dtype)
+        bucket, carried dp-sharded on the bucket's planned axis.
+        ``out_shardings`` makes XLA materialize each device's slice
+        directly — no transient replicated copy exists at any point."""
+        mesh, dp_axis = self.mesh, self.dp_axis
+        dp = mesh.shape[dp_axis]
+        params = self._params
+        sigs = [(params[i]._data._data.shape,
+                 str(params[i]._data._data.dtype))
+                for i in self._train_idx]
+        lamb = isinstance(self.optimizer, opt_mod.LAMB)
+        self._zero_dp = dp
+        self._zero_buckets = plan_zero_buckets(sigs, dp,
+                                               stack_axis_only=lamb)
+        specs, shardings = [], []
+        for b in self._zero_buckets:
+            leaf_shapes = jax.eval_shape(
+                lambda b=b: self._opt_init(
+                    jnp.zeros(b["padded_shape"], b["dtype"]),
+                    stacked=True))
+            bspecs = []
+            for leaf in leaf_shapes:
+                # full-rank leaves shard on the planned axis; rank-1
+                # per-row leaves (LAMB's t) ride the stack axis, which
+                # is the planned axis whenever they exist
+                s = [None] * len(leaf.shape)
+                s[b["axis"] if b["axis"] < len(leaf.shape) else 0] = \
+                    dp_axis
+                bspecs.append(P(*s))
+            specs.append(tuple(bspecs))
+            shardings.append(tuple(NamedSharding(mesh, sp)
+                                   for sp in bspecs))
+        self._zero_state_specs = tuple(specs)
+        self._zero_state_shardings = tuple(shardings)
+        buckets = self._zero_buckets
+        opt_init = self._opt_init
+
+        def init_all():
+            return tuple(
+                opt_init(jnp.zeros(b["padded_shape"], b["dtype"]),
+                         stacked=True)
+                for b in buckets)
+
+        self._opt_state = jax.jit(
+            init_all, out_shardings=self._zero_state_shardings)()
 
     def _build(self, key, x_raw, y_raw):
         params = self._params
@@ -429,26 +545,160 @@ class TrainStep:
                                                 opt_state, lrs, wds)
             return loss, new_vals, new_state, raw_aux
 
-        # learn the aux structure without device work
+        if self.zero:
+            # ZeRO-1 replaces the whole sync+update path: an explicit
+            # shard_map whose bucket exchange is reduce-scatter →
+            # shard-local update → all-gather
+            step = self._build_zero_step(loss_flat, x_raw, y_raw)
+
         train_vals = tuple(params[i]._data._data for i in train_idx)
         frozen_vals = tuple(params[i]._data._data for i in frozen_idx)
         zeros = jnp.zeros(len(train_idx), jnp.float32)
-        jax.eval_shape(step, train_vals, frozen_vals, self._opt_state,
-                       jax.random.key_data(key), zeros, zeros,
-                       x_raw, y_raw)
         donate = (0, 2) if self.donate else ()
         fitted = jax.jit(step, donate_argnums=donate)
+        fn = fitted
+        mem = None
+        if (self.param_spec_fn is None and
+                (self.mesh is None
+                 or not _mesh_is_multiprocess(self.mesh))):
+            # AOT-compile now: the lowering trace doubles as the aux
+            # discovery pass (no separate eval_shape), the first step
+            # pays no tracing, and memory_analysis / cost_analysis /
+            # hlo_text come for free afterwards.  Multi-process meshes
+            # keep the jit wrapper — its dispatch handles cross-host
+            # arrays.  So does tensor-parallel (param_spec_fn): GSPMD
+            # may return updated params with a compiler-chosen
+            # sharding that differs from the placement the program was
+            # lowered with, and AOT executables reject input shardings
+            # that drift between steps.
+            fn = fitted.lower(
+                train_vals, frozen_vals, self._opt_state,
+                jax.random.key_data(key), zeros, zeros, x_raw,
+                y_raw).compile()
+            mem = _mem_stats(fn)
+            self._last_mem = mem
+        else:
+            # learn the aux structure without device work
+            jax.eval_shape(step, train_vals, frozen_vals,
+                           self._opt_state, jax.random.key_data(key),
+                           zeros, zeros, x_raw, y_raw)
         # aux (BN running stats) positions inside the frozen tuple, in
         # aux_params order, for the scanned multi-step path to thread
         # them through the carry (None if an aux is somehow trainable)
         id2pos = {id(params[i]): j for j, i in enumerate(frozen_idx)}
         aux_pos = [id2pos.get(id(p)) for p in aux_box["aux_params"]]
-        return {"fn": fitted, "raw_step": step,
+        return {"fn": fn, "raw_step": step,
                 "aux_params": aux_box["aux_params"],
-                "frozen_idx": frozen_idx, "aux_pos": aux_pos}
+                "frozen_idx": frozen_idx, "aux_pos": aux_pos,
+                "mem": mem}
+
+    def _build_zero_step(self, loss_flat, x_raw, y_raw):
+        """The ZeRO-1 step body: an explicit ``shard_map`` over
+        ``dp_axis``.  GSPMD's ReduceScatterCreator pass is GPU/TPU
+        only, so sharding constraints alone cannot guarantee the
+        reduce-scatter on every backend — the explicit collectives
+        make the comm layout part of the program, testable from the
+        HLO on the CPU virtual mesh."""
+        from jax.experimental.shard_map import shard_map
+        mesh, dp_axis = self.mesh, self.dp_axis
+        dp = self._zero_dp
+        buckets = self._zero_buckets
+        opt_update = self._opt_update
+        batch_axis = self.batch_axis
+
+        def apply_zero(train_vals, grads, opt_state, lrs, wds):
+            new_vals: List[Any] = [None] * len(train_vals)
+            new_state = []
+            me = lax.axis_index(dp_axis)
+            for b, st in zip(buckets, opt_state):
+                js, ax, pad, rows = (b["jidx"], b["axis"], b["pad"],
+                                     b["rows"])
+                w_s = jnp.stack([train_vals[j] for j in js])
+                g_s = jnp.stack([grads[j] for j in js])
+                orig = w_s.shape[ax]
+                if pad:
+                    widths = [(0, 0)] * w_s.ndim
+                    widths[ax] = (0, pad)
+                    w_s = jnp.pad(w_s, widths)
+                    g_s = jnp.pad(g_s, widths)
+                # THE ZeRO exchange: reduce-scatter replaces the
+                # gradient all-reduce; this device owns rows
+                # [me*rows, (me+1)*rows) of the padded bucket.
+                # psum_scatter sums partial grads; /dp makes the mean
+                # matching the mean-of-shard-means loss
+                g_loc = lax.psum_scatter(g_s, dp_axis,
+                                         scatter_dimension=ax,
+                                         tiled=True) / dp
+                start = me * rows
+                w_loc = lax.dynamic_slice_in_dim(w_s, start, rows, ax)
+                idxa = jnp.asarray(np.asarray(js, np.int32))
+                if ax == 0:
+                    # per-row lr/wd follow the rows this device owns
+                    lr_v = jnp.take(lrs, idxa)
+                    wd_v = jnp.take(wds, idxa)
+                    if pad:
+                        lr_v = jnp.pad(lr_v, (0, pad))
+                        wd_v = jnp.pad(wd_v, (0, pad))
+                    bshape = (rows,) + (1,) * (w_s.ndim - 1)
+                    lr_b = lax.dynamic_slice_in_dim(
+                        lr_v, start, rows, 0).reshape(bshape)
+                    wd_b = lax.dynamic_slice_in_dim(
+                        wd_v, start, rows, 0).reshape(bshape)
+                else:
+                    # inner-axis shard: every device sees every row
+                    bshape = (len(js),) + (1,) * (w_s.ndim - 1)
+                    lr_b = jnp.take(lrs, idxa).reshape(bshape)
+                    wd_b = jnp.take(wds, idxa).reshape(bshape)
+                w2_loc, st2 = opt_update(w_loc, g_loc, st, lr_b, wd_b,
+                                         stacked=True)
+                w2 = lax.all_gather(w2_loc, dp_axis, axis=ax,
+                                    tiled=True)
+                if pad:
+                    w2 = lax.slice_in_dim(w2, 0, orig, axis=ax)
+                for a, j in enumerate(js):
+                    new_vals[j] = w2[a]
+                new_state.append(st2)
+            return tuple(new_vals), tuple(new_state)
+
+        def body(train_vals, frozen_vals, opt_state, key_data, lrs,
+                 wds, x, y):
+            me = lax.axis_index(dp_axis)
+            # decorrelate dropout across shards (the GSPMD path gets
+            # this for free from its globally-sharded RNG)
+            kd = jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(key_data), me))
+            (loss, raw_aux), grads = jax.value_and_grad(
+                loss_flat, has_aux=True)(train_vals, frozen_vals, kd,
+                                         x, y)
+            # loss_flat reduces over the LOCAL shard; equal shard
+            # sizes make the mean of shard means the global mean
+            loss = lax.psum(loss, dp_axis) / dp
+            raw_aux = tuple(
+                lax.pmean(a, dp_axis)
+                if jnp.issubdtype(a.dtype, jnp.inexact) else a
+                for a in raw_aux)
+            new_vals, new_state = apply_zero(train_vals, grads,
+                                             opt_state, lrs, wds)
+            return loss, new_vals, new_state, raw_aux
+
+        xspec = [None] * x_raw.ndim
+        xspec[batch_axis] = dp_axis
+        yspec = [None] * max(y_raw.ndim, 1)
+        if y_raw.ndim > batch_axis:
+            yspec[batch_axis] = dp_axis
+        in_specs = (P(), P(), self._zero_state_specs, P(), P(), P(),
+                    P(*xspec), P(*yspec[:y_raw.ndim]))
+        out_specs = (P(), P(), self._zero_state_specs, P())
+        # check_rep=False: the rep checker can't infer that the tiled
+        # all_gather output is replicated
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
 
     # -- the hot call ----------------------------------------------------
-    def __call__(self, x, y):
+    def _prep(self, x, y):
+        """Collect params, place the batch on the mesh, and return
+        ``(x_raw, y_raw, sig)`` — shared by __call__ and the
+        introspection entry points."""
         # under a multi-process mesh, keep non-NDArray inputs as HOST
         # buffers: _device_put_global shards them directly, avoiding a
         # wasted H2D→D2H round trip through the default device
@@ -458,6 +708,11 @@ class TrainStep:
         y_raw = y.data if isinstance(y, NDArray) else wrap(y)
         self._collect(x if isinstance(x, NDArray)
                       else NDArray(x_raw, None, _placed=True))
+        if self.zero and x_raw.shape[self.batch_axis] % self._zero_dp:
+            raise MXNetError(
+                f"ZeRO-1 shards the batch over dp={self._zero_dp}; "
+                f"batch dim {x_raw.shape[self.batch_axis]} is not "
+                f"divisible (pad the batch, or pass zero=0)")
         if self.mesh is not None:
             spec = [None] * x_raw.ndim
             spec[self.batch_axis] = self.dp_axis
@@ -468,20 +723,40 @@ class TrainStep:
                                        P(*yspec[:y_raw.ndim]))
         sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
                str(y_raw.dtype))
-        key = _rnd._next_key(None)
+        return x_raw, y_raw, sig
+
+    def _entry_for(self, x_raw, y_raw, sig, key):
         entry = self._compiled.get(sig)
         if entry is None:
             entry = self._build(key, x_raw, y_raw)
             self._compiled[sig] = entry
+        return entry
+
+    def _commit_small(self, *vals):
+        """AOT executables validate input shardings — commit the small
+        per-step scalars (lr/wd vectors, RNG key data) to the mesh
+        replicated layout (single-process meshes only; multi-process
+        keeps the jit path whose dispatch handles placement)."""
+        if self.mesh is None or _mesh_is_multiprocess(self.mesh):
+            return vals
+        rs = NamedSharding(self.mesh, P())
+        return tuple(jax.device_put(v, rs) for v in vals)
+
+    def __call__(self, x, y):
+        x_raw, y_raw, sig = self._prep(x, y)
+        key = _rnd._next_key(None)
+        entry = self._entry_for(x_raw, y_raw, sig, key)
         self._t += 1
         lrs, wds = self._lrs_wds()
+        lrs, wds, kd = self._commit_small(lrs, wds,
+                                          jax.random.key_data(key))
         params = self._params
         train_vals = tuple(params[i]._data._data for i in self._train_idx)
         frozen_vals = tuple(params[i]._data._data
                             for i in entry["frozen_idx"])
         loss, new_vals, new_state, raw_aux = entry["fn"](
             train_vals, frozen_vals, self._opt_state,
-            jax.random.key_data(key), lrs, wds, x_raw, y_raw)
+            kd, lrs, wds, x_raw, y_raw)
         for i, v in zip(self._train_idx, new_vals):
             params[i]._data._data = v
         self._opt_state = new_state
@@ -523,6 +798,11 @@ class TrainStep:
             ys = y_raw.reshape((steps, B) + y_raw.shape[1:]) \
                 if y_raw.ndim else y_raw
         self._collect(NDArray(x_raw[:B], None, _placed=True))
+        if self.zero and B % self._zero_dp:
+            raise MXNetError(
+                f"ZeRO-1 shards the batch over dp={self._zero_dp}; "
+                f"microbatch dim {B} is not divisible (pad the batch, "
+                f"or pass zero=0)")
         batch_dim = 0 if reuse_batch else 1
         if self.mesh is not None:
             spec = [None] * xs.ndim
@@ -543,6 +823,16 @@ class TrainStep:
             entry = self._build(key, xb0, yb0)
             self._compiled[sig] = entry
         msig = ("multi", steps, reuse_batch) + sig
+        self._t += steps
+        lrs, wds = self._lrs_wds()
+        params = self._params
+        train_vals = tuple(params[i]._data._data
+                           for i in self._train_idx)
+        frozen_vals = tuple(params[i]._data._data
+                            for i in entry["frozen_idx"])
+        keys = jax.vmap(jax.random.key_data)(
+            jax.random.split(key, steps))
+        lrs, wds, keys = self._commit_small(lrs, wds, keys)
         multi = self._compiled.get(msig)
         if multi is None:
             raw_step = entry["raw_step"]
@@ -572,16 +862,16 @@ class TrainStep:
 
             donate = (0, 1, 2) if self.donate else ()
             multi = jax.jit(multi_fn, donate_argnums=donate)
+            if (self.param_spec_fn is None and
+                    (self.mesh is None
+                     or not _mesh_is_multiprocess(self.mesh))):
+                # AOT (as in _build): the scanned program's memory
+                # stats are what bench.py's hbm_peak reports
+                multi = multi.lower(
+                    train_vals, frozen_vals, self._opt_state, keys,
+                    lrs, wds, xs, ys).compile()
+                self._last_mem = _mem_stats(multi)
             self._compiled[msig] = multi
-        self._t += steps
-        lrs, wds = self._lrs_wds()
-        params = self._params
-        train_vals = tuple(params[i]._data._data
-                           for i in self._train_idx)
-        frozen_vals = tuple(params[i]._data._data
-                            for i in entry["frozen_idx"])
-        keys = jax.vmap(jax.random.key_data)(
-            jax.random.split(key, steps))
         losses, tv, frozen, st = multi(
             train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
             xs, ys)
@@ -603,45 +893,145 @@ class TrainStep:
         XLA, so on TPU the count is a floor; the CPU lowering runs the
         lax reference paths and counts everything.  Compiles the
         program if this signature has not stepped yet."""
-        x_raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
-        y_raw = y.data if isinstance(y, NDArray) else jnp.asarray(y)
-        self._collect(x if isinstance(x, NDArray)
-                      else NDArray(x_raw, None, _placed=True))
-        sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
-               str(y_raw.dtype))
+        compiled = self._compiled_for(x, y)
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        return dict(ca)
+
+    def _compiled_for(self, x, y):
+        """The compiled one-step executable for this (x, y) signature
+        (building it if needed).  On the AOT path this is the very
+        executable the step runs; the multi-process jit path lowers a
+        twin for inspection."""
+        x_raw, y_raw, sig = self._prep(x, y)
         key = _rnd._next_key(None)
-        entry = self._compiled.get(sig)
-        if entry is None:
-            entry = self._build(key, x_raw, y_raw)
-            self._compiled[sig] = entry
+        entry = self._entry_for(x_raw, y_raw, sig, key)
+        fn = entry["fn"]
+        if not hasattr(fn, "lower"):  # AOT: already a Compiled
+            return fn
         lrs, wds = self._lrs_wds()
         params = self._params
         train_vals = tuple(params[i]._data._data
                            for i in self._train_idx)
         frozen_vals = tuple(params[i]._data._data
                             for i in entry["frozen_idx"])
-        compiled = entry["fn"].lower(
+        return fn.lower(
             train_vals, frozen_vals, self._opt_state,
             jax.random.key_data(key), lrs, wds, x_raw,
             y_raw).compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        return dict(ca)
+
+    def memory_analysis(self, x, y):
+        """Per-device memory footprint of the one-step compiled
+        program for this batch signature: argument/output/temp/alias
+        bytes from XLA's ``memory_analysis()``, plus ``hbm_peak``
+        (temp + argument) and ``opt_state_bytes`` (bytes of optimizer
+        state resident per device — under ZeRO-1, only the local
+        shard).  Compiles the program if this signature has not
+        stepped yet."""
+        compiled = self._compiled_for(x, y)
+        mem = dict(_mem_stats(compiled) or {})
+        mem["opt_state_bytes"] = self.opt_state_bytes()
+        return mem
+
+    def hlo_text(self, x, y):
+        """Compiled HLO of the one-step program for this batch
+        signature — the artifact the comm-layout regression tests
+        grep (reduce-scatter/all-gather under ZeRO-1, all-reduce on
+        the replicated path)."""
+        return self._compiled_for(x, y).as_text()
+
+    def last_memory_analysis(self):
+        """Memory stats of the most recently compiled program (the
+        one-step executable or the ``run_steps`` scan program) as a
+        dict with ``hbm_peak`` = temp + argument bytes; None if
+        nothing compiled yet or the backend doesn't report."""
+        return self._last_mem
+
+    def opt_state_bytes(self) -> int:
+        """Optimizer-state bytes resident PER DEVICE.  Replicated
+        states count in full; ZeRO-1 sharded states count only the
+        local shard — the dp× saving this mode exists for."""
+        if self._params is None:
+            raise MXNetError(
+                "opt_state_bytes before parameter collection — run a "
+                "step (or _collect) first")
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self._opt_state):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += shards[0].data.nbytes
+            else:
+                total += int(getattr(leaf, "nbytes", 0))
+        return total
 
     # -- checkpoint/resume (SURVEY §5.4: preemption-safe from day one) --
+    def _canonical_state(self):
+        """Optimizer state in the canonical per-parameter layout
+        (train-idx order, LAMB ``t`` a scalar per param).  The
+        replicated path already stores this; ZeRO-1 gathers its
+        bucketed shards and strips the padding — so checkpoints are
+        interchangeable between zero and replicated steps in both
+        directions."""
+        if not self.zero:
+            return self._opt_state
+        per_param: List[Any] = [None] * len(self._train_idx)
+        for b, st in zip(self._zero_buckets, self._opt_state):
+            js, ax = b["jidx"], b["axis"]
+            leaves = []
+            for leaf in st:
+                a = np.asarray(leaf)  # gathers the dp shards
+                axk = ax if a.ndim == len(b["padded_shape"]) else 0
+                orig = b["stacked_shape"][axk]
+                if a.shape[axk] != orig:
+                    sl = [slice(None)] * a.ndim
+                    sl[axk] = slice(0, orig)
+                    a = a[tuple(sl)]
+                leaves.append(a)
+            for pos, j in enumerate(js):
+                per_param[j] = tuple(leaf[pos] for leaf in leaves)
+        return tuple(per_param)
+
+    def _state_from_canonical(self, loaded):
+        """Restack a canonical per-parameter state into ZeRO-1's
+        padded bucket layout, placed shard-per-device."""
+        new_state = []
+        for b, shardings in zip(self._zero_buckets,
+                                self._zero_state_shardings):
+            js, ax = b["jidx"], b["axis"]
+            n_leaves = len(loaded[js[0]])
+            leaves = []
+            for k in range(n_leaves):
+                stk = np.stack([np.asarray(loaded[j][k]) for j in js])
+                axk = ax if stk.ndim == len(b["padded_shape"]) else 0
+                tgt = b["padded_shape"][axk]
+                if stk.shape[axk] != tgt:
+                    widths = [(0, 0)] * stk.ndim
+                    widths[axk] = (0, tgt - stk.shape[axk])
+                    stk = np.pad(stk, widths)
+                leaves.append(jax.device_put(jnp.asarray(stk),
+                                             shardings[k]))
+            new_state.append(tuple(leaves))
+        return tuple(new_state)
+
     def save_states(self, fname: str) -> None:
         """Serialize optimizer state + step counter.  Pair with
-        ``net.save_parameters`` for a full resumable checkpoint."""
+        ``net.save_parameters`` for a full resumable checkpoint.
+        Always writes the canonical per-parameter layout
+        (gather-on-save under ZeRO-1)."""
         import pickle
         if self._params is None:
             raise MXNetError("nothing to save: step never ran")
-        state_np = jax.tree_util.tree_map(np.asarray, self._opt_state)
+        state_np = jax.tree_util.tree_map(np.asarray,
+                                          self._canonical_state())
         with open(fname, "wb") as f:
             pickle.dump({"t": self._t, "opt_state": state_np}, f)
 
     def load_states(self, fname: str, x_example=None) -> None:
         """Restore optimizer state; the step counter resumes bias
-        correction / schedules where they left off."""
+        correction / schedules where they left off.  Checkpoints are
+        canonical per-parameter (see ``save_states``), so a ZeRO-1
+        step reshards on load and a replicated step loads a
+        ZeRO-written file unchanged."""
         import pickle
         with open(fname, "rb") as f:
             data = pickle.load(f)
@@ -653,13 +1043,23 @@ class TrainStep:
             self._collect(x_example if isinstance(x_example, NDArray)
                           else NDArray(jnp.asarray(x_example), None,
                                        _placed=True))
-        self._t = data["t"]
-        loaded = jax.tree_util.tree_map(jnp.asarray, data["opt_state"])
-        cur = jax.tree_util.tree_structure(self._opt_state)
+        loaded = data["opt_state"]
+        cur = jax.tree_util.tree_structure(tuple(
+            jax.eval_shape(
+                self._opt_init,
+                jax.ShapeDtypeStruct(
+                    self._params[i]._data._data.shape,
+                    self._params[i]._data._data.dtype))
+            for i in self._train_idx))
         got = jax.tree_util.tree_structure(loaded)
         if cur != got:
             raise MXNetError(
                 f"optimizer state structure mismatch: {got} vs {cur}")
+        self._t = data["t"]
+        if self.zero:
+            self._opt_state = self._state_from_canonical(loaded)
+            return
+        loaded = jax.tree_util.tree_map(jnp.asarray, loaded)
         if self.mesh is not None:
             loaded = jax.tree_util.tree_map(
                 lambda v: _device_put_global(v, self.mesh, P()),
@@ -695,19 +1095,23 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      mesh: Optional[Mesh] = None, dp_axis: str = "dp",
                      batch_axis: int = 0, param_spec_fn=None,
                      donate: bool = True, compute_dtype=None,
-                     cast_batch: bool = True) -> TrainStep:
+                     cast_batch: bool = True, zero=None) -> TrainStep:
     """Compile net+loss+optimizer into a single SPMD train step.
 
     ``mesh=None`` → single-device executable (still one fused program).
     With a mesh, batches shard over ``dp_axis`` and XLA inserts the
     gradient all-reduce; ``param_spec_fn(param) -> PartitionSpec`` adds
-    tensor-parallel sharding."""
+    tensor-parallel sharding.  On single-process dp meshes the step
+    defaults to ZeRO-1 sharded optimizer states (reduce-scatter +
+    all-gather instead of all-reduce; see :class:`TrainStep`) —
+    ``zero=0`` or ``MXTPU_ZERO=0`` restores the replicated path,
+    ``zero=1`` insists."""
     if not isinstance(optimizer, opt_mod.Optimizer):
         optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
     return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
                      donate=donate, compute_dtype=compute_dtype,
-                     cast_batch=cast_batch)
+                     cast_batch=cast_batch, zero=zero)
 
 
 from .pipeline import (spmd_pipeline, stack_stage_params,  # noqa: E402

@@ -20,6 +20,11 @@ r6 additions, covering the hot-path work this profile motivated:
   build_train_step with MXTPU_BATCHED_OPT=1/0; their difference is
   the shape/dtype-bucketed optimizer saving, and step_batched minus
   ``full`` is the whole optimizer+writeback share.
+- ``step_zero``        — the FULL TrainStep on a dp mesh over every
+  local device (dp = min(8, devices)) with ZeRO-1 sharded optimizer
+  states; vs step_batched this prices the reduce-scatter/all-gather
+  exchange against the dp× opt-state HBM saving.  Skipped on a
+  single-device host.
 - ``--cost``           — also print TrainStep.cost_analysis() FLOPs /
   bytes for the step program (on TPU the Pallas custom calls hide
   their FLOPs; the CPU lowering counts everything — see
@@ -192,12 +197,18 @@ class _env:
                 os.environ[k] = v
 
 
-def measure_train_step(batch, seqlen, batched):
+def measure_train_step(batch, seqlen, batched, zero=None):
     """Full compiled TrainStep (fwd+bwd+optimizer+writeback) per-step
-    ms — the number bench.py's BERT row is made of."""
+    ms — the number bench.py's BERT row is made of.  ``zero=1`` runs
+    it on a dp mesh over every local device with ZeRO-1 sharded
+    optimizer states (bench.py's bert_zero row)."""
     from mxtpu import nd, parallel
     from mxtpu.gluon import loss as gloss
 
+    mesh = None
+    if zero:
+        dp = min(8, jax.device_count())
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:dp]), ("dp",))
     with _env(MXTPU_BATCHED_OPT="1" if batched else "0"):
         net = _build_bert(seqlen)
         net.initialize(init="xavier")
@@ -208,7 +219,8 @@ def measure_train_step(batch, seqlen, batched):
 
         step = parallel.build_train_step(
             net, mlm_loss, "adam", {"learning_rate": 1e-4},
-            compute_dtype="bfloat16", cast_batch=False)
+            compute_dtype="bfloat16", cast_batch=False,
+            mesh=mesh, zero=zero)
         rng = np.random.RandomState(0)
         toks = nd.array(rng.randint(0, 30522, (batch, seqlen))
                         .astype(np.float32))
@@ -224,6 +236,12 @@ def measure_train_step(batch, seqlen, batched):
 
 
 def measure_variant(batch, seqlen, variant):
+    if variant == "step_zero":
+        dp = min(8, jax.device_count())
+        if dp <= 1 or batch % dp:
+            return None  # needs a >1 dp mesh that divides the batch
+        t, _, _ = measure_train_step(batch, seqlen, True, zero=1)
+        return t
     if variant in ("step_batched", "step_perparam"):
         t, _, _ = measure_train_step(batch, seqlen,
                                      variant == "step_batched")
@@ -260,7 +278,7 @@ def measure_variant(batch, seqlen, variant):
 
 VARIANTS = ["full", "attn_core_ablated", "attn_ablated", "ffn_ablated",
             "mlm_ablated", "ln_ablated", "no_dropout", "epilogue_lax",
-            "loop_floor", "step_batched", "step_perparam"]
+            "loop_floor", "step_batched", "step_perparam", "step_zero"]
 
 
 def main():
@@ -276,6 +294,10 @@ def main():
         if only and v not in only:
             continue
         t = measure_variant(batch, seqlen, v)
+        if t is None:
+            print(f"{v:>18}: skipped (needs a >1-device dp mesh that "
+                  f"divides the batch)", flush=True)
+            continue
         tok_s = batch * seqlen / t * 1e3
         delta = f"  (component ~{base - t:6.1f} ms)" \
             if base is not None and not v.startswith("step_") \
