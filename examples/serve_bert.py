@@ -1,0 +1,125 @@
+"""End-to-end ``mxtpu.serving`` demo: export a small BERT, stand up an
+``InferenceServer`` with dynamic batching over two sequence buckets,
+fire concurrent mixed-length requests at it, and print the stats
+snapshot (p50/p95/p99, fill-rate, req/sec).
+
+  python examples/serve_bert.py
+  python examples/serve_bert.py --clients 8 --requests 50 --layers 2
+
+Knobs the serving layer reads from the environment (see README
+"Serving"): MXTPU_SERVING_MAX_BATCH, MXTPU_SERVING_MAX_DELAY_US,
+MXTPU_SERVING_MAX_QUEUE, MXTPU_SERVING_DONATE.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu import nd
+from mxtpu.models.transformer import BERTModel
+from mxtpu.serving import InferenceServer, ModelRunner, ServerBusy
+
+
+def export_model(args, workdir):
+    """Train-side artifact step: build, init, export (the same
+    ``-symbol.json`` + ``.params`` pair ``Module.save_checkpoint``
+    produces)."""
+    net = BERTModel(args.vocab, args.units, 4 * args.units,
+                    args.layers, args.heads, max_length=args.seq_len,
+                    dropout=0.0)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    net(nd.array(rng.randint(0, args.vocab, (1, args.seq_len))
+                 .astype(np.float32)))       # materialize params
+    return net.export(os.path.join(workdir, "bert"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=25,
+                    help="requests per client")
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--units", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=64,
+                    help="largest sequence bucket")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--timeout-s", type=float, default=30.0)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as d:
+        sym_file, param_file = export_model(args, d)
+        print(f"exported: {os.path.basename(sym_file)} + "
+              f"{os.path.basename(param_file)}")
+
+        # serve-side: load the artifacts, pre-compile the bucket
+        # ladder (pow2 batches x two sequence buckets), share ONE
+        # weight upload across every bucket executable
+        runner = ModelRunner.from_export(
+            sym_file, param_file, input_specs={"data": (None,)},
+            seq_buckets=[args.seq_len // 2, args.seq_len],
+            max_batch_size=args.max_batch)
+        t0 = time.perf_counter()
+        runner.warmup()
+        print(f"warmup: compiled {runner.num_compiled()} bucket "
+              f"executables in {time.perf_counter() - t0:.1f}s "
+              f"(weights uploaded once: "
+              f"{runner.weight_bytes() / 2**20:.1f} MB)")
+
+        server = InferenceServer(log_every_s=2.0)
+        server.register("bert", runner, warmup=False)
+
+        rng = np.random.RandomState(1)
+        failures = []
+
+        def client(cid):
+            for _ in range(args.requests):
+                n = int(rng.randint(args.seq_len // 4,
+                                    args.seq_len + 1))
+                toks = rng.randint(0, args.vocab, (n,)) \
+                    .astype(np.float32)
+                try:
+                    req = server.submit("bert", {"data": toks},
+                                        timeout_s=args.timeout_s)
+                    (logits,) = req.result(
+                        timeout=args.timeout_s + 5.0)
+                    assert logits.shape == (n, args.vocab), \
+                        logits.shape
+                except ServerBusy:
+                    failures.append((cid, "busy"))
+                except Exception as e:  # noqa: BLE001
+                    failures.append((cid, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(args.clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        snap = server.stats("bert")
+        server.close()
+        total = args.clients * args.requests
+        print(f"\n{total} requests from {args.clients} concurrent "
+              f"clients in {wall:.2f}s "
+              f"({snap['completed'] / wall:.1f} req/sec end-to-end)")
+        print(json.dumps(snap, indent=2))
+        if failures:
+            print(f"failures: {failures[:10]}")
+            return 1
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

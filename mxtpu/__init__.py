@@ -55,6 +55,7 @@ def __getattr__(name):
         "parallel": ".parallel",
         "kernels": ".kernels",
         "models": ".models",
+        "serving": ".serving",
         "operator": ".operator",
         "rtc": ".rtc",
         "contrib": ".contrib",

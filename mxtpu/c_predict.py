@@ -6,10 +6,18 @@
 :class:`mxtpu.executor.Executor`; data crosses the ABI as raw bytes
 (the C side owns plain ``float*`` buffers, this side wraps/unwraps via
 numpy) so the C library needs no numpy C-API coupling.
+
+Wire dtypes: floating inputs/outputs cross as float32 (the reference
+ABI's format — back-compat), but integer/bool bindings are honoured
+exactly: an input bound int32 (via ``input_dtypes`` or a ``__dtype__``
+var attr) reads its bytes as int32, and integer outputs serialize as
+their own type (``get_output_dtype`` tells the caller which).
+Previously both ends hardcoded ``np.float32``, silently corrupting
+int32 token ids above 2^24.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,11 +51,25 @@ class Predictor:
 
     def __init__(self, symbol_json: str, param_blob: bytes,
                  dev_type: int, dev_id: int,
-                 input_shapes: Dict[str, Tuple[int, ...]]):
+                 input_shapes: Dict[str, Tuple[int, ...]],
+                 input_dtypes: Optional[Dict[str, Any]] = None):
         symbol = sym_mod.load_json(symbol_json)
         params = _params_from_bytes(param_blob)
         self._init_from_parts(symbol, params, dev_type, dev_id,
-                              input_shapes)
+                              input_shapes, input_dtypes)
+
+    @staticmethod
+    def _wire_dtype(bound_dtype) -> np.dtype:
+        """The dtype bytes cross the ABI as, derived from the BOUND
+        array: integer/bool inputs keep their exact type (int32 token
+        ids must not round-trip through float32 — that silently
+        corrupted ids above 2^24); everything floating stays the
+        reference's float32 wire format for ABI back-compat (the C side
+        owns plain ``float*`` buffers)."""
+        dt = np.dtype(bound_dtype)
+        if dt.kind in "iub":
+            return dt
+        return np.dtype(np.float32)
 
     # -- ABI surface ----------------------------------------------------
     def set_input(self, key: str, data: bytes) -> None:
@@ -58,13 +80,14 @@ class Predictor:
                 f"c_predict: {key!r} is not a declared input "
                 f"(inputs: {self._input_names})")
         cur = self._executor.arg_dict[key]
-        arr = np.frombuffer(data, np.float32)
+        wire = self._wire_dtype(cur.dtype)
+        arr = np.frombuffer(data, wire)
         if arr.size != int(np.prod(cur.shape)):
             raise MXNetError(
                 f"c_predict: input {key!r} size {arr.size} != bound "
-                f"shape {tuple(cur.shape)}")
+                f"shape {tuple(cur.shape)} (wire dtype {wire})")
         self._executor.arg_dict[key] = nd.array(
-            arr.reshape(cur.shape))
+            arr.reshape(cur.shape).astype(cur.dtype, copy=False))
 
     def forward(self) -> None:
         self._outputs = self._executor.forward(is_train=False)
@@ -84,8 +107,16 @@ class Predictor:
         if not 0 <= index < len(self._outputs):
             raise MXNetError(f"c_predict: output index {index} out of "
                              f"range ({len(self._outputs)} outputs)")
-        return self._outputs[index].asnumpy() \
-            .astype(np.float32).tobytes()
+        out = self._outputs[index].asnumpy()
+        return out.astype(self._wire_dtype(out.dtype),
+                          copy=False).tobytes()
+
+    def get_output_dtype(self, index: int) -> str:
+        """Wire dtype of ``get_output(index)`` — lets a caller decode
+        non-float32 (e.g. argmax int) outputs correctly."""
+        if not self._outputs:
+            raise MXNetError("c_predict: forward() has not run")
+        return str(self._wire_dtype(self._outputs[index].dtype))
 
 
     def reshape(self, input_shapes: Dict[str, Tuple[int, ...]]
@@ -94,26 +125,40 @@ class Predictor:
         weights (``MXPredReshape``†).  With XLA there is no memory pool
         to re-plan: a rebind (compile-cache hit per shape) is the whole
         story."""
-        symbol, params, dev_type, dev_id = self._parts
+        symbol, params, dev_type, dev_id, input_dtypes = self._parts
         clone = Predictor.__new__(Predictor)
         clone._init_from_parts(symbol, params, dev_type, dev_id,
                                {k: tuple(int(d) for d in v)
-                                for k, v in input_shapes.items()})
+                                for k, v in input_shapes.items()},
+                               input_dtypes)
         return clone
 
     def _init_from_parts(self, symbol, params,
-                         dev_type, dev_id, input_shapes):
+                         dev_type, dev_id, input_shapes,
+                         input_dtypes=None):
         # params may be host numpy (first create) or NDArray (reshape
         # clones): device buffers upload once and are SHARED across
         # reshapes — the reference MXPredReshape's zero-copy contract
         params = {k: v if isinstance(v, NDArray) else nd.array(v)
                   for k, v in params.items()}
-        self._parts = (symbol, params, dev_type, dev_id)
+        # input dtype resolution: explicit input_dtypes beats a
+        # ``__dtype__`` attr on the symbol's var, beats float32 — so
+        # int32 token-id inputs bind (and cross the wire) as int32
+        var_dtypes = symbol.attr_dict()
+        self._input_dtypes = {}
+        for name in input_shapes:
+            dt = (input_dtypes or {}).get(name) \
+                or (var_dtypes.get(name, {}) or {}).get("__dtype__")
+            self._input_dtypes[name] = np.dtype(dt) if dt \
+                else np.dtype(np.float32)
+        self._parts = (symbol, params, dev_type, dev_id,
+                       dict(self._input_dtypes))
         ctx = cpu(dev_id) if dev_type == 1 else gpu(dev_id)
         self._input_names = list(input_shapes)
         args = dict(params)
         for name, shape in input_shapes.items():
-            args[name] = nd.zeros(tuple(int(s) for s in shape))
+            args[name] = nd.zeros(tuple(int(s) for s in shape),
+                                  dtype=self._input_dtypes[name])
         known = set(symbol.list_inputs())
         args = {k: v for k, v in args.items() if k in known}
         missing = known - set(args)

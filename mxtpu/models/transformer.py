@@ -210,20 +210,23 @@ class TransformerModel(HybridBlock):
                                           dropout, remat=remat)
         self.out_proj = nn.Dense(vocab_size, flatten=False)
 
-    def _embed(self, F, tokens, pos_embed, T):
+    def _embed(self, F, tokens, pos_embed):
         x = self.embed(tokens) * float(np.sqrt(self._units))
-        pe = F.slice_axis(pos_embed, axis=0, begin=0, end=T)
-        x = x + F.expand_dims(pe, axis=0)
+        # length-polymorphic position add: slice_like keyed on the
+        # embedded activations instead of a static T makes ONE exported
+        # graph valid for every sequence length <= max_length — what
+        # bucketed serving (mxtpu.serving) relies on
+        pe = F.slice_like(F.expand_dims(pos_embed, axis=0), x,
+                          axes=(1,))
+        x = x + pe
         x = self.embed_ln(x)
         if self.drop is not None:
             x = self.drop(x)
         return x
 
     def hybrid_forward(self, F, src, tgt, pos_embed=None):
-        Ts = src.shape[1] if hasattr(src, "shape") else None
-        Tt = tgt.shape[1] if hasattr(tgt, "shape") else None
-        memory = self.encoder(self._embed(F, src, pos_embed, Ts))
-        dec = self.decoder(self._embed(F, tgt, pos_embed, Tt), memory)
+        memory = self.encoder(self._embed(F, src, pos_embed))
+        dec = self.decoder(self._embed(F, tgt, pos_embed), memory)
         return self.out_proj(dec)
 
 
@@ -250,19 +253,15 @@ class BERTModel(HybridBlock):
 
     def hybrid_forward(self, F, tokens, token_types=None,
                        pos_embed=None):
-        if hasattr(tokens, "shape"):
-            T = tokens.shape[1]
-        else:
-            # symbolic composition: Symbol carries no static shape —
-            # honour a __shape__ attr if the var declares one, else the
-            # graph is built for T == max_length
-            shp = tokens.attr("__shape__") if hasattr(tokens, "attr") \
-                else None
-            T = int(str(shp).strip("()[] ").split(",")[1]) \
-                if shp else None
         x = self.word_embed(tokens)
-        pe = F.slice_axis(pos_embed, axis=0, begin=0, end=T)
-        x = x + F.expand_dims(pe, axis=0)
+        # slice_like (not a static-T slice_axis) keeps the exported
+        # graph valid for ANY sequence length <= max_length: the
+        # position table is sliced against the activations at run/trace
+        # time, which is what lets mxtpu.serving compile one export
+        # into many sequence buckets
+        pe = F.slice_like(F.expand_dims(pos_embed, axis=0), x,
+                          axes=(1,))
+        x = x + pe
         if self.type_embed is not None and token_types is not None:
             x = x + self.type_embed(token_types)
         x = self.embed_ln(x)

@@ -22,7 +22,7 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Event", "Counter", "Marker",
-           "start_jax_trace", "stop_jax_trace"]
+           "record_span", "start_jax_trace", "stop_jax_trace"]
 
 _ACTIVE = False          # fast-path flag read by the op dispatcher
 _PAUSED = False
@@ -94,6 +94,17 @@ def record_op(name: str, ts_us: float, dur_us: float,
     """Called by the eager dispatcher per op when profiling."""
     _record(name, "operator", ts_us, dur_us,
             {"shapes": shapes} if shapes else None)
+
+
+def record_span(name: str, ts_us: float, dur_us: float,
+                cat: str = "subsystem", args: Optional[dict] = None
+                ) -> None:
+    """Public complete-event hook for subsystems that time themselves
+    (``mxtpu.serving`` batch execution, io feeds, …): one chrome-trace
+    "X" event under category ``cat``.  ``ts_us`` must come from
+    ``_now_us()``-compatible time (``time.perf_counter()*1e6``); no-op
+    unless the profiler is running."""
+    _record(name, cat, ts_us, dur_us, args)
 
 
 def dumps(reset: bool = False) -> str:

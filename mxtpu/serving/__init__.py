@@ -1,0 +1,33 @@
+"""mxtpu.serving — dynamic-batching TPU inference serving (ISSUE 4).
+
+The TPU-native equivalent of the reference's C predict API +
+``BucketingModule`` deployment story (SURVEY.md §3), grown into a
+serving layer:
+
+- :class:`ModelRunner` (runner.py): loads ``export``/``save_checkpoint``
+  artifacts, AOT-compiles one donated-buffer XLA executable per
+  (batch, seq) shape bucket; weights upload once and are shared by
+  every bucket (``MXPredReshape``† zero-copy contract).
+- :class:`DynamicBatcher` (batcher.py): bounded queue,
+  ``max_batch_size``/``max_queue_delay_us`` assembly, per-request
+  deadlines, :class:`ServerBusy` backpressure — policy is pure and
+  clock-injected (deterministically testable).
+- :class:`InferenceServer` (server.py): name→version→runner registry,
+  worker threads per model, round-robin across device replicas.
+- :class:`ServingStats` (stats.py): rolling p50/p95/p99, queue depth,
+  batch fill-rate, req/sec; Speedometer-style log line; chrome-trace
+  spans via ``mxtpu.profiler``.
+
+Knobs (also README "Serving"): ``MXTPU_SERVING_MAX_BATCH``,
+``MXTPU_SERVING_MAX_DELAY_US``, ``MXTPU_SERVING_MAX_QUEUE``,
+``MXTPU_SERVING_DONATE``.
+"""
+from .batcher import (Batch, DynamicBatcher, InferenceRequest,
+                      RequestTimeout, ServerBusy)
+from .runner import ModelRunner, batch_ladder
+from .server import InferenceServer
+from .stats import ServingStats
+
+__all__ = ["ModelRunner", "InferenceServer", "DynamicBatcher",
+           "ServingStats", "InferenceRequest", "Batch", "ServerBusy",
+           "RequestTimeout", "batch_ladder"]

@@ -1,0 +1,284 @@
+"""Dynamic micro-batcher (ISSUE 4 tentpole item 2).
+
+A bounded request queue with ``max_batch_size`` / ``max_queue_delay_us``
+batch assembly.  The batching *policy* is pure and clock-injected —
+``submit(..)`` + ``poll(now)`` never touch wall time or threads, so
+unit tests drive it deterministically; the server wraps it in worker
+threads via ``wait_next()``.
+
+Safety contract (acceptance criteria):
+- the queue is bounded: ``submit`` past ``max_queue`` raises
+  :class:`ServerBusy` — load sheds at the edge, memory never grows
+  unboundedly;
+- a request whose deadline passed is failed with
+  :class:`RequestTimeout`, both while queued (dropped at poll) and when
+  its batch finishes late (checked at completion) — a caller that timed
+  out can never read a stale/late result;
+- requests only ever batch with same-``group`` requests (the shape
+  bucket), so pad/scatter cannot mix shapes.
+
+Degradation to batch=1 when traffic is sparse falls out of the flush
+rule: a lone request flushes after ``max_queue_delay_us`` and runs in
+the smallest bucket.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from ..base import MXNetError
+
+__all__ = ["ServerBusy", "RequestTimeout", "InferenceRequest",
+           "Batch", "DynamicBatcher"]
+
+
+class ServerBusy(MXNetError):
+    """Backpressure: the bounded request queue is full."""
+
+
+class RequestTimeout(MXNetError):
+    """The request's deadline expired before a result was available."""
+
+
+class InferenceRequest:
+    """Submit-side future.  ``result()`` blocks for the outcome;
+    completion is one-shot — whichever of {result, timeout, error}
+    lands first wins and later writes are ignored."""
+
+    __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
+                 "_event", "_value", "_error", "t_dequeue", "t_done")
+
+    def __init__(self, payload: Any, group: Any = None,
+                 seq_len: Optional[int] = None,
+                 t_submit: float = 0.0,
+                 deadline: Optional[float] = None):
+        self.payload = payload
+        self.group = group
+        self.seq_len = seq_len
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self.t_dequeue: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    # -- completion (batcher/server side) -------------------------------
+    def _complete(self, value: Any, now: float) -> bool:
+        """Deliver a result — unless the deadline already passed, in
+        which case the caller gets RequestTimeout, never a late
+        payload."""
+        if self._event.is_set():
+            return False
+        if self.deadline is not None and now > self.deadline:
+            return self._fail(RequestTimeout(
+                f"serving: request missed its deadline by "
+                f"{(now - self.deadline) * 1e3:.2f} ms"), now)
+        self._value = value
+        self.t_done = now
+        self._event.set()
+        return True
+
+    def _fail(self, error: BaseException, now: float) -> bool:
+        if self._event.is_set():
+            return False
+        self._error = error
+        self.t_done = now
+        self._event.set()
+        return True
+
+    # -- caller side ----------------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                "serving: result() wait timed out (request still "
+                "in flight)")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_us(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1e6
+
+    @property
+    def queue_us(self) -> Optional[float]:
+        if self.t_dequeue is None:
+            return None
+        return (self.t_dequeue - self.t_submit) * 1e6
+
+
+class Batch:
+    """One assembled micro-batch: same-group requests, FIFO order."""
+
+    __slots__ = ("requests", "group")
+
+    def __init__(self, requests: List[InferenceRequest], group: Any):
+        self.requests = requests
+        self.group = group
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+class DynamicBatcher:
+    """Bounded FIFO + flush policy.
+
+    Flush rule, evaluated against the oldest queued request (per
+    group): dispatch when the group has ``max_batch_size`` requests
+    waiting, OR when the oldest has waited ``max_queue_delay_us``.
+    FIFO head priority keeps tail latency bounded under mixed-shape
+    traffic: the assembled batch is always the one the *oldest*
+    request belongs to.
+    """
+
+    def __init__(self, max_batch_size: int = 32,
+                 max_queue_delay_us: float = 2000.0,
+                 max_queue: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_timeout: Optional[Callable[[int], None]] = None,
+                 on_depth: Optional[Callable[[int], None]] = None):
+        if max_batch_size < 1:
+            raise MXNetError("max_batch_size must be >= 1")
+        self.max_batch_size = int(max_batch_size)
+        self.max_queue_delay_us = float(max_queue_delay_us)
+        self.max_queue = int(max_queue) if max_queue is not None \
+            else 8 * self.max_batch_size
+        self._clock = clock
+        self._queue: List[InferenceRequest] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._on_timeout = on_timeout
+        self._on_depth = on_depth
+        self.peak_depth = 0
+
+    # -- submit side ----------------------------------------------------
+    def submit(self, payload: Any, *, group: Any = None,
+               seq_len: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> InferenceRequest:
+        """Enqueue one request; raises :class:`ServerBusy` when the
+        bounded queue is full (explicit rejection, never unbounded
+        growth)."""
+        now = self._clock()
+        req = InferenceRequest(
+            payload, group=group, seq_len=seq_len, t_submit=now,
+            deadline=None if timeout_s is None else now + timeout_s)
+        with self._cond:
+            if self._closed:
+                raise MXNetError("serving: batcher is closed")
+            if len(self._queue) >= self.max_queue:
+                raise ServerBusy(
+                    f"serving: queue full ({self.max_queue} waiting); "
+                    f"retry with backoff")
+            self._queue.append(req)
+            self._note_depth_locked()
+            self._cond.notify()
+        return req
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def _note_depth_locked(self) -> None:
+        d = len(self._queue)
+        if d > self.peak_depth:
+            self.peak_depth = d
+        if self._on_depth is not None:
+            self._on_depth(d)
+
+    # -- policy (pure, clock-injected) ----------------------------------
+    def _expire_locked(self, now: float) -> None:
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        if not expired:
+            return
+        self._queue = [r for r in self._queue if r not in expired]
+        self._note_depth_locked()
+        for r in expired:
+            r._fail(RequestTimeout(
+                "serving: deadline expired while queued"), now)
+        if self._on_timeout is not None:
+            self._on_timeout(len(expired))
+
+    def _poll_locked(self, now: float) -> Optional[Batch]:
+        self._expire_locked(now)
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        group = [r for r in self._queue if r.group == head.group]
+        full = len(group) >= self.max_batch_size
+        overdue = (now - head.t_submit) * 1e6 >= self.max_queue_delay_us
+        if not (full or overdue):
+            return None
+        take = group[:self.max_batch_size]
+        taken = set(map(id, take))
+        self._queue = [r for r in self._queue if id(r) not in taken]
+        self._note_depth_locked()
+        for r in take:
+            r.t_dequeue = now
+        return Batch(take, head.group)
+
+    def poll(self, now: Optional[float] = None) -> Optional[Batch]:
+        """Non-blocking assembly decision at time ``now`` (defaults to
+        the injected clock).  Returns a Batch when the flush rule fires,
+        else None.  This is the whole policy — tests call it directly
+        with a hand-stepped clock."""
+        with self._cond:
+            return self._poll_locked(
+                self._clock() if now is None else now)
+
+    def _next_event_locked(self, now: float) -> Optional[float]:
+        """Seconds until the next time-driven state change (flush of
+        the current head, or earliest deadline) — how long a worker may
+        sleep without missing a flush."""
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        wake = head.t_submit + self.max_queue_delay_us / 1e6
+        for r in self._queue:
+            if r.deadline is not None and r.deadline < wake:
+                wake = r.deadline
+        return max(0.0, wake - now)
+
+    # -- thread side (server workers) -----------------------------------
+    def wait_next(self, timeout: Optional[float] = None
+                  ) -> Optional[Batch]:
+        """Block until a batch is ready (or ``timeout``).  Used by
+        server worker threads; the policy itself stays in ``poll``."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                now = self._clock()
+                if self._closed:
+                    return None
+                batch = self._poll_locked(now)
+                if batch is not None:
+                    return batch
+                wait = self._next_event_locked(now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None \
+                        else min(wait, remaining)
+                # a flush can only become due by time passing or a new
+                # submit — both bounded by `wait` (None = submit only)
+                self._cond.wait(wait if wait is None or wait > 0
+                                else 1e-4)
+
+    def close(self) -> None:
+        """Fail everything still queued and wake all waiters."""
+        with self._cond:
+            self._closed = True
+            now = self._clock()
+            for r in self._queue:
+                r._fail(MXNetError("serving: batcher closed"), now)
+            self._queue.clear()
+            self._cond.notify_all()

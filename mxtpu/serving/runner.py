@@ -1,0 +1,360 @@
+"""ModelRunner — AOT-compiled bucketed inference executors sharing one
+weight upload (ISSUE 4 tentpole item 1).
+
+The TPU-native analog of the reference's C predict API over per-bucket
+shared-weight executors (``MXPredReshape``† / ``BucketingModule``†,
+SURVEY.md §3): a deployed model (``Module.save_checkpoint`` / gluon
+``export`` artifacts, parsed through the same ``c_predict`` binding
+path) is compiled ONCE PER SHAPE BUCKET — a powers-of-two batch ladder
+crossed with optional sequence-length buckets for token models — into
+XLA executables via ``jax.jit(..).lower(..).compile()``.  Weights are
+uploaded to the device once and the SAME committed buffers feed every
+bucket executable (the ``MXPredReshape`` zero-copy contract, asserted
+by test); input buffers are donated on accelerator backends so the
+padded batch staging buffer is recycled into the executable's
+workspace.
+
+Why buckets instead of dynamic shapes: XLA compiles static shapes.  A
+pow2 batch ladder caps the number of programs at log2(max_batch) per
+sequence bucket while bounding padding waste at <2x in the worst case
+and ~1.3x expected under uniform fill — the same trade the reference's
+``BucketingModule`` made for variable-length RNNs.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import profiler
+from .batcher import InferenceRequest
+
+__all__ = ["ModelRunner", "batch_ladder"]
+
+# Serving kill switches / knobs (README "Serving"): the env defaults
+# feed every ModelRunner/InferenceServer that does not pass explicit
+# values, so a deployment can be retuned without code changes.
+_ENV_MAX_BATCH = "MXTPU_SERVING_MAX_BATCH"
+_ENV_DONATE = "MXTPU_SERVING_DONATE"
+
+
+def batch_ladder(max_batch_size: int) -> Tuple[int, ...]:
+    """Powers-of-two ladder 1,2,4,… capped at ``max_batch_size`` (the
+    cap itself is always a rung so full batches never pad)."""
+    if max_batch_size < 1:
+        raise MXNetError("max_batch_size must be >= 1")
+    rungs = []
+    b = 1
+    while b < max_batch_size:
+        rungs.append(b)
+        b *= 2
+    rungs.append(max_batch_size)
+    return tuple(rungs)
+
+
+class ModelRunner:
+    """Load-once, compile-per-bucket, run-many inference engine.
+
+    Parameters
+    ----------
+    symbol : mxtpu.symbol.Symbol
+        The inference graph (deployment artifact).
+    params : dict name -> numpy/NDArray
+        Trained weights (``arg:``/``aux:`` prefixes already stripped).
+    input_specs : dict name -> per-example shape tuple
+        Shapes EXCLUDE the batch axis.  A ``None`` entry marks the
+        variable (sequence) axis of a token model and requires
+        ``seq_buckets``; e.g. ``{"data": (None,)}`` for token ids.
+    input_dtypes : dict name -> dtype, optional (default float32)
+    seq_buckets : ascending ints, optional
+        Sequence-length rungs for every ``None`` axis.
+    max_batch_size : int, optional (env MXTPU_SERVING_MAX_BATCH, 32)
+    device : jax device, optional — one runner binds ONE device; build
+        one runner per replica for data-parallel serving and let
+        ``InferenceServer`` round-robin across them.
+    pad_value : scalar used for sequence padding (default 0).
+    """
+
+    def __init__(self, symbol, params: Dict[str, Any],
+                 input_specs: Dict[str, Tuple],
+                 input_dtypes: Optional[Dict[str, Any]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_batch_size: Optional[int] = None,
+                 device=None, pad_value: float = 0,
+                 donate: Optional[bool] = None):
+        import jax
+
+        self._symbol = symbol
+        self._input_names = list(input_specs)
+        self._input_specs = {k: tuple(v) for k, v in input_specs.items()}
+        self._input_dtypes = {
+            k: np.dtype((input_dtypes or {}).get(k, np.float32))
+            for k in input_specs}
+        self.max_batch_size = int(
+            max_batch_size if max_batch_size is not None
+            else os.environ.get(_ENV_MAX_BATCH, "32"))
+        self.batch_buckets = batch_ladder(self.max_batch_size)
+        self.seq_buckets = tuple(sorted(int(s) for s in seq_buckets)) \
+            if seq_buckets else None
+        has_var = any(None in spec for spec in self._input_specs.values())
+        if has_var and not self.seq_buckets:
+            raise MXNetError(
+                "serving: input_specs contain a variable (None) axis — "
+                "pass seq_buckets")
+        self._pad_value = pad_value
+        self._device = device if device is not None else jax.devices()[0]
+        if donate is None:
+            donate = os.environ.get(_ENV_DONATE, "1") == "1" and \
+                jax.default_backend() != "cpu"  # cpu: donation is a no-op
+        self._donate = bool(donate)
+
+        # -- one weight upload, shared by every bucket executable ------
+        known = set(symbol.list_inputs())
+        self._param_names = tuple(
+            n for n in params if n in known and n not in input_specs)
+        missing = known - set(self._param_names) - set(input_specs)
+        if missing:
+            raise MXNetError(
+                f"serving: graph inputs {sorted(missing)} have neither "
+                f"a param nor an input_spec")
+        self._param_vals = tuple(
+            jax.device_put(self._as_np(params[n]), self._device)
+            for n in self._param_names)
+        # lowering must pin THIS replica's device, or every runner
+        # would compile (and expect buffers) on jax.devices()[0]
+        self._sharding = jax.sharding.SingleDeviceSharding(self._device)
+        self._param_structs = tuple(
+            jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                 sharding=self._sharding)
+            for v in self._param_vals)
+
+        self._entries: Dict[Tuple, Any] = {}   # bucket -> executable
+        self.compile_seconds: Dict[Tuple, float] = {}
+
+    @staticmethod
+    def _as_np(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    # -- deployment-artifact constructors -------------------------------
+    @classmethod
+    def from_export(cls, symbol_file: str, params_file: str, **kwargs
+                    ) -> "ModelRunner":
+        """Load gluon ``HybridBlock.export`` / ``Module.save_checkpoint``
+        artifacts (``-symbol.json`` + ``-NNNN.params``), parsing the
+        params blob through the c_predict binding path."""
+        from .. import symbol as sym_mod
+        from ..c_predict import _params_from_bytes
+        with open(symbol_file) as f:
+            symbol = sym_mod.load_json(f.read())
+        with open(params_file, "rb") as f:
+            params = _params_from_bytes(f.read())
+        return cls(symbol, params, **kwargs)
+
+    @classmethod
+    def from_checkpoint(cls, prefix: str, epoch: int, **kwargs
+                        ) -> "ModelRunner":
+        """``prefix-symbol.json`` + ``prefix-{epoch:04d}.params``."""
+        return cls.from_export(f"{prefix}-symbol.json",
+                               f"{prefix}-{epoch:04d}.params", **kwargs)
+
+    # -- buckets ---------------------------------------------------------
+    def bucket_for(self, n: int, seq_len: Optional[int] = None) -> Tuple:
+        """Smallest (batch_bucket, seq_bucket) ladder rung covering a
+        batch of ``n`` examples of length ``seq_len``."""
+        if n < 1:
+            raise MXNetError("serving: empty batch")
+        if n > self.max_batch_size:
+            raise MXNetError(
+                f"serving: batch {n} exceeds max_batch_size "
+                f"{self.max_batch_size}")
+        b = next(r for r in self.batch_buckets if r >= n)
+        if self.seq_buckets is None:
+            return (b, None)
+        if seq_len is None:
+            raise MXNetError("serving: token model needs seq_len")
+        if seq_len > self.seq_buckets[-1]:
+            raise MXNetError(
+                f"serving: seq_len {seq_len} exceeds largest bucket "
+                f"{self.seq_buckets[-1]}")
+        s = next(r for r in self.seq_buckets if r >= seq_len)
+        return (b, s)
+
+    def seq_bucket_for(self, seq_len: Optional[int]) -> Optional[int]:
+        """The batcher's grouping key: requests sharing a seq bucket
+        may batch together; batch-size bucketing happens at dispatch."""
+        if self.seq_buckets is None:
+            return None
+        return self.bucket_for(1, seq_len)[1]
+
+    def buckets(self) -> List[Tuple]:
+        """The full ladder (what ``warmup()`` compiles)."""
+        seqs = self.seq_buckets or (None,)
+        return [(b, s) for s in seqs for b in self.batch_buckets]
+
+    def _concrete_shape(self, name: str, batch: int,
+                        seq: Optional[int]) -> Tuple[int, ...]:
+        return (batch,) + tuple(seq if d is None else int(d)
+                                for d in self._input_specs[name])
+
+    # -- AOT compile ------------------------------------------------------
+    def _pure_fn(self):
+        """Pure (traceable) interpretation of the symbol: (input_vals,
+        param_vals) -> tuple of raw outputs, inference mode (no
+        recording, training=False — dropout is identity)."""
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+        from ..symbol import _eval_symbol
+        sym = self._symbol
+        in_names = tuple(self._input_names)
+        p_names = self._param_names
+
+        def fn(input_vals, param_vals):
+            bindings = {}
+            for n, v in zip(in_names, input_vals):
+                bindings[n] = NDArray(v, None, _placed=True)
+            for n, v in zip(p_names, param_vals):
+                bindings[n] = NDArray(v, None, _placed=True)
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(False)
+            try:
+                outs = _eval_symbol(sym, bindings)
+            finally:
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+            return tuple(o.data for o in outs)
+
+        return fn
+
+    def _entry(self, bucket: Tuple):
+        """Compile (once) and return the bucket's XLA executable."""
+        entry = self._entries.get(bucket)
+        if entry is not None:
+            return entry
+        import jax
+        batch, seq = bucket
+        in_structs = tuple(
+            jax.ShapeDtypeStruct(self._concrete_shape(n, batch, seq),
+                                 self._input_dtypes[n],
+                                 sharding=self._sharding)
+            for n in self._input_names)
+        t0 = time.perf_counter()
+        with profiler.Task(f"serving:compile:b{batch}"
+                           f"{'' if seq is None else f's{seq}'}"):
+            jitted = jax.jit(
+                self._pure_fn(),
+                donate_argnums=(0,) if self._donate else ())
+            compiled = jitted.lower(in_structs,
+                                    self._param_structs).compile()
+        self.compile_seconds[bucket] = time.perf_counter() - t0
+        entry = {"compiled": compiled, "in_structs": in_structs}
+        self._entries[bucket] = entry
+        return entry
+
+    def warmup(self, buckets: Optional[Sequence[Tuple]] = None
+               ) -> Dict[Tuple, float]:
+        """Pre-compile the ladder (or a subset) so no production request
+        pays a compile; returns per-bucket compile seconds."""
+        for bucket in (buckets if buckets is not None
+                       else self.buckets()):
+            self._entry(tuple(bucket))
+        return dict(self.compile_seconds)
+
+    # -- execution --------------------------------------------------------
+    def _pad_stack(self, rows: List[Dict[str, np.ndarray]],
+                   bucket: Tuple) -> Tuple:
+        """Per-example input dicts -> padded device-ready arrays of the
+        bucket's shape.  Batch padding repeats row 0 (keeps values in
+        the embedding/index domain — zeros could be out-of-vocab for
+        some models, row 0 never is); sequence padding uses
+        ``pad_value``."""
+        import jax
+        batch, seq = bucket
+        vals = []
+        for name in self._input_names:
+            shape = self._concrete_shape(name, batch, seq)
+            dt = self._input_dtypes[name]
+            buf = np.empty(shape, dt)
+            for i, row in enumerate(rows):
+                ex = np.asarray(row[name], dt)
+                if ex.shape != shape[1:]:
+                    # sequence-pad every None axis up to the bucket
+                    pads, slices = [], []
+                    for d, (want, got) in enumerate(
+                            zip(shape[1:], ex.shape)):
+                        if got > want:
+                            raise MXNetError(
+                                f"serving: input {name!r} axis {d} size "
+                                f"{got} exceeds bucket {want}")
+                        pads.append((0, want - got))
+                        slices.append(slice(0, got))
+                    ex = np.pad(ex, pads, constant_values=self._pad_value)
+                buf[i] = ex
+            if len(rows) < batch:
+                buf[len(rows):] = buf[0]
+            vals.append(jax.device_put(buf, self._device))
+        return tuple(vals)
+
+    def run_raw(self, input_vals: Tuple, bucket: Tuple) -> Tuple:
+        """One executable dispatch on pre-padded device arrays — the
+        back-to-back path bench.py measures batcher overhead against."""
+        return self._entry(bucket)["compiled"](input_vals,
+                                               self._param_vals)
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              seq_len: Optional[int] = None) -> List[np.ndarray]:
+        """Synchronous batched inference: ``inputs`` carry a leading
+        batch axis; pads to the covering bucket, runs, slices back.
+        Returns host numpy arrays (one per graph output)."""
+        names = self._input_names
+        n = int(np.asarray(inputs[names[0]]).shape[0])
+        if seq_len is None and self.seq_buckets is not None:
+            seq_len = int(np.asarray(inputs[names[0]]).shape[1])
+        bucket = self.bucket_for(n, seq_len)
+        rows = [{name: np.asarray(inputs[name])[i] for name in names}
+                for i in range(n)]
+        vals = self._pad_stack(rows, bucket)
+        outs = self.run_raw(vals, bucket)
+        return [np.asarray(o)[:n] for o in outs]
+
+    def run_requests(self, requests: List[InferenceRequest],
+                     now: Optional[float] = None) -> Tuple:
+        """Server path: execute one assembled same-group batch and
+        scatter each request its OWN output rows (sequence axis trimmed
+        back to the request's true length).  Returns (bucket, outputs)
+        for stats."""
+        n = len(requests)
+        seq = requests[0].group if self.seq_buckets is not None else None
+        bucket = self.bucket_for(n, seq)
+        vals = self._pad_stack([r.payload for r in requests], bucket)
+        outs = self.run_raw(vals, bucket)
+        host = [np.asarray(o) for o in outs]
+        done_t = time.monotonic() if now is None else now
+        for i, r in enumerate(requests):
+            row_outs = []
+            for o in host:
+                row = o[i]
+                # un-pad the sequence axis (axis 0 of the per-example
+                # view) when this output still carries the bucket length
+                if (seq is not None and r.seq_len is not None
+                        and row.ndim >= 1 and row.shape[0] == seq
+                        and r.seq_len < seq):
+                    row = row[:r.seq_len]
+                row_outs.append(row)
+            r._complete(row_outs, done_t)
+        return bucket, host
+
+    # -- introspection ----------------------------------------------------
+    def num_compiled(self) -> int:
+        return len(self._entries)
+
+    def weight_buffers(self) -> Tuple:
+        """The committed device arrays every bucket executable reads —
+        tests assert these stay the SAME buffers across buckets (the
+        MXPredReshape zero-copy contract)."""
+        return self._param_vals
+
+    def weight_bytes(self) -> int:
+        return int(sum(v.nbytes for v in self._param_vals))

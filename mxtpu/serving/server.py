@@ -1,0 +1,272 @@
+"""InferenceServer — multi-model serving front end (ISSUE 4 tentpole
+item 3).
+
+A name → version → :class:`ModelRunner` registry; each registered
+(model, version) endpoint owns one :class:`DynamicBatcher`, one
+:class:`ServingStats`, and a pool of worker threads that assemble
+micro-batches and dispatch them ROUND-ROBIN across the endpoint's
+data-parallel device replicas (one ModelRunner per device — weights
+are uploaded once per replica, buckets share them, see runner.py).
+
+Every executed batch emits a chrome-trace span through
+``mxtpu.profiler.record_span`` (cat ``serving``) so serving traffic
+lines up with training ops in trace dumps, and feeds the endpoint's
+Speedometer-style periodic log line.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import profiler
+from .batcher import DynamicBatcher, InferenceRequest
+from .runner import ModelRunner
+from .stats import ServingStats
+
+__all__ = ["InferenceServer"]
+
+_ENV_MAX_DELAY = "MXTPU_SERVING_MAX_DELAY_US"
+_ENV_MAX_QUEUE = "MXTPU_SERVING_MAX_QUEUE"
+
+
+class _Endpoint:
+    """One (model, version): runners + batcher + stats + workers."""
+
+    def __init__(self, name: str, version: int,
+                 runners: List[ModelRunner],
+                 max_queue_delay_us: float, max_queue: Optional[int],
+                 log_every_s: float):
+        self.name = name
+        self.version = version
+        self.runners = runners
+        r0 = runners[0]
+        for r in runners[1:]:
+            if r.max_batch_size != r0.max_batch_size or \
+                    r.seq_buckets != r0.seq_buckets:
+                raise MXNetError(
+                    "serving: replica runners must share the bucket "
+                    "ladder (max_batch_size/seq_buckets)")
+        self.stats = ServingStats(name=f"{name}:v{version}",
+                                  log_every_s=log_every_s)
+        self.batcher = DynamicBatcher(
+            max_batch_size=r0.max_batch_size,
+            max_queue_delay_us=max_queue_delay_us,
+            max_queue=max_queue,
+            on_timeout=self.stats.record_timeout,
+            on_depth=self.stats.record_queue_depth)
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self.dispatched: Dict[int, int] = {i: 0
+                                           for i in range(len(runners))}
+        self._stop = threading.Event()
+        self.threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"mxtpu-serve-{name}-v{version}-{i}")
+            for i in range(len(runners))]
+
+    def start(self) -> None:
+        for t in self.threads:
+            t.start()
+
+    def _next_runner(self) -> int:
+        with self._rr_lock:
+            i = self._rr % len(self.runners)
+            self._rr += 1
+            self.dispatched[i] += 1
+            return i
+
+    def _work(self) -> None:
+        while not self._stop.is_set():
+            batch = self.batcher.wait_next(timeout=0.1)
+            if batch is None:
+                continue
+            idx = self._next_runner()
+            runner = self.runners[idx]
+            t0 = profiler._now_us()
+            try:
+                bucket, _ = runner.run_requests(batch.requests)
+            except Exception as e:  # noqa: BLE001 — fail the batch,
+                now = time.monotonic()  # never kill the worker
+                for r in batch.requests:
+                    r._fail(MXNetError(
+                        f"serving: batch execution failed: {e}"), now)
+                continue
+            dur = profiler._now_us() - t0
+            profiler.record_span(
+                f"serve/{self.name}:v{self.version}", t0, dur,
+                cat="serving",
+                args={"batch": len(batch.requests),
+                      "bucket": list(bucket), "replica": idx})
+            self.stats.record_batch(len(batch.requests), bucket[0])
+            for r in batch.requests:
+                if r.latency_us is not None:
+                    self.stats.record_completion(
+                        r.latency_us, r.queue_us or 0.0)
+            self.stats.maybe_log()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.batcher.close()
+        for t in self.threads:
+            t.join(timeout=2.0)
+
+
+class InferenceServer:
+    """Multi-model dynamic-batching front end.
+
+    >>> server = InferenceServer()
+    >>> server.register("bert", runner)           # version 1
+    >>> out = server.infer("bert", {"data": toks}, seq_len=40)
+    >>> server.stats("bert")["latency_ms"]["p99"]
+    """
+
+    def __init__(self, log_every_s: float = 10.0):
+        self._endpoints: Dict[str, Dict[int, _Endpoint]] = {}
+        self._lock = threading.Lock()
+        self._log_every_s = log_every_s
+        self._closed = False
+
+    # -- registry ---------------------------------------------------------
+    def register(self, name: str,
+                 runners: Union[ModelRunner, Sequence[ModelRunner]],
+                 version: int = 1,
+                 max_queue_delay_us: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 warmup: bool = False) -> None:
+        """Attach a model version.  ``runners`` may be a single
+        ModelRunner or one per device replica (round-robin dispatch).
+        ``warmup=True`` pre-compiles every replica's bucket ladder
+        before the endpoint accepts traffic."""
+        if isinstance(runners, ModelRunner):
+            runners = [runners]
+        runners = list(runners)
+        if not runners:
+            raise MXNetError("serving: register needs >= 1 runner")
+        if max_queue_delay_us is None:
+            max_queue_delay_us = float(
+                os.environ.get(_ENV_MAX_DELAY, "2000"))
+        if max_queue is None and _ENV_MAX_QUEUE in os.environ:
+            max_queue = int(os.environ[_ENV_MAX_QUEUE])
+        if warmup:
+            for r in runners:
+                r.warmup()
+        ep = _Endpoint(name, version, runners, max_queue_delay_us,
+                       max_queue, self._log_every_s)
+        with self._lock:
+            if self._closed:
+                raise MXNetError("serving: server is closed")
+            if version in self._endpoints.get(name, {}):
+                raise MXNetError(
+                    f"serving: {name!r} v{version} already registered")
+            self._endpoints.setdefault(name, {})[version] = ep
+        ep.start()
+
+    def unregister(self, name: str,
+                   version: Optional[int] = None) -> None:
+        with self._lock:
+            versions = self._endpoints.get(name)
+            if not versions:
+                raise MXNetError(f"serving: unknown model {name!r}")
+            drop = list(versions) if version is None else [version]
+            eps = []
+            for v in drop:
+                if v not in versions:
+                    raise MXNetError(
+                        f"serving: {name!r} has no version {v}")
+                eps.append(versions.pop(v))
+            if not versions:
+                del self._endpoints[name]
+        for ep in eps:
+            ep.stop()
+
+    def models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {n: sorted(vs) for n, vs in self._endpoints.items()}
+
+    def _endpoint(self, name: str,
+                  version: Optional[int]) -> _Endpoint:
+        with self._lock:
+            versions = self._endpoints.get(name)
+            if not versions:
+                raise MXNetError(f"serving: unknown model {name!r}")
+            if version is None:
+                version = max(versions)   # latest by default
+            ep = versions.get(version)
+            if ep is None:
+                raise MXNetError(
+                    f"serving: {name!r} has no version {version} "
+                    f"(have {sorted(versions)})")
+            return ep
+
+    # -- request path -----------------------------------------------------
+    def submit(self, name: str, inputs: Dict[str, np.ndarray],
+               seq_len: Optional[int] = None,
+               version: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> InferenceRequest:
+        """Async single-example submit: ``inputs`` are ONE example (no
+        batch axis).  Returns a future; raises ServerBusy under
+        backpressure.  ``timeout_s`` is the request deadline — expiry
+        yields RequestTimeout, never a stale result."""
+        ep = self._endpoint(name, version)
+        r0 = ep.runners[0]
+        if seq_len is None and r0.seq_buckets is not None:
+            first = np.asarray(inputs[next(iter(r0._input_specs))])
+            seq_len = int(first.shape[0])
+        group = r0.seq_bucket_for(seq_len)
+        try:
+            return ep.batcher.submit(inputs, group=group,
+                                     seq_len=seq_len,
+                                     timeout_s=timeout_s)
+        except Exception:
+            ep.stats.record_rejected()
+            raise
+
+    def infer(self, name: str, inputs: Dict[str, np.ndarray],
+              seq_len: Optional[int] = None,
+              version: Optional[int] = None,
+              timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking convenience wrapper over ``submit``."""
+        req = self.submit(name, inputs, seq_len=seq_len,
+                          version=version, timeout_s=timeout_s)
+        # +grace so the batcher's own deadline machinery (not the
+        # caller-side wait) decides timeout in the normal case
+        return req.result(timeout=None if timeout_s is None
+                          else timeout_s + 5.0)
+
+    # -- observability ----------------------------------------------------
+    def stats(self, name: Optional[str] = None,
+              version: Optional[int] = None) -> Dict:
+        """Stats snapshot: one endpoint when ``name`` is given, else
+        ``{name: {version: snapshot}}`` for the whole registry."""
+        if name is not None:
+            ep = self._endpoint(name, version)
+            snap = ep.stats.snapshot()
+            snap["replicas"] = len(ep.runners)
+            snap["dispatched_per_replica"] = dict(ep.dispatched)
+            snap["compiled_buckets"] = [r.num_compiled()
+                                        for r in ep.runners]
+            return snap
+        with self._lock:
+            items = [(n, v) for n, vs in self._endpoints.items()
+                     for v in vs]
+        return {f"{n}:v{v}": self.stats(n, v) for n, v in items}
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            eps = [ep for vs in self._endpoints.values()
+                   for ep in vs.values()]
+            self._endpoints.clear()
+        for ep in eps:
+            ep.stop()
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

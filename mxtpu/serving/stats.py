@@ -1,0 +1,163 @@
+"""Serving observability — rolling latency percentiles, queue depth,
+batch fill-rate and request rate, exposed three ways (ISSUE 4 tentpole
+item 4): a ``stats()`` snapshot dict, a Speedometer-style periodic log
+line (SURVEY.md §5.5 — the reference's ``mx.callback.Speedometer``
+printed samples/sec every N batches; here req/sec + percentiles every
+``log_every_s`` seconds of traffic), and chrome-trace spans emitted
+through :func:`mxtpu.profiler.record_span` by the server worker so
+serving batches show up next to training ops in trace dumps.
+
+Everything is O(1) per event under one lock: percentiles come from a
+bounded ring of recent latencies (default 2048 — at serving rates this
+is seconds of traffic, enough for a rolling p99 without unbounded
+growth), rates from a deque of completion timestamps.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+__all__ = ["ServingStats"]
+
+logger = logging.getLogger("mxtpu.serving")
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingStats:
+    """Per-endpoint rolling counters.  One instance per registered
+    (model, version); the server updates it from its worker threads,
+    ``snapshot()`` is safe from any thread."""
+
+    def __init__(self, name: str = "", window: int = 2048,
+                 rate_window_s: float = 30.0,
+                 log_every_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._lat_us = deque(maxlen=window)     # completed-request latency
+        self._queue_us = deque(maxlen=window)   # time spent queued
+        self._done_ts = deque()                 # completion stamps (rate)
+        self._rate_window_s = rate_window_s
+        self._log_every_s = log_every_s
+        self._last_log = clock()
+        # monotonically increasing totals
+        self.completed = 0
+        self.timed_out = 0
+        self.rejected = 0
+        self.batches = 0
+        self.padded_slots = 0    # bucket capacity minus real requests
+        self.batched_requests = 0
+        self.queue_depth = 0
+        self.peak_queue_depth = 0
+
+    # -- event hooks (called by batcher/server) -------------------------
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            if depth > self.peak_queue_depth:
+                self.peak_queue_depth = depth
+
+    def record_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self.rejected += n
+
+    def record_timeout(self, n: int = 1) -> None:
+        with self._lock:
+            self.timed_out += n
+
+    def record_batch(self, n_real: int, capacity: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += n_real
+            self.padded_slots += max(0, capacity - n_real)
+
+    def record_completion(self, latency_us: float,
+                          queue_us: float = 0.0) -> None:
+        now = self._clock()
+        with self._lock:
+            self.completed += 1
+            self._lat_us.append(latency_us)
+            self._queue_us.append(queue_us)
+            self._done_ts.append(now)
+            horizon = now - self._rate_window_s
+            while self._done_ts and self._done_ts[0] < horizon:
+                self._done_ts.popleft()
+
+    # -- views ----------------------------------------------------------
+    def requests_per_sec(self) -> float:
+        with self._lock:
+            return self._rps_locked(self._clock())
+
+    def _rps_locked(self, now: float) -> float:
+        if not self._done_ts:
+            return 0.0
+        span = max(now - self._done_ts[0], 1e-6)
+        return len(self._done_ts) / span
+
+    def snapshot(self) -> Dict:
+        """One coherent stats dict (the ``stats()`` surface of the
+        serving layer)."""
+        with self._lock:
+            lat = sorted(self._lat_us)
+            queued = sorted(self._queue_us)
+            cap = self.batched_requests + self.padded_slots
+            return {
+                "completed": self.completed,
+                "timed_out": self.timed_out,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "requests_per_sec": round(
+                    self._rps_locked(self._clock()), 2),
+                "latency_ms": {
+                    "p50": round(_percentile(lat, 50) / 1e3, 3),
+                    "p95": round(_percentile(lat, 95) / 1e3, 3),
+                    "p99": round(_percentile(lat, 99) / 1e3, 3),
+                    "n": len(lat),
+                },
+                "queue_ms": {
+                    "p50": round(_percentile(queued, 50) / 1e3, 3),
+                    "p99": round(_percentile(queued, 99) / 1e3, 3),
+                },
+                "batch_fill_rate": round(
+                    self.batched_requests / cap, 4) if cap else None,
+                "mean_batch_size": round(
+                    self.batched_requests / self.batches, 2)
+                if self.batches else None,
+                "queue_depth": self.queue_depth,
+                "peak_queue_depth": self.peak_queue_depth,
+            }
+
+    def maybe_log(self) -> Optional[str]:
+        """Speedometer-style throttled log line — call after each batch;
+        emits at most once per ``log_every_s``.  Returns the line when
+        one was emitted (tests hook this)."""
+        now = self._clock()
+        with self._lock:
+            if now - self._last_log < self._log_every_s:
+                return None
+            self._last_log = now
+            lat = sorted(self._lat_us)
+            cap = self.batched_requests + self.padded_slots
+            line = (f"Serving [{self.name}] "
+                    f"{self._rps_locked(now):.1f} req/sec\t"
+                    f"p50={_percentile(lat, 50) / 1e3:.2f}ms "
+                    f"p95={_percentile(lat, 95) / 1e3:.2f}ms "
+                    f"p99={_percentile(lat, 99) / 1e3:.2f}ms\t"
+                    f"fill={self.batched_requests / cap if cap else 0.0:.2f} "
+                    f"queue={self.queue_depth} "
+                    f"(peak {self.peak_queue_depth}) "
+                    f"timeout={self.timed_out} busy={self.rejected}")
+        logger.info(line)
+        return line

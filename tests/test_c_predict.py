@@ -132,6 +132,99 @@ def test_predictor_semantics(model):
     np.testing.assert_allclose(got, y0, rtol=1e-5, atol=1e-5)
 
 
+def test_reshape_shares_device_buffers(model):
+    """MXPredReshape zero-copy contract: a reshape clone binds the SAME
+    weight NDArrays (same underlying device buffers — no second
+    upload), and its outputs match a fresh bind at the new shape."""
+    from mxtpu.c_predict import Predictor
+    sym_file, param_file, x, y0 = model
+    with open(sym_file) as f:
+        sym_json = f.read()
+    with open(param_file, "rb") as f:
+        params = f.read()
+    p = Predictor(sym_json, params, 1, 0, {"data": x.shape})
+    clone = p.reshape({"data": (5, x.shape[1])})
+
+    weight_names = [k for k in p._executor.arg_dict
+                    if k not in p._input_names]
+    assert weight_names
+    for k in weight_names:
+        a, b = p._executor.arg_dict[k], clone._executor.arg_dict[k]
+        assert a is b                 # same NDArray object...
+        assert a.data is b.data       # ...wrapping the same jax buffer
+
+    x5 = np.random.RandomState(1).randn(5, x.shape[1]) \
+        .astype(np.float32)
+    clone.set_input("data", x5.tobytes())
+    clone.forward()
+    got = np.frombuffer(clone.get_output(0), np.float32) \
+        .reshape(clone.get_output_shape(0))
+    fresh = Predictor(sym_json, params, 1, 0, {"data": x5.shape})
+    fresh.set_input("data", x5.tobytes())
+    fresh.forward()
+    want = np.frombuffer(fresh.get_output(0), np.float32) \
+        .reshape(fresh.get_output_shape(0))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    # the original's binding is untouched by the clone
+    p.set_input("data", x.astype(np.float32).tobytes())
+    p.forward()
+    np.testing.assert_allclose(
+        np.frombuffer(p.get_output(0), np.float32).reshape(y0.shape),
+        y0, rtol=1e-5, atol=1e-5)
+
+
+def test_int32_inputs_cross_wire_exactly(tmp_path):
+    """Integer bindings are honoured on the wire: an int32 token-id
+    input reads its bytes as int32 (ids above 2^24 must survive —
+    float32 wire silently corrupted them), and integer outputs declare
+    their dtype via get_output_dtype."""
+    from mxtpu import symbol as sym
+    from mxtpu.c_predict import Predictor
+    big = 2 ** 24 + 3   # not representable in float32
+    data = sym.var("data", dtype="int32")
+    graph = data + data   # stays int32; 2*big still needs > 24 bits
+    ids = np.array([[1, 7, big]], np.int32)
+    pfile = str(tmp_path / "int32.params")
+    nd.save(pfile, {"arg:unused": nd.zeros((1,))})
+    with open(pfile, "rb") as f:
+        blob = f.read()
+
+    p = Predictor(graph.tojson(), blob, 1, 0, {"data": ids.shape})
+    # bound dtype resolved from the var's __dtype__ attr
+    assert p._executor.arg_dict["data"].dtype == np.int32
+    p.set_input("data", ids.tobytes())   # int32 bytes, verbatim
+    p.forward()
+    assert p.get_output_dtype(0) == "int32"
+    got = np.frombuffer(p.get_output(0), np.int32) \
+        .reshape(p.get_output_shape(0))
+    np.testing.assert_array_equal(got, ids * 2)   # exact, no 2^24 loss
+    # explicit input_dtypes wins too, and survives reshape clones
+    p2 = Predictor(graph.tojson(), blob, 1, 0, {"data": ids.shape},
+                   input_dtypes={"data": "int32"})
+    clone = p2.reshape({"data": (1, 2)})
+    assert clone._executor.arg_dict["data"].dtype == np.int32
+    clone.set_input("data", ids[:, :2].tobytes())
+    clone.forward()
+    np.testing.assert_array_equal(
+        np.frombuffer(clone.get_output(0), np.int32),
+        ids.ravel()[:2] * 2)
+
+
+def test_float_outputs_keep_float32_wire(model):
+    """ABI back-compat: floating bindings still cross as float32."""
+    from mxtpu.c_predict import Predictor
+    sym_file, param_file, x, y0 = model
+    with open(sym_file) as f:
+        sym_json = f.read()
+    with open(param_file, "rb") as f:
+        params = f.read()
+    p = Predictor(sym_json, params, 1, 0, {"data": x.shape})
+    p.set_input("data", x.astype(np.float32).tobytes())
+    p.forward()
+    assert p.get_output_dtype(0) == "float32"
+    assert len(p.get_output(0)) == int(np.prod(y0.shape)) * 4
+
+
 def test_compiled_c_program(model, tmp_path):
     """Compile predict_example.c with gcc/g++ and run it as a true
     external C consumer (embedded interpreter boot path)."""

@@ -110,3 +110,18 @@ def test_train_moe_recipe(caplog):
     msgs = [r.message for r in caplog.records]
     assert any("EP sharding verified" in m for m in msgs)
     assert any("parity vs unsharded OK" in m for m in msgs)
+
+
+def test_serve_bert_recipe(capsys):
+    """Serving recipe (ISSUE 4): export → ModelRunner.from_export →
+    InferenceServer → concurrent mixed-length clients → stats."""
+    with pytest.raises(SystemExit) as e:
+        _run("serve_bert.py",
+             ["--clients", "2", "--requests", "5", "--layers", "1",
+              "--units", "64", "--heads", "2", "--seq-len", "32",
+              "--max-batch", "4"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "req/sec end-to-end" in out
+    assert '"completed": 10' in out
+    assert "weights uploaded once" in out

@@ -1,0 +1,481 @@
+"""mxtpu.serving — dynamic-batching inference layer (ISSUE 4).
+
+The batcher tests are fully deterministic: the policy is pure
+(``submit``/``poll``) and driven by an injected clock, so no test here
+depends on wall-clock timing except the server end-to-end ones (which
+assert outcomes, not latencies) and the slow-marked soak.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.base import MXNetError
+from mxtpu.serving import (DynamicBatcher, InferenceServer, ModelRunner,
+                           RequestTimeout, ServerBusy, ServingStats,
+                           batch_ladder)
+
+
+class FakeClock:
+    """Hand-stepped monotonic clock for deterministic batcher tests."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mul_runner(**kwargs):
+    """Per-row-independent graph with one real weight: out = data * w."""
+    data = sym.var("data")
+    w = sym.var("w")
+    graph = data * w
+    return ModelRunner(graph, {"w": np.array([1.0, 2.0, 3.0],
+                                             np.float32)},
+                       {"data": (3,)}, max_batch_size=4, **kwargs)
+
+
+def _token_runner(**kwargs):
+    """Per-token-independent token model: out = data * 3 (padding can
+    only pollute rows/positions scatter must not return)."""
+    graph = sym.var("data") * 3.0
+    return ModelRunner(graph, {}, {"data": (None,)},
+                       seq_buckets=[4, 8], max_batch_size=4, **kwargs)
+
+
+# ---------------------------------------------------------------- buckets
+
+def test_batch_ladder():
+    assert batch_ladder(1) == (1,)
+    assert batch_ladder(8) == (1, 2, 4, 8)
+    assert batch_ladder(6) == (1, 2, 4, 6)   # cap is always a rung
+    with pytest.raises(MXNetError):
+        batch_ladder(0)
+
+
+def test_bucket_selection():
+    r = _token_runner()
+    assert r.bucket_for(3, 2) == (4, 4)
+    assert r.bucket_for(1, 5) == (1, 8)
+    assert r.bucket_for(4, 8) == (4, 8)
+    assert r.seq_bucket_for(3) == 4
+    assert set(r.buckets()) == {(b, s) for b in (1, 2, 4)
+                                for s in (4, 8)}
+    with pytest.raises(MXNetError, match="exceeds max_batch_size"):
+        r.bucket_for(5, 2)
+    with pytest.raises(MXNetError, match="exceeds largest bucket"):
+        r.bucket_for(1, 9)
+    with pytest.raises(MXNetError, match="needs seq_len"):
+        r.bucket_for(1)
+    with pytest.raises(MXNetError, match="pass seq_buckets"):
+        ModelRunner(sym.var("data") * 1.0, {}, {"data": (None,)})
+
+
+# ---------------------------------------------------------------- batcher
+
+def test_batcher_flush_on_full_batch():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=2000,
+                       clock=fc)
+    reqs = [b.submit(i) for i in range(4)]
+    batch = b.poll()            # full → flushes with zero delay
+    assert batch is not None and len(batch) == 4
+    assert [r.payload for r in batch.requests] == [0, 1, 2, 3]  # FIFO
+    assert all(r.t_dequeue == fc.t for r in reqs)
+    assert b.poll() is None     # queue drained
+
+
+def test_batcher_flush_on_delay_and_batch1_degradation():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=2000,
+                       clock=fc)
+    b.submit("lone")
+    assert b.poll() is None                 # not full, not overdue
+    fc.advance(0.0019)
+    assert b.poll() is None                 # still 100us early
+    fc.advance(0.0002)
+    batch = b.poll()                        # overdue → ships alone
+    assert batch is not None and len(batch) == 1
+    assert batch.requests[0].payload == "lone"
+
+
+def test_batcher_groups_never_mix():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=1000,
+                       clock=fc)
+    b.submit("a0", group=4)
+    b.submit("b0", group=8)
+    b.submit("a1", group=4)
+    fc.advance(0.002)
+    first = b.poll()            # head's group (4), in FIFO order
+    assert first.group == 4
+    assert [r.payload for r in first.requests] == ["a0", "a1"]
+    second = b.poll()           # new head (group 8) is overdue too
+    assert second.group == 8
+    assert [r.payload for r in second.requests] == ["b0"]
+
+
+def test_batcher_deadline_expiry_while_queued():
+    fc = FakeClock()
+    expired = []
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=10_000,
+                       clock=fc, on_timeout=expired.append)
+    doomed = b.submit("x", timeout_s=0.001)
+    alive = b.submit("y", timeout_s=10.0)
+    fc.advance(0.002)
+    assert b.poll() is None     # doomed dropped; alive not overdue yet
+    assert doomed.done()
+    with pytest.raises(RequestTimeout):
+        doomed.result(timeout=0)
+    assert expired == [1]
+    fc.advance(0.01)
+    batch = b.poll()
+    assert [r.payload for r in batch.requests] == ["y"]
+    assert alive in batch.requests
+
+
+def test_batcher_late_result_becomes_timeout_not_stale():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=1, max_queue_delay_us=0, clock=fc)
+    req = b.submit("x", timeout_s=0.5)
+    batch = b.poll()
+    assert len(batch) == 1
+    # batch executed, but the result lands after the deadline: the
+    # caller must see RequestTimeout, never the stale payload
+    req._complete("stale", now=fc.t + 1.0)
+    with pytest.raises(RequestTimeout, match="missed its deadline"):
+        req.result(timeout=0)
+    # one-shot: a later write cannot overwrite the outcome
+    assert not req._complete("late again", now=fc.t)
+
+    ok = b.submit("y", timeout_s=0.5)
+    assert ok._complete("fresh", now=fc.t + 0.1)
+    assert ok.result(timeout=0) == "fresh"
+    assert ok.latency_us == pytest.approx(0.1e6)
+
+
+def test_batcher_bounded_queue_server_busy():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=2, max_queue_delay_us=1e6,
+                       max_queue=3, clock=fc)
+    for i in range(3):
+        b.submit(i, group=i)    # distinct groups: nothing flushes
+    with pytest.raises(ServerBusy, match="queue full"):
+        b.submit(99, group=99)
+    assert b.depth == 3 and b.peak_depth == 3
+
+
+def test_batcher_close_fails_queued():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=1e6,
+                       clock=fc)
+    req = b.submit("x")
+    b.close()
+    with pytest.raises(MXNetError, match="closed"):
+        req.result(timeout=0)
+    with pytest.raises(MXNetError, match="closed"):
+        b.submit("y")
+
+
+def test_batcher_wait_next_blocks_until_submit():
+    b = DynamicBatcher(max_batch_size=2, max_queue_delay_us=0)
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(b.wait_next(timeout=5.0)))
+    t.start()
+    b.submit("x")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert got and len(got[0]) == 1
+    assert b.wait_next(timeout=0.01) is None   # empty → timeout → None
+
+
+# ----------------------------------------------------------------- runner
+
+def test_runner_exact_outputs_across_buckets():
+    r = _mul_runner()
+    w = np.array([1.0, 2.0, 3.0], np.float32)
+    rng = np.random.RandomState(0)
+    for n in (1, 3, 4):         # buckets (1,None),(4,None),(4,None)
+        x = rng.randn(n, 3).astype(np.float32)
+        (out,) = r.infer({"data": x})
+        assert out.shape == (n, 3)   # sliced back from the bucket
+        np.testing.assert_allclose(out, x * w, rtol=1e-6, atol=1e-6)
+    assert r.num_compiled() == 2     # (1,) and (4,) — (3→4 shared)
+
+
+def test_runner_weights_uploaded_once_shared_across_buckets():
+    """The MXPredReshape zero-copy contract: one device upload feeds
+    every bucket executable — compiling/warming the whole ladder must
+    not touch or copy the weight buffers."""
+    r = _mul_runner()
+    bufs = r.weight_buffers()
+    assert len(bufs) == 1
+    ptrs = [b.unsafe_buffer_pointer() for b in bufs]
+    r.warmup()                       # compiles the full ladder
+    assert r.num_compiled() == len(r.buckets()) == 3
+    assert all(c > 0 for c in r.compile_seconds.values())
+    x = np.ones((4, 3), np.float32)
+    r.infer({"data": x})
+    r.infer({"data": x[:1]})
+    after = r.weight_buffers()
+    assert all(a is b for a, b in zip(bufs, after))      # same arrays
+    assert [b.unsafe_buffer_pointer() for b in after] == ptrs  # same mem
+
+
+def test_runner_pad_scatter_roundtrip():
+    """Mixed-length requests through pad → run → scatter: every request
+    gets exactly its own rows, trimmed back to its true length."""
+    fc = FakeClock()
+    r = _token_runner()
+    b = DynamicBatcher(max_batch_size=4, max_queue_delay_us=0, clock=fc)
+    lens = [2, 3, 4]
+    rows = [np.arange(10 * i, 10 * i + n).astype(np.float32)
+            for i, n in enumerate(lens)]
+    reqs = [b.submit({"data": row}, group=r.seq_bucket_for(n),
+                     seq_len=n)
+            for row, n in zip(rows, lens)]
+    bucket, _ = r.run_requests(b.poll().requests, now=fc.t)
+    assert bucket == (4, 4)
+    for req, row, n in zip(reqs, rows, lens):
+        (out,) = req.result(timeout=0)
+        assert out.shape == (n,)            # padded tail trimmed
+        np.testing.assert_allclose(out, row * 3.0, rtol=1e-6)
+    # second group: longer sequences land in the (·, 8) bucket
+    long_row = np.arange(7).astype(np.float32)
+    req = b.submit({"data": long_row}, group=r.seq_bucket_for(7),
+                   seq_len=7)
+    bucket, _ = r.run_requests(b.poll().requests, now=fc.t)
+    assert bucket == (1, 8)
+    np.testing.assert_allclose(req.result(timeout=0)[0], long_row * 3.0,
+                               rtol=1e-6)
+    with pytest.raises(MXNetError, match="exceeds bucket"):
+        r._pad_stack([{"data": np.zeros(9, np.float32)}], (1, 8))
+
+
+def test_runner_export_artifacts_roundtrip(tmp_path):
+    """from_export loads gluon export artifacts through the c_predict
+    params path and matches the in-process net."""
+    from mxtpu import nd
+    from mxtpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(init="xavier")
+    x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    y0 = net(nd.array(x)).asnumpy()
+    sym_file, param_file = net.export(str(tmp_path / "m"))
+    r = ModelRunner.from_export(sym_file, param_file,
+                                input_specs={"data": (5,)},
+                                max_batch_size=4)
+    (out,) = r.infer({"data": x})
+    np.testing.assert_allclose(out, y0, rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------- server
+
+def test_server_end_to_end_round_robin():
+    import jax
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    runners = [_mul_runner(device=devs[0]), _mul_runner(device=devs[1])]
+    w = np.array([1.0, 2.0, 3.0], np.float32)
+    rng = np.random.RandomState(1)
+    rows = [rng.randn(3).astype(np.float32) for _ in range(12)]
+    with InferenceServer() as server:
+        server.register("mul", runners, max_queue_delay_us=500)
+        assert server.models() == {"mul": [1]}
+        reqs = [server.submit("mul", {"data": row}, timeout_s=60.0)
+                for row in rows]
+        for req, row in zip(reqs, rows):
+            (out,) = req.result(timeout=60.0)
+            np.testing.assert_allclose(out, row * w, rtol=1e-6,
+                                       atol=1e-6)
+        # completions are recorded by the worker just AFTER futures
+        # resolve — give the counters a beat to settle
+        import time
+        for _ in range(100):
+            snap = server.stats("mul")
+            if snap["completed"] == 12:
+                break
+            time.sleep(0.02)
+        assert snap["completed"] == 12
+        assert snap["timed_out"] == 0 and snap["rejected"] == 0
+        assert snap["replicas"] == 2
+        d = snap["dispatched_per_replica"]
+        assert sum(d.values()) == snap["batches"]
+        assert abs(d[0] - d[1]) <= 1        # round-robin stays even
+        assert snap["latency_ms"]["p99"] >= snap["latency_ms"]["p50"] > 0
+        assert 0 < snap["batch_fill_rate"] <= 1
+
+
+def test_server_multi_model_versions():
+    r_v1 = _mul_runner()
+    data = sym.var("data")
+    graph = data * sym.var("w")
+    r_v2 = ModelRunner(graph, {"w": np.full(3, 10.0, np.float32)},
+                       {"data": (3,)}, max_batch_size=4)
+    x = np.ones(3, np.float32)
+    with InferenceServer() as server:
+        server.register("m", r_v1, version=1, max_queue_delay_us=100)
+        server.register("m", r_v2, version=2, max_queue_delay_us=100)
+        with pytest.raises(MXNetError, match="already registered"):
+            server.register("m", r_v1, version=2)
+        np.testing.assert_allclose(
+            server.infer("m", {"data": x}, version=1)[0],
+            [1.0, 2.0, 3.0], rtol=1e-6)
+        np.testing.assert_allclose(          # default = latest version
+            server.infer("m", {"data": x})[0], [10.0] * 3, rtol=1e-6)
+        assert server.models() == {"m": [1, 2]}
+        server.unregister("m", version=2)
+        np.testing.assert_allclose(          # latest is v1 again
+            server.infer("m", {"data": x})[0], [1.0, 2.0, 3.0],
+            rtol=1e-6)
+        with pytest.raises(MXNetError, match="unknown model"):
+            server.infer("nope", {"data": x})
+
+
+def test_server_request_timeout_and_stats():
+    # delay so long the batch never flushes by itself: the request's
+    # own deadline must fire (worker wakes on it) → RequestTimeout
+    with InferenceServer() as server:
+        server.register("m", _mul_runner(),
+                        max_queue_delay_us=30_000_000)
+        req = server.submit("m", {"data": np.ones(3, np.float32)},
+                            timeout_s=0.05)
+        with pytest.raises(RequestTimeout):
+            req.result(timeout=10.0)
+        snap = server.stats("m")
+        assert snap["timed_out"] == 1 and snap["completed"] == 0
+
+
+def test_server_backpressure_records_rejections():
+    with InferenceServer() as server:
+        server.register("m", _mul_runner(),
+                        max_queue_delay_us=30_000_000, max_queue=2)
+        x = {"data": np.ones(3, np.float32)}
+        server.submit("m", x, timeout_s=30.0)
+        server.submit("m", x, timeout_s=30.0)
+        with pytest.raises(ServerBusy):
+            server.submit("m", x, timeout_s=30.0)
+        snap = server.stats("m")
+        assert snap["rejected"] == 1
+        assert snap["peak_queue_depth"] == 2
+
+
+def test_server_emits_profiler_spans(tmp_path):
+    import json
+    from mxtpu import profiler
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    try:
+        with InferenceServer() as server:
+            server.register("traced", _mul_runner(),
+                            max_queue_delay_us=100)
+            server.infer("traced", {"data": np.ones(3, np.float32)},
+                         timeout_s=30.0)
+    finally:
+        profiler.set_state("stop")
+    events = json.loads(profiler.dumps(reset=True))["traceEvents"]
+    spans = [e for e in events if e["name"] == "serve/traced:v1"]
+    assert spans and spans[0]["cat"] == "serving"
+    assert spans[0]["args"]["batch"] == 1
+    assert spans[0]["args"]["bucket"] == [1, None]
+
+
+# ------------------------------------------------------------------ stats
+
+def test_stats_snapshot_and_speedometer_line():
+    fc = FakeClock()
+    s = ServingStats(name="m:v1", log_every_s=5.0, clock=fc)
+    assert s.maybe_log() is None            # throttled at t=+0
+    for i in range(100):
+        fc.advance(0.01)
+        s.record_completion(latency_us=(i + 1) * 1000.0,
+                            queue_us=500.0)
+    s.record_batch(3, 4)
+    s.record_queue_depth(7)
+    s.record_queue_depth(2)
+    s.record_rejected()
+    s.record_timeout(2)
+    snap = s.snapshot()
+    assert snap["completed"] == 100
+    assert snap["latency_ms"]["p50"] == pytest.approx(50.0, abs=2.0)
+    assert snap["latency_ms"]["p99"] == pytest.approx(99.0, abs=2.0)
+    assert snap["batch_fill_rate"] == 0.75
+    assert snap["queue_depth"] == 2 and snap["peak_queue_depth"] == 7
+    assert snap["rejected"] == 1 and snap["timed_out"] == 2
+    # ~100 completions over ~1s of fake time
+    assert snap["requests_per_sec"] == pytest.approx(100.0, rel=0.1)
+    fc.advance(5.0)
+    line = s.maybe_log()                    # >5s elapsed → emits
+    assert line is not None and "req/sec" in line and "m:v1" in line
+    assert s.maybe_log() is None            # throttled again
+
+
+# ------------------------------------------------------------------- soak
+
+@pytest.mark.slow
+def test_server_soak_concurrent_closed_loop_clients():
+    """Multi-threaded soak: concurrent closed-loop clients with mixed
+    sequence lengths; every accepted request must come back correct
+    (its OWN rows), with bounded retries on backpressure."""
+    import jax
+    devs = jax.devices()
+    runners = [_token_runner(device=d) for d in devs[:2]]
+    n_clients, n_per_client = 6, 25
+    errors = []
+    done = [0] * n_clients
+
+    with InferenceServer() as server:
+        server.register("tok", runners, max_queue_delay_us=1000,
+                        warmup=True)
+
+        def client(cid):
+            rng = np.random.RandomState(cid)
+            try:
+                for j in range(n_per_client):
+                    n = int(rng.randint(1, 9))
+                    row = rng.randn(n).astype(np.float32)
+                    for attempt in range(50):
+                        try:
+                            req = server.submit("tok", {"data": row},
+                                                timeout_s=30.0)
+                            break
+                        except ServerBusy:
+                            import time
+                            time.sleep(0.002 * (attempt + 1))
+                    else:
+                        raise AssertionError("starved by backpressure")
+                    (out,) = req.result(timeout=60.0)
+                    np.testing.assert_allclose(out, row * 3.0,
+                                               rtol=1e-5)
+                    done[cid] += 1
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errors.append((cid, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads), "soak deadlocked"
+        assert not errors, errors
+        assert done == [n_per_client] * n_clients
+        import time
+        for _ in range(100):
+            snap = server.stats("tok")
+            if snap["completed"] == n_clients * n_per_client:
+                break
+            time.sleep(0.02)
+        assert snap["completed"] == n_clients * n_per_client
+        assert snap["batches"] >= 1
+        assert 0 < snap["batch_fill_rate"] <= 1
