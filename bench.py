@@ -53,6 +53,14 @@ import time
 
 import numpy as np
 
+from mxtpu import guards, knobs
+
+# MXTPU_GUARDS must never change bench semantics: self_check asserts
+# the disabled scope is the shared no-op object (zero per-call
+# overhead when guards are off) and, when enabled, that a jitted
+# probe returns bit-identical results inside the guard scope.
+guards.self_check()
+
 # Peak dense bf16 FLOP/s per chip, by jax device_kind prefix.
 # v5 lite (v5e) 197 TFLOP/s; v5p 459; v4 275; v3 123 (bf16).
 _PEAK_BF16 = (("TPU v5 lite", 197e12), ("TPU v5p", 459e12),
@@ -177,15 +185,13 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
     from mxtpu.gluon import loss as gloss
     from mxtpu.models import resnet50
 
-    batch_size = batch_size or int(
-        os.environ.get("MXTPU_BENCH_BATCH", "256"))
+    batch_size = batch_size or knobs.get("MXTPU_BENCH_BATCH")
     net = resnet50(classes=1000)
     net.initialize(init="xavier")
     step = parallel.build_train_step(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        compute_dtype=os.environ.get("MXTPU_BENCH_DTYPE",
-                                     "bfloat16") or None)
+        compute_dtype=knobs.get("MXTPU_BENCH_DTYPE") or None)
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
@@ -227,10 +233,8 @@ def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
                           PrefetchingIter)
     from mxtpu.models import resnet50
 
-    batch_size = batch_size or int(
-        os.environ.get("MXTPU_BENCH_BATCH", "256"))
-    row_budget = row_budget or float(
-        os.environ.get("MXTPU_BENCH_ROW_BUDGET", "90"))
+    batch_size = batch_size or knobs.get("MXTPU_BENCH_BATCH")
+    row_budget = row_budget or knobs.get("MXTPU_BENCH_ROW_BUDGET")
     t_row = time.perf_counter()
     d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
     try:
@@ -249,8 +253,7 @@ def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
                 np.roll(base, i % 224, axis=2).tobytes()))
         rec.close()
 
-        compute_dtype = os.environ.get("MXTPU_BENCH_DTYPE",
-                                       "bfloat16") or "float32"
+        compute_dtype = knobs.get("MXTPU_BENCH_DTYPE") or "float32"
 
         class _DeviceNormalize(nn.HybridBlock):
             """uint8 -> (x - mean)/std on device; XLA fuses it into the
@@ -344,7 +347,7 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
 
     net = bert_large(vocab_size=30522, max_length=seq_len, dropout=0.1)
     net.initialize(init="xavier")
-    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
 
     def mlm_loss(pred, y):
         V = 30522
@@ -396,7 +399,7 @@ def bench_transformer(batch_size=16, src_len=64, tgt_len=64, warmup=3,
 
     net = _MTWrap(src_len)
     net.initialize(init="xavier")
-    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
 
     def mt_loss(pred, y):
         return gloss.SoftmaxCrossEntropyLoss()(
@@ -441,8 +444,7 @@ def bench_ssd(batch_size=8, size=300, num_classes=20, warmup=3,
     step = parallel.build_train_step(
         net, det_loss, "sgd",
         {"learning_rate": 5e-3, "momentum": 0.9, "wd": 5e-4},
-        compute_dtype=os.environ.get("MXTPU_BENCH_DTYPE",
-                                     "bfloat16") or None)
+        compute_dtype=knobs.get("MXTPU_BENCH_DTYPE") or None)
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(batch_size, 3, size, size)
                  .astype(np.float32))
@@ -581,7 +583,7 @@ def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
     from mxtpu.models.transformer import bert_large
 
     V = 30522
-    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16") or None
+    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
     rng = np.random.RandomState(0)
     toks = nd.array(rng.randint(0, V, (batch_size, seq_len))
                     .astype(np.float32))
@@ -807,7 +809,7 @@ def _sweep_stale_tmpdirs():
 
 
 def main():
-    which = os.environ.get("MXTPU_BENCH_MODEL", "all")
+    which = knobs.get("MXTPU_BENCH_MODEL")
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
              "resnet50_pipeline": bench_resnet50_pipeline,
              "bert": bench_bert,
@@ -829,7 +831,7 @@ def main():
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")
-    budget = float(os.environ.get("MXTPU_BENCH_WALL_BUDGET", "780"))
+    budget = knobs.get("MXTPU_BENCH_WALL_BUDGET")
     order = [which] if which != "all" else \
         ["resnet50", "resnet50_pipeline", "bert", "bert_s512",
          "transformer", "lenet"]

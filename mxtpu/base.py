@@ -73,15 +73,17 @@ def get_env(name: str, default: Any = None, dtype: type = str) -> Any:
 
 
 class _EnvFlags:
-    """Central catalogue of runtime flags (role of ``docs/faq/env_var.md``†).
-
-    Each flag is read lazily so tests can monkeypatch os.environ."""
+    """Lazy accessors over the knob registry (``mxtpu/knobs.py`` — the
+    role of ``docs/faq/env_var.md``†).  Each flag is read live so tests
+    can monkeypatch os.environ; knobs is imported lazily because it
+    imports this module for MXNetError."""
 
     @property
     def engine_type(self) -> str:
         # MXNET_ENGINE_TYPE=NaiveEngine forces synchronous execution for
         # debugging (reference: src/engine/engine.cc† engine selection).
-        return get_env("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        from . import knobs
+        return knobs.get("MXTPU_ENGINE_TYPE")
 
     @property
     def synchronous(self) -> bool:
@@ -89,24 +91,28 @@ class _EnvFlags:
 
     @property
     def exec_bulk(self) -> bool:
-        return get_env("MXTPU_EXEC_BULK_EXEC_TRAIN", True, bool)
+        from . import knobs
+        return knobs.get("MXTPU_EXEC_BULK_EXEC_TRAIN")
 
     @property
     def profiler_autostart(self) -> bool:
-        return get_env("MXTPU_PROFILER_AUTOSTART", False, bool)
+        from . import knobs
+        return knobs.get("MXTPU_PROFILER_AUTOSTART")
 
     @property
     def test_seed(self) -> Optional[int]:
-        v = get_env("MXTPU_TEST_SEED", None)
-        return None if v is None else int(v)
+        from . import knobs
+        return knobs.get("MXTPU_TEST_SEED", default=None)
 
     @property
     def kvstore_bigarray_bound(self) -> int:
-        return get_env("MXTPU_KVSTORE_BIGARRAY_BOUND", 1 << 20, int)
+        from . import knobs
+        return knobs.get("MXTPU_KVSTORE_BIGARRAY_BOUND")
 
     @property
     def default_dtype(self) -> str:
-        return get_env("MXTPU_DEFAULT_DTYPE", "float32")
+        from . import knobs
+        return knobs.get("MXTPU_DEFAULT_DTYPE")
 
 
 env_flags = _EnvFlags()

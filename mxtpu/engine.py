@@ -19,15 +19,18 @@ The dependency engine itself is subsumed by XLA/PjRt async dispatch
 """
 from __future__ import annotations
 
-import os
 from contextlib import contextmanager
+
+from . import knobs
 
 __all__ = ["set_bulk_size", "bulk_size", "bulk", "set_sync_mode",
            "sync_enabled"]
 
 _BULK_SIZE = 15
-_SYNC = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine" or \
-    os.environ.get("MXTPU_ENGINE_SYNC", "0") == "1"
+# MXTPU_ENGINE_TYPE falls back to the reference MXNET_ENGINE_TYPE
+# spelling inside knobs.get, preserving the original env contract.
+_SYNC = knobs.get("MXTPU_ENGINE_TYPE") == "NaiveEngine" or \
+    knobs.get("MXTPU_ENGINE_SYNC")
 
 
 def set_bulk_size(size: int) -> int:

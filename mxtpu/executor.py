@@ -16,20 +16,18 @@ eager per-op interpretation.
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .base import MXNetError, _as_list
 from . import autograd
+from . import knobs
 from . import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
 from .symbol import Symbol, _eval_symbol, _is_aux_name
 
 __all__ = ["Executor"]
-
-_JIT_DEFAULT = os.environ.get("MXTPU_EXECUTOR_JIT", "1") == "1"
 
 
 class Executor:
@@ -68,7 +66,7 @@ class Executor:
 
         self._outputs: Optional[List[NDArray]] = None
         self._monitor_callback = None
-        self._jit = _JIT_DEFAULT
+        self._jit = knobs.get("MXTPU_EXECUTOR_JIT")
         self._jit_cache: Dict[Tuple, Any] = {}
         self._last_call = None  # inputs of the last jitted forward
         self._pending_grads = None

@@ -1,0 +1,168 @@
+"""Runtime guard rails (ISSUE 5) — the dynamic half of tools/mxlint.
+
+Static analysis catches what an AST can see; this module catches the
+two TPU-stack failure modes that only manifest at runtime:
+
+* **Recompile churn** — a jitted entry whose cache keeps missing
+  (shape-unstable batches, Python scalars flowing into traced
+  signatures) silently turns a ~ms step into a ~seconds step.
+  :class:`ChurnDetector` counts compiles per entry; past the limit
+  (``MXTPU_GUARDS_CHURN_LIMIT``) it warns, or raises under
+  ``MXTPU_GUARDS=2``.
+* **Implicit host↔device transfers** — the ``asnumpy()`` trap the
+  reference's threaded engine existed to avoid (SURVEY §0/§2).
+  :func:`no_implicit_transfers` wraps a dispatch in
+  ``jax.transfer_guard("disallow")`` so an un-committed numpy array
+  sneaking into a hot path raises instead of quietly stalling the
+  device.  Wired into ``TrainStep.__call__``/``run_steps`` and
+  ``ModelRunner.run_raw``/``warmup`` under ``MXTPU_GUARDS=1``; tests
+  use it to pin those paths transfer-clean.
+
+Zero-overhead contract (asserted by ``bench.py`` at import): with
+``MXTPU_GUARDS`` unset, :func:`no_implicit_transfers` returns one
+shared ``nullcontext`` and the hot-path wiring is behind a cached
+boolean — disabled guards add no per-step work.  Enabled guards add
+only a context-manager flip around dispatch: the compiled program is
+untouched, so bench row semantics cannot change (``self_check``
+verifies a guarded computation is bit-identical to an unguarded one).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import warnings
+from typing import Any, Dict, Optional
+
+from . import knobs
+from .base import MXNetError
+
+__all__ = ["enabled", "strict", "ChurnDetector", "RecompileChurn",
+           "no_implicit_transfers", "self_check"]
+
+logger = logging.getLogger("mxtpu.guards")
+
+_NULL = contextlib.nullcontext()
+
+
+def enabled() -> bool:
+    """Guards on?  ``MXTPU_GUARDS=1`` (warn) or ``2`` (raise)."""
+    return knobs.get("MXTPU_GUARDS").strip().lower() \
+        in ("1", "2", "true", "yes", "on")
+
+
+def strict() -> bool:
+    """``MXTPU_GUARDS=2``: guard trips raise instead of warn."""
+    return knobs.get("MXTPU_GUARDS").strip() == "2"
+
+
+class RecompileChurn(MXNetError):
+    """A guarded jit entry recompiled more times than its limit."""
+
+
+class ChurnDetector:
+    """Per-entry jit cache-miss counter.
+
+    ``note_compile(key)`` on every cache miss, ``note_call()`` on every
+    dispatch; once compiles exceed ``limit`` the detector warns ONCE
+    (or raises, ``strict=True`` / ``MXTPU_GUARDS=2``) with the
+    compiles-per-call ratio — the signature of an entry that keeps
+    retracing instead of reusing its cache.
+    """
+
+    def __init__(self, name: str, limit: Optional[int] = None,
+                 strict: Optional[bool] = None):
+        self.name = name
+        self._limit = limit
+        self._strict = strict
+        self._lock = threading.Lock()
+        self.compiles = 0        # guarded-by: _lock
+        self.calls = 0           # guarded-by: _lock
+        self._last_keys = []     # guarded-by: _lock
+        self._tripped = False    # guarded-by: _lock
+
+    @property
+    def limit(self) -> int:
+        if self._limit is not None:
+            return self._limit
+        return int(knobs.get("MXTPU_GUARDS_CHURN_LIMIT"))
+
+    def note_call(self) -> None:
+        with self._lock:
+            self.calls += 1
+
+    def note_compile(self, key: Any = None) -> None:
+        """Record one jit cache miss; trips the guard past the limit."""
+        with self._lock:
+            self.compiles += 1
+            self._last_keys.append(key)
+            del self._last_keys[:-4]  # keep the most recent few
+            over = self.compiles > self.limit and not self._tripped
+            if not over:
+                return
+            self._tripped = True
+            msg = (f"mxtpu.guards: recompile churn on {self.name!r} — "
+                   f"{self.compiles} compiles over {self.calls} calls "
+                   f"(limit {self.limit}). Recent signatures: "
+                   f"{self._last_keys}. Unstable shapes/dtypes or "
+                   f"Python values flowing into the traced signature "
+                   f"keep missing the jit cache; make them static or "
+                   f"bucket them.")
+        be_strict = self._strict if self._strict is not None else strict()
+        if be_strict:
+            raise RecompileChurn(msg)
+        logger.warning(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"name": self.name, "compiles": self.compiles,
+                    "calls": self.calls, "limit": self.limit,
+                    "tripped": self._tripped}
+
+
+def no_implicit_transfers(enabled_override: Optional[bool] = None):
+    """Context manager: inside it, implicit host↔device transfers
+    raise (``jax.transfer_guard("disallow")``); explicit
+    ``jax.device_put`` stays allowed.  Disabled (the default with
+    ``MXTPU_GUARDS`` unset) it returns a shared ``nullcontext`` —
+    zero overhead.  Pass ``enabled_override`` to force either way
+    (hot paths pass their cached flag so the knob is not re-read per
+    step)."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return _NULL
+    import jax
+    return jax.transfer_guard("disallow")
+
+
+def self_check() -> Dict[str, Any]:
+    """The import-time assertion bench.py runs: guards must be free
+    when disabled and semantics-preserving when enabled.
+
+    * disabled ⇒ :func:`no_implicit_transfers` is the shared
+      nullcontext (no allocation, no env read in hot paths);
+    * enabled ⇒ a tiny jitted computation produces bit-identical
+      results inside and outside the guard scope (the scope changes
+      WHAT IS ALLOWED, never what is computed).
+    """
+    if no_implicit_transfers(enabled_override=False) is not _NULL:
+        raise MXNetError(
+            "guards self_check: disabled transfer scope is not the "
+            "zero-overhead nullcontext")
+    info: Dict[str, Any] = {"enabled": enabled(), "strict": strict(),
+                            "churn_limit":
+                                int(knobs.get("MXTPU_GUARDS_CHURN_LIMIT"))}
+    if info["enabled"]:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        probe = jax.jit(lambda v: v * 2 + 1)
+        x = jnp.arange(8, dtype=jnp.float32)
+        bare = probe(x)
+        with no_implicit_transfers(enabled_override=True):
+            guarded = probe(x)
+        if not np.array_equal(np.asarray(bare), np.asarray(guarded)):
+            raise MXNetError(
+                "guards self_check: guarded dispatch changed results")
+    return info

@@ -9,16 +9,16 @@ and as the parity oracle in tests.
 """
 from __future__ import annotations
 
-import os
-
 import jax
+
+from .. import knobs
 
 __all__ = ["layer_norm", "flash_attention", "pallas_enabled"]
 
 
 def pallas_enabled() -> bool:
     """True when the Pallas path should be used."""
-    flag = os.environ.get("MXTPU_PALLAS", "auto")
+    flag = knobs.get("MXTPU_PALLAS")
     if flag in ("0", "off", "false"):
         return False
     if flag == "interpret":
@@ -27,7 +27,7 @@ def pallas_enabled() -> bool:
 
 
 def interpret_mode() -> bool:
-    return os.environ.get("MXTPU_PALLAS", "auto") == "interpret" or \
+    return knobs.get("MXTPU_PALLAS") == "interpret" or \
         jax.default_backend() != "tpu"
 
 

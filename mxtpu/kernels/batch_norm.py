@@ -45,13 +45,14 @@ model-zoo NCHW convention.  Other axes use the composite fallback.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .. import knobs
 
 
 # ----------------------------------------------------------------------
@@ -152,7 +153,7 @@ def _bwd_kernel(*refs, n, act, add):
 # ----------------------------------------------------------------------
 
 def _vmem_cap():
-    return int(os.environ.get("MXTPU_BN_VMEM_CAP_MB", "120")) << 20
+    return knobs.get("MXTPU_BN_VMEM_CAP_MB") << 20
 
 
 def _pick_cb(N, C, S, itemsize, mult):
@@ -342,7 +343,7 @@ def fused_bn_act(x, gamma, beta, eps=1e-5, act="none", residual=None):
     feasible = (
         pallas_enabled() and x.ndim >= 3
         and (residual is None or residual.shape == x.shape)
-        and os.environ.get("MXTPU_FUSED_BN", "0") in ("1", "on")
+        and knobs.get("MXTPU_FUSED_BN")
     )
     if feasible:
         N, C = x.shape[0], x.shape[1]

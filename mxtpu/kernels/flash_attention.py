@@ -382,10 +382,10 @@ def _fa_fwd(q, k, v, causal, sm_scale, valid_kv=None, delta=None):
 
 def _fa_bwd(causal, sm_scale, valid_kv, delta, res, do):
     q, k, v, o, lse = res
-    import os
+    from .. import knobs
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    mode = os.environ.get("MXTPU_FLASH_BWD", "auto")
+    mode = knobs.get("MXTPU_FLASH_BWD")
     if mode not in ("auto", "pallas", "ref"):
         raise ValueError(
             f"MXTPU_FLASH_BWD={mode!r} not recognised; "

@@ -12,7 +12,6 @@ kept whole in VMEM (fine up to ~tens of thousands of features).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +19,8 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .. import knobs
 
 
 def layer_norm_reference(x, gamma, beta, eps=1e-5):
@@ -423,8 +424,7 @@ _fused_residual_ln_pallas.defvjp(_frln_fwd_rule, _frln_bwd_rule)
 def epilogue_enabled() -> bool:
     """Kill switch for the Pallas epilogue (MXTPU_FUSED_LN_EPILOGUE=0
     falls back to the lax composite with identical mask numerics)."""
-    return os.environ.get("MXTPU_FUSED_LN_EPILOGUE", "1").lower() \
-        not in ("0", "off", "false")
+    return knobs.get("MXTPU_FUSED_LN_EPILOGUE")
 
 
 def fused_residual_layer_norm(h, bias, res, gamma, beta, key_data,

@@ -1,0 +1,279 @@
+"""Central registry of every ``MXTPU_*`` environment knob (ISSUE 5).
+
+One declaration per knob — name, type, default, one-line doc — and one
+accessor, :func:`get`, that every call site in ``mxtpu/``, ``tools/``
+and ``bench.py`` goes through.  The registry is the single source of
+truth three consumers share:
+
+* runtime reads (:func:`get` — live ``os.environ`` lookup, typed,
+  with the reference's ``MXNET_*`` spelling accepted as a fallback
+  exactly like ``base.get_env`` always did);
+* the README knob table (:func:`readme_table` generates it;
+  ``python -m tools.mxlint --fix-readme`` writes it between the
+  ``<!-- mxlint:knob-table -->`` markers, and the lint's
+  ``knob-readme-drift`` check fails when it goes stale);
+* ``tools/mxlint``'s ``knob-unregistered`` / ``knob-raw-env`` rules —
+  reading an ``MXTPU_*`` name that is not declared here, or reading
+  one through raw ``os.environ`` instead of :func:`get`, is a lint
+  violation.
+
+This module must stay importable WITHOUT jax and WITHOUT the mxtpu
+package (tools/mxlint loads it by file path so linting never pays a
+jax import); keep it free of framework imports.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, NamedTuple, Optional
+
+try:  # normal package import
+    from .base import MXNetError as _Err
+except ImportError:  # standalone import by path (tools/mxlint)
+    _Err = RuntimeError  # type: ignore[assignment,misc]
+
+__all__ = ["Knob", "register", "get", "registered", "readme_table"]
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off", ""}
+
+
+class Knob(NamedTuple):
+    name: str
+    default: Any
+    kind: str          # "bool" | "int" | "float" | "str"
+    doc: str
+    group: str         # README table grouping
+
+
+_REGISTRY: Dict[str, Knob] = {}
+_MISSING = object()
+
+
+def register(name: str, default: Any, kind: str = "str", doc: str = "",
+             group: str = "misc") -> Knob:
+    if kind not in ("bool", "int", "float", "str"):
+        raise _Err(f"knob {name}: unknown kind {kind!r}")
+    if not name.startswith("MXTPU_"):
+        raise _Err(f"knob {name!r} must be MXTPU_-prefixed")
+    if name in _REGISTRY:
+        raise _Err(f"knob {name} registered twice")
+    knob = Knob(name, default, kind, doc, group)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def _coerce(knob: Knob, raw: str) -> Any:
+    if knob.kind == "bool":
+        low = raw.strip().lower()
+        if low in _TRUTHY:
+            return True
+        if low in _FALSY:
+            return False
+        raise _Err(f"invalid boolean value {knob.name}={raw!r}")
+    if knob.kind == "int":
+        return int(raw)
+    if knob.kind == "float":
+        return float(raw)
+    return raw
+
+
+def get(name: str, default: Any = _MISSING) -> Any:
+    """Typed live read of a registered knob.  The environment always
+    wins; otherwise ``default`` (when given) overrides the registered
+    default.  ``MXNET_<suffix>`` is consulted as a fallback spelling so
+    reference-era scripts keep working."""
+    knob = _REGISTRY.get(name)
+    if knob is None:
+        raise _Err(
+            f"unregistered knob {name!r} — declare it in mxtpu/knobs.py "
+            f"(tools/mxlint enforces this)")
+    raw = os.environ.get(name)
+    if raw is None:
+        raw = os.environ.get("MXNET_" + name[len("MXTPU_"):])
+    if raw is None:
+        return knob.default if default is _MISSING else default
+    return _coerce(knob, raw)
+
+
+def registered() -> Dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+# ----------------------------------------------------------------------
+# The registry.  Every MXTPU_* name read anywhere in the tree (and the
+# coordination names tools/launch.py exports to workers) is declared
+# here; keep defaults in sync with the consuming module's docs.
+# NOTE: first argument must stay a string literal — tools/mxlint
+# cross-references these declarations.
+# ----------------------------------------------------------------------
+
+# -- performance kill switches (each =0 restores the pre-optimization
+#    behaviour exactly; README "Performance kill switches & knobs") ----
+register("MXTPU_ZERO", "", "str",
+         "ZeRO-1 sharded optimizer states (reduce-scatter/all-gather). "
+         "Auto: on for single-process dp>1 meshes; `0` reverts to "
+         "replicated states + gradient all-reduce.", "kill-switch")
+register("MXTPU_BATCHED_OPT", True, "bool",
+         "(shape, dtype)-bucketed stacked optimizer updates; `0` "
+         "reverts to one update chain per parameter (ignored under "
+         "ZeRO-1, whose exchange is inherently bucketed).",
+         "kill-switch")
+register("MXTPU_FUSED_LN_EPILOGUE", True, "bool",
+         "Fused bias+dropout+add+LayerNorm Pallas epilogue; `0` "
+         "reverts to the unfused lax composite.", "kill-switch")
+register("MXTPU_FUSED_BN", False, "bool",
+         "Opt-in one-HBM-pass Pallas BatchNorm(Add)Relu kernel; the "
+         "default composite keeps XLA-fused epilogues (BASELINE.md "
+         "\"Fused-BN verdict\").", "kill-switch")
+register("MXTPU_FLASH_BWD", "auto", "str",
+         "Flash-attention backward: `auto` (length-based pick), "
+         "`pallas` (blockwise kernel), `ref` (recompute composite).",
+         "kill-switch")
+register("MXTPU_PALLAS", "auto", "str",
+         "Pallas kernel dispatch: `auto` (on TPU), `interpret` "
+         "(interpreter mode for CPU testing), `0` (disable).",
+         "kill-switch")
+register("MXTPU_EXECUTOR_JIT", True, "bool",
+         "Symbolic Executor compiles the bound graph under a "
+         "shape-keyed jax.jit; `0` falls back to eager per-op "
+         "interpretation.", "kill-switch")
+
+# -- guards (this PR) --------------------------------------------------
+register("MXTPU_GUARDS", "", "str",
+         "Runtime guard rails (mxtpu.guards): `1` warn on recompile "
+         "churn and pin TrainStep/ModelRunner dispatch transfer-clean "
+         "via jax.transfer_guard; `2` raise instead of warn; "
+         "unset/`0` = off with zero overhead.", "guards")
+register("MXTPU_GUARDS_CHURN_LIMIT", 10, "int",
+         "Compiles tolerated per guarded jit entry before the "
+         "recompile-churn guard fires (ModelRunner adds its bucket-"
+         "ladder size).", "guards")
+
+# -- numerics / engine -------------------------------------------------
+register("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice", "str",
+         "`NaiveEngine` forces synchronous execution for debugging "
+         "(reference MXNET_ENGINE_TYPE).", "engine")
+register("MXTPU_ENGINE_SYNC", False, "bool",
+         "`1` forces a blocking wait after every engine op (pairs "
+         "with MXTPU_ENGINE_TYPE=NaiveEngine).", "engine")
+register("MXTPU_EXEC_BULK_EXEC_TRAIN", True, "bool",
+         "Allow bulked (scanned) multi-step training execution.",
+         "engine")
+register("MXTPU_DEFAULT_DTYPE", "float32", "str",
+         "Default NDArray dtype.", "engine")
+register("MXTPU_BN_VMEM_CAP_MB", 120, "int",
+         "Scoped-VMEM budget for the Pallas BN kernel's channel-block "
+         "selection.", "engine")
+register("MXTPU_KVSTORE_BIGARRAY_BOUND", 1048576, "int",
+         "Arrays >= this many elements use the big-array kvstore "
+         "path.", "engine")
+register("MXTPU_SAVE_FORMAT", "", "str",
+         "Checkpoint container: `legacy` (reference dmlc stream) or "
+         "`mxtpu` (MXTPU01 npz); unset picks by file extension.",
+         "engine")
+register("MXTPU_PROFILER_AUTOSTART", False, "bool",
+         "Start the chrome-trace profiler at import.", "engine")
+
+# -- serving -----------------------------------------------------------
+register("MXTPU_SERVING_MAX_BATCH", 32, "int",
+         "ModelRunner bucket-ladder cap (pow2 rungs up to this).",
+         "serving")
+register("MXTPU_SERVING_MAX_DELAY_US", 2000.0, "float",
+         "DynamicBatcher assembly window in microseconds.", "serving")
+register("MXTPU_SERVING_MAX_QUEUE", 0, "int",
+         "Bound on queued requests before ServerBusy shedding "
+         "(0/unset = 8x max batch).", "serving")
+register("MXTPU_SERVING_DONATE", True, "bool",
+         "Donate padded input buffers to the serving executable on "
+         "accelerator backends.", "serving")
+
+# -- bench / tools -----------------------------------------------------
+register("MXTPU_BENCH_MODEL", "all", "str",
+         "bench.py workload selector (lenet|resnet50|bert|transformer|"
+         "moe_ffn|ssd|bert_zero|serving_bert|... or `all`).", "bench")
+register("MXTPU_BENCH_BATCH", 256, "int",
+         "bench.py ResNet-50 global batch size.", "bench")
+register("MXTPU_BENCH_DTYPE", "bfloat16", "str",
+         "bench.py compute dtype (empty = model default).", "bench")
+register("MXTPU_BENCH_WALL_BUDGET", 780.0, "float",
+         "bench.py global wall-clock budget in seconds; over-budget "
+         "rows are recorded as skipped.", "bench")
+register("MXTPU_BENCH_ROW_BUDGET", 90.0, "float",
+         "bench.py conservative per-row wall estimate used by the "
+         "budget gate.", "bench")
+register("MXTPU_PROFILE_BERT_MODEL", "large", "str",
+         "tools/profile_bert.py model tier (tiny|base|large).",
+         "bench")
+register("MXTPU_PROBE_CONV", True, "bool",
+         "tools/probe_bn_fusion.py: `0` skips the in-context conv "
+         "probe.", "bench")
+
+# -- distributed launch (written by tools/launch.py for workers) -------
+register("MXTPU_COORDINATOR", "", "str",
+         "Coordinator address exported to launched worker processes.",
+         "launch")
+register("MXTPU_NUM_PROCESSES", 1, "int",
+         "World size exported to launched worker processes.", "launch")
+register("MXTPU_PROCESS_ID", 0, "int",
+         "Process rank exported to launched worker processes.",
+         "launch")
+
+# -- test harness ------------------------------------------------------
+register("MXTPU_TEST_PLATFORM", "cpu", "str",
+         "Test platform: `cpu` (virtual 8-device mesh) or `tpu`.",
+         "test")
+register("MXTPU_TEST_SEED", 42, "int",
+         "Deterministic per-test seed (reference MXNET_TEST_SEED).",
+         "test")
+register("MXTPU_TEST_SLOW", False, "bool",
+         "Enable heavy model-zoo test variants.", "test")
+
+
+# ----------------------------------------------------------------------
+# README generation
+# ----------------------------------------------------------------------
+_GROUP_TITLES = [
+    ("kill-switch", "Performance kill switches"),
+    ("guards", "Runtime guards"),
+    ("engine", "Engine / numerics"),
+    ("serving", "Serving"),
+    ("bench", "Bench & profiling tools"),
+    ("launch", "Distributed launch"),
+    ("test", "Test harness"),
+]
+
+TABLE_BEGIN = "<!-- mxlint:knob-table:begin (generated by " \
+    "`python -m tools.mxlint --fix-readme`; do not edit by hand) -->"
+TABLE_END = "<!-- mxlint:knob-table:end -->"
+
+
+def _fmt_default(knob: Knob) -> str:
+    if knob.kind == "bool":
+        return "on" if knob.default else "off"
+    if knob.default == "":
+        return "unset"
+    return f"`{knob.default}`"
+
+
+def readme_table() -> str:
+    """The README knob table, generated from the registry (checked
+    for drift by tools/mxlint's knob-readme-drift rule)."""
+    out: List[str] = [TABLE_BEGIN, ""]
+    for group, title in _GROUP_TITLES:
+        knobs = [k for k in _REGISTRY.values() if k.group == group]
+        if not knobs:
+            continue
+        out.append(f"**{title}**")
+        out.append("")
+        out.append("| knob | type | default | effect |")
+        out.append("|---|---|---|---|")
+        for k in sorted(knobs, key=lambda k: k.name):
+            doc = " ".join(k.doc.split())
+            out.append(f"| `{k.name}` | {k.kind} | {_fmt_default(k)} "
+                       f"| {doc} |")
+        out.append("")
+    out.append(f"({len(_REGISTRY)} knobs registered in "
+               f"`mxtpu/knobs.py`.)")
+    out.append("")
+    out.append(TABLE_END)
+    return "\n".join(out)

@@ -514,8 +514,8 @@ _SAVE_MAGIC = b"MXTPU01\n"
 
 
 def _pick_format(fname: str, fmt) -> str:
-    from ..base import get_env
-    fmt = fmt or get_env("MXTPU_SAVE_FORMAT", None) or \
+    from .. import knobs
+    fmt = fmt or knobs.get("MXTPU_SAVE_FORMAT") or \
         ("legacy" if fname.endswith(".params") else "mxtpu")
     if fmt not in ("legacy", "mxtpu"):
         raise MXNetError(f"unknown save format {fmt!r}; "

@@ -1,3 +1,4 @@
+# mxlint: hot-path
 """``mxtpu.parallel`` — SPMD execution over a device mesh.
 
 This is the TPU-native replacement for the reference's multi-device
@@ -25,7 +26,6 @@ total comm bytes (rs + ag == ar).
 """
 from __future__ import annotations
 
-import os
 import weakref
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -37,6 +37,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .. import guards
+from .. import knobs
 from .. import optimizer as opt_mod
 from ..ndarray import random as _rnd
 from ..ndarray.ndarray import NDArray
@@ -61,6 +63,7 @@ def snapshot_params(net):
     auto-naming gives every instance fresh prefixes, so values must be
     carried by position, not name — keeping that subtle assumption in
     one place (r4 review)."""
+    # mxlint: sync-point — deliberate checkpoint-style host snapshot
     return [p.data().asnumpy() for p in net.collect_params().values()]
 
 
@@ -91,7 +94,8 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
     if total > len(devices):
         raise MXNetError(
             f"mesh {axes} needs {total} devices, have {len(devices)}")
-    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    dev_array = np.asarray(  # mxlint: disable=host-sync — device objects, not data
+        devices[:total]).reshape(sizes)
     return Mesh(dev_array, names)
 
 
@@ -116,6 +120,23 @@ def _mesh_is_multiprocess(mesh: Mesh) -> bool:
     return flag
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs,
+                     check=None):
+    """``shard_map`` across jax releases: new jax exposes
+    ``jax.shard_map`` (``check_vma``), older releases only
+    ``jax.experimental.shard_map.shard_map`` (``check_rep``).
+    ``check=None`` keeps the library default."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check is None else {"check_vma": check}
+        return sm(fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map
+    kw = {} if check is None else {"check_rep": check}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kw)
+
+
 def _device_put_global(raw, mesh: Mesh, spec) -> jax.Array:
     """Place a value onto a mesh sharding, including meshes that span
     processes.  Host values: every process passes the SAME full value
@@ -132,8 +153,12 @@ def _device_put_global(raw, mesh: Mesh, spec) -> jax.Array:
             return raw
         if not raw.is_fully_addressable:
             # global array with a different layout: reshard with an
-            # in-graph identity (XLA inserts the collectives)
-            return jax.jit(lambda a: a, out_shardings=sh)(raw)
+            # in-graph identity (XLA inserts the collectives).  Cold
+            # placement path: one compile per (shape, sharding) is the
+            # cost of resharding, not churn.
+            return jax.jit(  # mxlint: disable=retrace-inline-jit
+                lambda a: a, out_shardings=sh)(raw)
+    # mxlint: sync-point — global placement fetches host values once
     host = np.asarray(raw)
     idx_map = sh.addressable_devices_indices_map(host.shape)
     shards = [jax.device_put(host[idx], d)
@@ -315,6 +340,12 @@ class TrainStep:
         self._t = 0
         self._last_mem: Optional[Dict[str, int]] = None
         self.zero = self._decide_zero(zero)
+        # Guard rails (mxtpu.guards, MXTPU_GUARDS=1): enabled() is read
+        # ONCE here so the disabled hot path costs a single cached-bool
+        # test per step (bench.py asserts the zero-overhead contract).
+        self._guards = guards.enabled()
+        self._churn = guards.ChurnDetector(
+            f"TrainStep[{type(net).__name__}]")
 
     def _decide_zero(self, zero) -> bool:
         """Resolve the ZeRO-1 mode: ``MXTPU_ZERO=0`` is the global
@@ -322,12 +353,12 @@ class TrainStep:
         default is ON exactly when the mechanism applies — a
         single-process mesh with a >1-sized ``dp_axis`` and no
         tensor-parallel ``param_spec_fn``."""
-        env = os.environ.get("MXTPU_ZERO", "").strip().lower()
+        env = knobs.get("MXTPU_ZERO").strip().lower()
         if env in ("0", "off", "false"):
             return False
         if zero is not None and not zero:
             return False
-        forced = bool(zero)
+        forced = bool(zero)  # mxlint: disable=host-sync — Python arg
         if self.mesh is None or self.dp_axis not in self.mesh.shape \
                 or self.mesh.shape[self.dp_axis] <= 1:
             if forced:
@@ -430,7 +461,8 @@ class TrainStep:
                          stacked=True)
                 for b in buckets)
 
-        self._opt_state = jax.jit(
+        # one setup-time compile per TrainStep, not a hot path
+        self._opt_state = jax.jit(  # mxlint: disable=retrace-inline-jit
             init_all, out_shardings=self._zero_state_shardings)()
 
     def _build(self, key, x_raw, y_raw):
@@ -492,8 +524,7 @@ class TrainStep:
         # stacked apply is numerically identical to the per-param loop
         # (LAMB reduces its trust-ratio norms per slice).
         # MXTPU_BATCHED_OPT=0 restores the per-param loop.
-        batched = os.environ.get("MXTPU_BATCHED_OPT", "1").lower() \
-            not in ("0", "off", "false")
+        batched = knobs.get("MXTPU_BATCHED_OPT")
         groups: List[List[int]] = []
         if batched:
             by_sig: Dict[Tuple, List[int]] = {}
@@ -525,6 +556,7 @@ class TrainStep:
                 st_s = tuple(
                     jnp.stack([opt_state[j][k] for j in group])
                     for k in range(n_leaves))
+                # mxlint: disable=host-sync — Python index lists
                 idx = jnp.asarray(np.asarray(group, np.int32))
                 bshape = (len(group),) + (1,) * (w_s.ndim - 1)
                 lr_s = jnp.take(lrs, idx).reshape(bshape)
@@ -631,6 +663,7 @@ class TrainStep:
                                          tiled=True) / dp
                 start = me * rows
                 w_loc = lax.dynamic_slice_in_dim(w_s, start, rows, ax)
+                # mxlint: disable=host-sync — Python index lists
                 idxa = jnp.asarray(np.asarray(js, np.int32))
                 if ax == 0:
                     # per-row lr/wd follow the rows this device owns
@@ -728,6 +761,8 @@ class TrainStep:
     def _entry_for(self, x_raw, y_raw, sig, key):
         entry = self._compiled.get(sig)
         if entry is None:
+            if self._guards:
+                self._churn.note_compile(sig)
             entry = self._build(key, x_raw, y_raw)
             self._compiled[sig] = entry
         return entry
@@ -754,9 +789,12 @@ class TrainStep:
         train_vals = tuple(params[i]._data._data for i in self._train_idx)
         frozen_vals = tuple(params[i]._data._data
                             for i in entry["frozen_idx"])
-        loss, new_vals, new_state, raw_aux = entry["fn"](
-            train_vals, frozen_vals, self._opt_state,
-            kd, lrs, wds, x_raw, y_raw)
+        if self._guards:
+            self._churn.note_call()
+        with guards.no_implicit_transfers(self._guards):
+            loss, new_vals, new_state, raw_aux = entry["fn"](
+                train_vals, frozen_vals, self._opt_state,
+                kd, lrs, wds, x_raw, y_raw)
         for i, v in zip(self._train_idx, new_vals):
             params[i]._data._data = v
         self._opt_state = new_state
@@ -818,6 +856,8 @@ class TrainStep:
         sig = (one_shape, str(xs.dtype), y_one, str(ys.dtype))
         entry = self._compiled.get(sig)
         if entry is None:
+            if self._guards:
+                self._churn.note_compile(sig)
             xb0 = xs if reuse_batch else xs[0]
             yb0 = ys if reuse_batch else (ys[0] if ys.ndim else ys)
             entry = self._build(key, xb0, yb0)
@@ -835,6 +875,8 @@ class TrainStep:
         lrs, wds, keys = self._commit_small(lrs, wds, keys)
         multi = self._compiled.get(msig)
         if multi is None:
+            if self._guards:
+                self._churn.note_compile(msig)
             raw_step = entry["raw_step"]
             aux_pos = entry["aux_pos"]
 
@@ -872,9 +914,12 @@ class TrainStep:
                     lrs, wds, xs, ys).compile()
                 self._last_mem = _mem_stats(multi)
             self._compiled[msig] = multi
-        losses, tv, frozen, st = multi(
-            train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
-            xs, ys)
+        if self._guards:
+            self._churn.note_call()
+        with guards.no_implicit_transfers(self._guards):
+            losses, tv, frozen, st = multi(
+                train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
+                xs, ys)
         for i, v in zip(self._train_idx, tv):
             params[i]._data._data = v
         for j, i in enumerate(entry["frozen_idx"]):
@@ -979,7 +1024,8 @@ class TrainStep:
             js, ax = b["jidx"], b["axis"]
             leaves = []
             for leaf in st:
-                a = np.asarray(leaf)  # gathers the dp shards
+                # mxlint: sync-point — checkpoint save gathers shards
+                a = np.asarray(leaf)
                 axk = ax if a.ndim == len(b["padded_shape"]) else 0
                 orig = b["stacked_shape"][axk]
                 if a.shape[axk] != orig:
@@ -1001,6 +1047,7 @@ class TrainStep:
             n_leaves = len(loaded[js[0]])
             leaves = []
             for k in range(n_leaves):
+                # mxlint: sync-point — checkpoint load stages host data
                 stk = np.stack([np.asarray(loaded[j][k]) for j in js])
                 axk = ax if stk.ndim == len(b["padded_shape"]) else 0
                 tgt = b["padded_shape"][axk]
@@ -1080,10 +1127,10 @@ class TrainStep:
         # changes to Parameter.lr_mult/wd_mult or optimizer.set_lr_mult
         # take effect on the next step — matching the eager Trainer.
         allp = self._params
-        lr_mults = np.asarray(
+        lr_mults = np.asarray(  # mxlint: disable=host-sync — Python floats
             [allp[i].lr_mult * opt.lr_mult.get(allp[i].name, 1.0)
              for i in self._train_idx], np.float32)
-        wd_mults = np.asarray(
+        wd_mults = np.asarray(  # mxlint: disable=host-sync — Python floats
             [allp[i].wd_mult * opt.wd_mult.get(allp[i].name, 1.0)
              for i in self._train_idx], np.float32)
         lrs = jnp.asarray(base_lr * bias * lr_mults)

@@ -98,10 +98,11 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x: jax.Array,
     x_spec = P(*((None,) + bspec))
     p_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     out_spec = x_spec
-    fn = jax.shard_map(
-        local_fn, mesh=mesh,
+    from . import shard_map_compat
+    fn = shard_map_compat(
+        local_fn, mesh,
         in_specs=(p_specs, x_spec, P()),
-        out_specs=out_spec, check_vma=False)
+        out_specs=out_spec, check=False)
     key_data = key if key is not None else jnp.zeros((), jnp.uint32)
     from . import _device_put_global, _mesh_is_multiprocess
     if _mesh_is_multiprocess(mesh):
@@ -116,7 +117,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, x: jax.Array,
         # reshape stays in-graph: eager ops on non-addressable global
         # arrays are rejected by jax.  Output replicated (the merged
         # batch axis has no single-axis sharding after the collapse).
-        return jax.jit(
+        # Cold multiprocess path: one compile per pipeline shape.
+        return jax.jit(  # mxlint: disable=retrace-inline-jit
             lambda a: a.reshape((B,) + a.shape[2:]),
             out_shardings=jax.NamedSharding(mesh, P()))(y_mb)
     y_mb = fn(stage_params, x_mb, key_data)

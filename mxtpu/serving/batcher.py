@@ -151,12 +151,12 @@ class DynamicBatcher:
         self.max_queue = int(max_queue) if max_queue is not None \
             else 8 * self.max_batch_size
         self._clock = clock
-        self._queue: List[InferenceRequest] = []
         self._cond = threading.Condition()
-        self._closed = False
+        self._queue: List[InferenceRequest] = []  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         self._on_timeout = on_timeout
         self._on_depth = on_depth
-        self.peak_depth = 0
+        self.peak_depth = 0  # guarded-by: _cond
 
     # -- submit side ----------------------------------------------------
     def submit(self, payload: Any, *, group: Any = None,

@@ -1,3 +1,4 @@
+# mxlint: hot-path
 """ModelRunner — AOT-compiled bucketed inference executors sharing one
 weight upload (ISSUE 4 tentpole item 1).
 
@@ -22,23 +23,19 @@ and ~1.3x expected under uniform fill — the same trade the reference's
 """
 from __future__ import annotations
 
-import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..base import MXNetError
+from .. import guards
+from .. import knobs
 from .. import profiler
 from .batcher import InferenceRequest
 
 __all__ = ["ModelRunner", "batch_ladder"]
-
-# Serving kill switches / knobs (README "Serving"): the env defaults
-# feed every ModelRunner/InferenceServer that does not pass explicit
-# values, so a deployment can be retuned without code changes.
-_ENV_MAX_BATCH = "MXTPU_SERVING_MAX_BATCH"
-_ENV_DONATE = "MXTPU_SERVING_DONATE"
 
 
 def batch_ladder(max_batch_size: int) -> Tuple[int, ...]:
@@ -93,9 +90,12 @@ class ModelRunner:
         self._input_dtypes = {
             k: np.dtype((input_dtypes or {}).get(k, np.float32))
             for k in input_specs}
+        # Serving knobs (mxtpu/knobs.py, README "Serving"): the env
+        # defaults feed every runner that does not pass explicit
+        # values, so a deployment can be retuned without code changes.
         self.max_batch_size = int(
             max_batch_size if max_batch_size is not None
-            else os.environ.get(_ENV_MAX_BATCH, "32"))
+            else knobs.get("MXTPU_SERVING_MAX_BATCH"))
         self.batch_buckets = batch_ladder(self.max_batch_size)
         self.seq_buckets = tuple(sorted(int(s) for s in seq_buckets)) \
             if seq_buckets else None
@@ -107,9 +107,9 @@ class ModelRunner:
         self._pad_value = pad_value
         self._device = device if device is not None else jax.devices()[0]
         if donate is None:
-            donate = os.environ.get(_ENV_DONATE, "1") == "1" and \
+            donate = knobs.get("MXTPU_SERVING_DONATE") and \
                 jax.default_backend() != "cpu"  # cpu: donation is a no-op
-        self._donate = bool(donate)
+        self._donate = bool(donate)  # mxlint: disable=host-sync
 
         # -- one weight upload, shared by every bucket executable ------
         known = set(symbol.list_inputs())
@@ -131,11 +131,23 @@ class ModelRunner:
                                  sharding=self._sharding)
             for v in self._param_vals)
 
-        self._entries: Dict[Tuple, Any] = {}   # bucket -> executable
-        self.compile_seconds: Dict[Tuple, float] = {}
+        # _Endpoint worker threads race through _entry()/warmup() when
+        # a server front-loads compiles while requests stream in; the
+        # compile cache and its timing ledger are lock-protected so a
+        # bucket is compiled exactly once.
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, Any] = {}  # guarded-by: _lock
+        self.compile_seconds: Dict[Tuple, float] = {}  # guarded-by: _lock
+        self._guards = guards.enabled()
+        # One compile per ladder rung is the design; anything past the
+        # ladder (+ slack for explicit extra warmup buckets) is churn.
+        self._churn = guards.ChurnDetector(
+            f"ModelRunner[{type(symbol).__name__}]",
+            limit=len(self.buckets()) + 4)
 
     @staticmethod
     def _as_np(v):
+        # mxlint: sync-point — host-side param ingest, pre-upload
         return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
 
     # -- deployment-artifact constructors -------------------------------
@@ -229,38 +241,47 @@ class ModelRunner:
         return fn
 
     def _entry(self, bucket: Tuple):
-        """Compile (once) and return the bucket's XLA executable."""
-        entry = self._entries.get(bucket)
-        if entry is not None:
+        """Compile (once) and return the bucket's XLA executable.
+        Holding ``_lock`` across the compile trades warmup parallelism
+        for the exactly-once contract: two worker threads hitting the
+        same cold bucket would otherwise both pay the compile and one
+        executable would be silently dropped."""
+        with self._lock:
+            entry = self._entries.get(bucket)
+            if entry is not None:
+                return entry
+            import jax
+            if self._guards:
+                self._churn.note_compile(bucket)
+            batch, seq = bucket
+            in_structs = tuple(
+                jax.ShapeDtypeStruct(self._concrete_shape(n, batch, seq),
+                                     self._input_dtypes[n],
+                                     sharding=self._sharding)
+                for n in self._input_names)
+            t0 = time.perf_counter()
+            with profiler.Task(f"serving:compile:b{batch}"
+                               f"{'' if seq is None else f's{seq}'}"):
+                jitted = jax.jit(
+                    self._pure_fn(),
+                    donate_argnums=(0,) if self._donate else ())
+                compiled = jitted.lower(in_structs,
+                                        self._param_structs).compile()
+            self.compile_seconds[bucket] = time.perf_counter() - t0
+            entry = {"compiled": compiled, "in_structs": in_structs}
+            self._entries[bucket] = entry
             return entry
-        import jax
-        batch, seq = bucket
-        in_structs = tuple(
-            jax.ShapeDtypeStruct(self._concrete_shape(n, batch, seq),
-                                 self._input_dtypes[n],
-                                 sharding=self._sharding)
-            for n in self._input_names)
-        t0 = time.perf_counter()
-        with profiler.Task(f"serving:compile:b{batch}"
-                           f"{'' if seq is None else f's{seq}'}"):
-            jitted = jax.jit(
-                self._pure_fn(),
-                donate_argnums=(0,) if self._donate else ())
-            compiled = jitted.lower(in_structs,
-                                    self._param_structs).compile()
-        self.compile_seconds[bucket] = time.perf_counter() - t0
-        entry = {"compiled": compiled, "in_structs": in_structs}
-        self._entries[bucket] = entry
-        return entry
 
     def warmup(self, buckets: Optional[Sequence[Tuple]] = None
                ) -> Dict[Tuple, float]:
         """Pre-compile the ladder (or a subset) so no production request
         pays a compile; returns per-bucket compile seconds."""
-        for bucket in (buckets if buckets is not None
-                       else self.buckets()):
-            self._entry(tuple(bucket))
-        return dict(self.compile_seconds)
+        with guards.no_implicit_transfers(self._guards):
+            for bucket in (buckets if buckets is not None
+                           else self.buckets()):
+                self._entry(tuple(bucket))
+        with self._lock:
+            return dict(self.compile_seconds)
 
     # -- execution --------------------------------------------------------
     def _pad_stack(self, rows: List[Dict[str, np.ndarray]],
@@ -278,6 +299,7 @@ class ModelRunner:
             dt = self._input_dtypes[name]
             buf = np.empty(shape, dt)
             for i, row in enumerate(rows):
+                # mxlint: sync-point — staging host rows, not device data
                 ex = np.asarray(row[name], dt)
                 if ex.shape != shape[1:]:
                     # sequence-pad every None axis up to the bucket
@@ -300,8 +322,11 @@ class ModelRunner:
     def run_raw(self, input_vals: Tuple, bucket: Tuple) -> Tuple:
         """One executable dispatch on pre-padded device arrays — the
         back-to-back path bench.py measures batcher overhead against."""
-        return self._entry(bucket)["compiled"](input_vals,
-                                               self._param_vals)
+        entry = self._entry(bucket)
+        if self._guards:
+            self._churn.note_call()
+        with guards.no_implicit_transfers(self._guards):
+            return entry["compiled"](input_vals, self._param_vals)
 
     def infer(self, inputs: Dict[str, np.ndarray],
               seq_len: Optional[int] = None) -> List[np.ndarray]:
@@ -309,14 +334,16 @@ class ModelRunner:
         batch axis; pads to the covering bucket, runs, slices back.
         Returns host numpy arrays (one per graph output)."""
         names = self._input_names
+        # mxlint: sync-point — inputs are caller-supplied host arrays
         n = int(np.asarray(inputs[names[0]]).shape[0])
         if seq_len is None and self.seq_buckets is not None:
-            seq_len = int(np.asarray(inputs[names[0]]).shape[1])
+            seq_len = int(np.asarray(inputs[names[0]]).shape[1])  # mxlint: sync-point
         bucket = self.bucket_for(n, seq_len)
-        rows = [{name: np.asarray(inputs[name])[i] for name in names}
+        rows = [{name: np.asarray(inputs[name])[i] for name in names}  # mxlint: sync-point
                 for i in range(n)]
         vals = self._pad_stack(rows, bucket)
         outs = self.run_raw(vals, bucket)
+        # mxlint: sync-point — the one deliberate D2H: materialize outputs
         return [np.asarray(o)[:n] for o in outs]
 
     def run_requests(self, requests: List[InferenceRequest],
@@ -330,6 +357,7 @@ class ModelRunner:
         bucket = self.bucket_for(n, seq)
         vals = self._pad_stack([r.payload for r in requests], bucket)
         outs = self.run_raw(vals, bucket)
+        # mxlint: sync-point — deliberate D2H before scattering rows
         host = [np.asarray(o) for o in outs]
         done_t = time.monotonic() if now is None else now
         for i, r in enumerate(requests):
@@ -348,7 +376,8 @@ class ModelRunner:
 
     # -- introspection ----------------------------------------------------
     def num_compiled(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def weight_buffers(self) -> Tuple:
         """The committed device arrays every bucket executable reads —

@@ -15,7 +15,6 @@ Speedometer-style periodic log line.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -23,15 +22,13 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..base import MXNetError
+from .. import knobs
 from .. import profiler
 from .batcher import DynamicBatcher, InferenceRequest
 from .runner import ModelRunner
 from .stats import ServingStats
 
 __all__ = ["InferenceServer"]
-
-_ENV_MAX_DELAY = "MXTPU_SERVING_MAX_DELAY_US"
-_ENV_MAX_QUEUE = "MXTPU_SERVING_MAX_QUEUE"
 
 
 class _Endpoint:
@@ -59,8 +56,9 @@ class _Endpoint:
             max_queue=max_queue,
             on_timeout=self.stats.record_timeout,
             on_depth=self.stats.record_queue_depth)
-        self._rr = 0
         self._rr_lock = threading.Lock()
+        self._rr = 0  # guarded-by: _rr_lock
+        # per-replica dispatch tally  # guarded-by: _rr_lock
         self.dispatched: Dict[int, int] = {i: 0
                                            for i in range(len(runners))}
         self._stop = threading.Event()
@@ -79,6 +77,14 @@ class _Endpoint:
             self._rr += 1
             self.dispatched[i] += 1
             return i
+
+    def dispatch_counts(self) -> Dict[int, int]:
+        """Locked snapshot of the per-replica dispatch tally.  stats()
+        used to read ``dispatched`` bare, racing the workers'
+        ``_next_runner`` increments (mxlint lock-discipline finding —
+        a torn read under concurrent dict mutation)."""
+        with self._rr_lock:
+            return dict(self.dispatched)
 
     def _work(self) -> None:
         while not self._stop.is_set():
@@ -148,10 +154,11 @@ class InferenceServer:
         if not runners:
             raise MXNetError("serving: register needs >= 1 runner")
         if max_queue_delay_us is None:
-            max_queue_delay_us = float(
-                os.environ.get(_ENV_MAX_DELAY, "2000"))
-        if max_queue is None and _ENV_MAX_QUEUE in os.environ:
-            max_queue = int(os.environ[_ENV_MAX_QUEUE])
+            max_queue_delay_us = knobs.get("MXTPU_SERVING_MAX_DELAY_US")
+        if max_queue is None:
+            mq = knobs.get("MXTPU_SERVING_MAX_QUEUE")
+            if mq:  # 0 = unbounded (knob unset)
+                max_queue = mq
         if warmup:
             for r in runners:
                 r.warmup()
@@ -247,7 +254,7 @@ class InferenceServer:
             ep = self._endpoint(name, version)
             snap = ep.stats.snapshot()
             snap["replicas"] = len(ep.runners)
-            snap["dispatched_per_replica"] = dict(ep.dispatched)
+            snap["dispatched_per_replica"] = ep.dispatch_counts()
             snap["compiled_buckets"] = [r.num_compiled()
                                         for r in ep.runners]
             return snap

@@ -46,21 +46,21 @@ class ServingStats:
         self.name = name
         self._lock = threading.Lock()
         self._clock = clock
-        self._lat_us = deque(maxlen=window)     # completed-request latency
-        self._queue_us = deque(maxlen=window)   # time spent queued
-        self._done_ts = deque()                 # completion stamps (rate)
+        self._lat_us = deque(maxlen=window)  # guarded-by: _lock
+        self._queue_us = deque(maxlen=window)  # guarded-by: _lock
+        self._done_ts = deque()  # guarded-by: _lock
         self._rate_window_s = rate_window_s
         self._log_every_s = log_every_s
-        self._last_log = clock()
+        self._last_log = clock()  # guarded-by: _lock
         # monotonically increasing totals
-        self.completed = 0
-        self.timed_out = 0
-        self.rejected = 0
-        self.batches = 0
-        self.padded_slots = 0    # bucket capacity minus real requests
-        self.batched_requests = 0
-        self.queue_depth = 0
-        self.peak_queue_depth = 0
+        self.completed = 0  # guarded-by: _lock
+        self.timed_out = 0  # guarded-by: _lock
+        self.rejected = 0  # guarded-by: _lock
+        self.batches = 0  # guarded-by: _lock
+        self.padded_slots = 0  # guarded-by: _lock
+        self.batched_requests = 0  # guarded-by: _lock
+        self.queue_depth = 0  # guarded-by: _lock
+        self.peak_queue_depth = 0  # guarded-by: _lock
 
     # -- event hooks (called by batcher/server) -------------------------
     def record_queue_depth(self, depth: int) -> None:

@@ -1,0 +1,229 @@
+"""mxtpu.guards — runtime guard rails (ISSUE 5).
+
+Covers both rails (recompile-churn detector, no-implicit-transfer
+scope), the zero-overhead contract bench.py asserts at import, and the
+guarded hot paths end to end: a TrainStep and a ModelRunner must run
+transfer-clean and churn-free under MXTPU_GUARDS=2 (strict) on the
+JAX_PLATFORMS=cpu test mesh — plus the serving dispatch-tally race
+regression the lint's lock-discipline rule surfaced.
+"""
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxtpu import guards, nd, parallel
+from mxtpu import symbol as sym
+from mxtpu.gluon import nn
+from mxtpu.guards import ChurnDetector, RecompileChurn
+from mxtpu.parallel import restore_params, snapshot_params
+from mxtpu.serving import ModelRunner
+from mxtpu.serving.server import _Endpoint
+
+
+# ------------------------------------------------------- churn detector
+
+def test_churn_trips_strict_past_limit():
+    det = ChurnDetector("t", limit=3, strict=True)
+    for i in range(3):
+        det.note_compile(("sig", i))
+    with pytest.raises(RecompileChurn, match="recompile churn"):
+        det.note_compile(("sig", 3))
+    assert det.stats()["tripped"] is True
+
+
+def test_churn_warns_once_in_warn_mode():
+    det = ChurnDetector("t", limit=1, strict=False)
+    det.note_compile("a")
+    with pytest.warns(RuntimeWarning, match="recompile churn"):
+        det.note_compile("b")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        det.note_compile("c")   # already tripped: stays silent
+
+
+def test_churn_silent_across_steady_state_steps():
+    """One compile then 100 cache hits — the healthy profile — must
+    never fire."""
+    det = ChurnDetector("steady", limit=10, strict=True)
+    det.note_compile((4, None))
+    for _ in range(100):
+        det.note_call()
+    s = det.stats()
+    assert s["compiles"] == 1 and s["calls"] == 100
+    assert s["tripped"] is False
+
+
+def test_churn_fires_on_deliberately_retracing_fn():
+    """A jit entry fed a fresh shape every call retraces every call;
+    the detector must trip once compiles pass the limit."""
+    det = ChurnDetector("retrace", limit=4, strict=True)
+    traces = [0]
+
+    def f(x):
+        traces[0] += 1          # executes only when jax (re)traces
+        return x * 2.0
+
+    jf = jax.jit(f)
+    with pytest.raises(RecompileChurn):
+        for n in range(1, 16):
+            seen = traces[0]
+            jf(jnp.zeros((n,), jnp.float32))
+            det.note_call()
+            if traces[0] > seen:
+                det.note_compile(("f32", (n,)))
+    assert det.stats()["compiles"] == 5     # limit + the tripping miss
+
+
+# ----------------------------------------------------- transfer scope
+
+def test_transfer_scope_blocks_implicit_h2d():
+    jf = jax.jit(lambda v: v + 1.0)
+    host = np.ones((4,), np.float32)
+    jf(jax.device_put(host))                 # compile outside the scope
+    with guards.no_implicit_transfers(enabled_override=True):
+        jf(jax.device_put(host))             # explicit: allowed
+        with pytest.raises(Exception, match="isallow"):
+            jf(host)                         # implicit H2D: blocked
+
+
+def test_disabled_scope_is_shared_nullcontext(monkeypatch):
+    monkeypatch.delenv("MXTPU_GUARDS", raising=False)
+    monkeypatch.delenv("MXNET_GUARDS", raising=False)
+    assert guards.enabled() is False
+    a = guards.no_implicit_transfers()
+    b = guards.no_implicit_transfers()
+    assert a is b is guards._NULL            # zero allocation per step
+
+
+def test_self_check_both_modes(monkeypatch):
+    monkeypatch.delenv("MXTPU_GUARDS", raising=False)
+    info = guards.self_check()
+    assert info["enabled"] is False and info["strict"] is False
+    monkeypatch.setenv("MXTPU_GUARDS", "2")
+    info = guards.self_check()
+    assert info["enabled"] is True and info["strict"] is True
+
+
+def test_bench_imports_with_self_check_hook():
+    """bench.py runs guards.self_check() at import — importing it must
+    succeed with guards off (the default) and leave the hook wired."""
+    import bench
+    assert "guards.self_check()" in open(bench.__file__).read()
+
+
+# ------------------------------------------------- guarded TrainStep
+
+def _make_net(x):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, flatten=False), nn.Dense(4, flatten=False))
+    net.initialize(init="xavier")
+    net(x)
+    return net
+
+
+def _run_steps(monkeypatch, guards_mode, x, y, snap, steps=4):
+    if guards_mode is None:
+        monkeypatch.delenv("MXTPU_GUARDS", raising=False)
+    else:
+        monkeypatch.setenv("MXTPU_GUARDS", guards_mode)
+    net = _make_net(x)
+    restore_params(net, snap)
+    step = parallel.build_train_step(
+        net, lambda p, t: ((p - t) ** 2).mean(), "sgd",
+        {"learning_rate": 0.05})
+    losses = [float(step(x, y).asscalar()) for _ in range(steps)]
+    return step, losses
+
+
+@pytest.fixture()
+def _data():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(4, 8).astype(np.float32))
+    y = nd.array(rng.randn(4, 4).astype(np.float32))
+    snap = snapshot_params(_make_net(x))
+    return x, y, snap
+
+
+def test_train_step_transfer_clean_under_strict_guards(_data,
+                                                       monkeypatch):
+    """MXTPU_GUARDS=2: every TrainStep dispatch runs inside
+    transfer_guard("disallow") — an implicit host transfer anywhere on
+    the step path would raise here."""
+    x, y, snap = _data
+    step, losses = _run_steps(monkeypatch, "2", x, y, snap)
+    assert all(np.isfinite(losses))
+    s = step._churn.stats()
+    assert s["calls"] == 4 and s["compiles"] >= 1
+    assert s["tripped"] is False
+
+
+def test_guards_change_no_training_semantics(_data, monkeypatch):
+    """The bench.py contract, end to end: identical params + data give
+    bit-identical losses with guards off and strict."""
+    x, y, snap = _data
+    _, bare = _run_steps(monkeypatch, None, x, y, snap)
+    _, strict_ = _run_steps(monkeypatch, "2", x, y, snap)
+    assert bare == strict_
+
+
+# ------------------------------------------------ guarded ModelRunner
+
+def test_model_runner_warmup_and_infer_under_strict_guards(monkeypatch):
+    monkeypatch.setenv("MXTPU_GUARDS", "2")
+    graph = sym.var("data") * sym.var("w")
+    r = ModelRunner(graph, {"w": np.array([1.0, 2.0, 3.0], np.float32)},
+                    {"data": (3,)}, max_batch_size=4)
+    secs = r.warmup()                      # AOT compiles inside the scope
+    assert set(secs) == set(r.buckets())
+    out = r.infer({"data": np.ones((2, 3), np.float32)})
+    np.testing.assert_allclose(
+        out[0], np.tile([1.0, 2.0, 3.0], (2, 1)))
+    s = r._churn.stats()
+    assert s["compiles"] == len(r.buckets())
+    assert s["tripped"] is False           # ladder fits under the limit
+
+
+# -------------------------------------- serving race regression (lint)
+
+def test_dispatch_counts_is_race_free():
+    """Regression for the lock-discipline finding: stats() used to
+    read ``_Endpoint.dispatched`` bare while workers increment it in
+    ``_next_runner``.  Hammer both sides concurrently; the locked
+    snapshot must never tear and the final tally must be exact."""
+    runner = ModelRunner(sym.var("data") * 2.0, {}, {"data": (2,)},
+                         max_batch_size=2)
+    ep = _Endpoint("m", 1, [runner, runner],
+                   max_queue_delay_us=1000.0, max_queue=None,
+                   log_every_s=60.0)      # workers NOT started
+    N, T = 400, 4
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(N):
+                ep._next_runner()
+        except Exception as e:              # pragma: no cover
+            errs.append(e)
+
+    def snapshot():
+        try:
+            for _ in range(N):
+                c = ep.dispatch_counts()
+                assert sum(c.values()) <= N * T
+        except Exception as e:              # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(T)] + \
+              [threading.Thread(target=snapshot) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    counts = ep.dispatch_counts()
+    assert sum(counts.values()) == N * T
+    assert len(counts) == 2

@@ -1,0 +1,2 @@
+# Makes tools/ importable so `python -m tools.mxlint` (and
+# `from tools.microbench import ...`) resolve from the repo root.

@@ -1,0 +1,33 @@
+"""mxlint — framework-aware static analysis for the mxtpu tree
+(ISSUE 5 tentpole; the static half of mxtpu/guards.py).
+
+Generic linters can't see the TPU-stack failure modes this codebase
+actually has, so mxlint knows the framework:
+
+* **retrace hazards** — impure calls (time/random/np.random/os.environ
+  /print) inside jit bodies, Python branching on traced parameters,
+  value-concretization (`float`/`np.asarray`/`.item()`) under trace,
+  and inline ``jax.jit(f)(x)`` immediate invocations;
+* **host-sync leaks** — ``.item()``/``float()``/``np.asarray`` style
+  device→host syncs in files marked ``# mxlint: hot-path``, outside
+  lines whitelisted with ``# mxlint: sync-point``;
+* **lock discipline** — attributes annotated ``# guarded-by: <lock>``
+  must only be touched inside ``with self.<lock>:`` (methods named
+  ``*_locked`` and ``__init__`` are assumed to hold it);
+* **knob registry** — every ``MXTPU_*`` env read must go through
+  ``mxtpu.knobs.get`` (``knob-raw-env``), name a registered knob
+  (``knob-unregistered``), and the README knob table must match the
+  registry (``knob-readme-drift``).
+
+Suppression: ``# mxlint: disable=<rule>[,<rule>...]`` on (or on the
+comment line directly above) the offending line;
+``# mxlint: disable-file=<rule>`` near the top of a file.
+
+Findings are fingerprinted (rule, path, stripped source line) so the
+committed baseline (``tools/mxlint/baseline.json``) survives
+line-number drift; ``--check`` fails only on NEW findings.
+
+mxlint never imports jax or the mxtpu package — ``mxtpu/knobs.py`` is
+loaded standalone by file path, everything else is pure ``ast``.
+"""
+from .core import Finding, lint_repo, load_baseline  # noqa: F401

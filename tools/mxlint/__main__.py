@@ -1,0 +1,97 @@
+"""mxlint CLI.
+
+Exit codes (the contract tests/test_lint.py pins):
+
+* 0 — no findings outside the committed baseline;
+* 1 — new findings (or README knob-table drift);
+* 2 — usage / internal error (unreadable baseline, bad paths).
+
+Default scan set: ``mxtpu/``, ``tools/``, ``bench.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from .core import (DEFAULT_BASELINE, DEFAULT_PATHS, REPO_ROOT,
+                   lint_repo, load_baseline, split_by_baseline,
+                   write_baseline)
+from .rules import fix_readme
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Framework-aware static analysis for the mxtpu "
+                    "tree (retrace hazards, host-sync leaks, lock "
+                    "discipline, knob registry).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help="accepted-findings baseline JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="counts only; exit 1 on new findings "
+                         "(CI mode)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the "
+                         "baseline and exit 0")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="regenerate the README knob table from "
+                         "mxtpu/knobs.py and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    args = ap.parse_args(argv)
+
+    if args.fix_readme:
+        changed = fix_readme(REPO_ROOT)
+        print("README.md knob table "
+              + ("rewritten" if changed else "already current"))
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        findings = lint_repo(tuple(args.paths) or DEFAULT_PATHS)
+    except SyntaxError as e:  # a rule crashed on a parse artifact
+        print(f"mxlint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"wrote {len({f.fingerprint for f in findings})} "
+              f"fingerprints to {args.baseline}")
+        return 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"mxlint: cannot read baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    new, old = split_by_baseline(findings, baseline)
+    dt = time.perf_counter() - t0
+
+    if args.as_json:
+        print(json.dumps({"new": [f.as_json() for f in new],
+                          "baselined": [f.as_json() for f in old],
+                          "seconds": round(dt, 3)}, indent=1))
+    elif args.check:
+        print(f"mxlint: {len(new)} new, {len(old)} baselined "
+              f"({dt:.2f}s)")
+        for f in new:
+            print("  " + f.format())
+    else:
+        for f in new:
+            print(f.format())
+        if old:
+            print(f"({len(old)} baselined finding(s) suppressed; "
+                  f"see {args.baseline.name})")
+        print(f"mxlint: {len(new)} new finding(s) in {dt:.2f}s")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""mxlint core: file model, suppression handling, baseline, runner.
+
+Pure stdlib (``ast``/``re``/``json``); must never import jax or the
+mxtpu package — linting the tree cannot pay a framework import, and a
+broken mxtpu must still be lintable.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, \
+    Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_PATHS = ("mxtpu", "tools", "bench.py")
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_SUPPRESS_RE = re.compile(r"#\s*mxlint:\s*disable=([\w\-, ]+)")
+_FILE_SUPPRESS_RE = re.compile(r"#\s*mxlint:\s*disable-file=([\w\-, ]+)")
+_SYNC_RE = re.compile(r"#\s*mxlint:\s*sync-point")
+_HOT_RE = re.compile(r"#\s*mxlint:\s*hot-path")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+
+# pragma lines must appear this early to mark a whole file
+_HEADER_LINES = 5
+
+
+class Finding:
+    """One violation.  ``fingerprint`` identifies it across edits that
+    only move lines: the exact line text (stripped) within a file for
+    a given rule."""
+
+    __slots__ = ("rule", "path", "line", "message", "snippet")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 snippet: str = ""):
+        self.rule = rule
+        self.path = path          # repo-relative posix path
+        self.line = line
+        self.message = message
+        self.snippet = snippet
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path,
+                "line": self.line, "message": self.message,
+                "snippet": self.snippet}
+
+
+class FileCtx:
+    """Parsed file + its mxlint pragmas."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        head = self.lines[:_HEADER_LINES]
+        self.hot_path = any(_HOT_RE.search(ln) for ln in head)
+        self.file_suppressions: Set[str] = set()
+        for ln in head:
+            m = _FILE_SUPPRESS_RE.search(ln)
+            if m:
+                self.file_suppressions.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+        # line -> suppressed rule names; a comment-only pragma line
+        # also covers the line after it (annotations above multi-line
+        # statements)
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.sync_points: Set[int] = set()
+        for i, ln in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            comment_only = ln.lstrip().startswith("#")
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.suppressions.setdefault(i, set()).update(rules)
+                if comment_only:
+                    self.suppressions.setdefault(i + 1, set()).update(
+                        rules)
+            if _SYNC_RE.search(ln):
+                self.sync_points.add(i)
+                if comment_only:
+                    self.sync_points.add(i + 1)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        if rule in self.file_suppressions or \
+                "*" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(lineno, ())
+        return rule in rules or "*" in rules
+
+
+class Rule:
+    """A named check over one FileCtx (or, for ``repo_check``, the
+    whole repo)."""
+
+    name = ""
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        return []
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for nested Attribute/Name chains, else
+    None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ----------------------------------------------------------------------
+# knobs.py standalone load (no mxtpu import: knobs.py catches the
+# failing relative import of .base and degrades to RuntimeError)
+# ----------------------------------------------------------------------
+def load_knobs_module(root: Path = REPO_ROOT):
+    path = root / "mxtpu" / "knobs.py"
+    spec = importlib.util.spec_from_file_location("_mxlint_knobs", path)
+    mod = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------------
+# file discovery
+# ----------------------------------------------------------------------
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist",
+              ".eggs"}
+
+
+def iter_py_files(paths: Sequence[str],
+                  root: Path = REPO_ROOT) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        full = (root / p) if not Path(p).is_absolute() else Path(p)
+        if full.is_file() and full.suffix == ".py":
+            out.append(full)
+        elif full.is_dir():
+            for f in sorted(full.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    out.append(f)
+    return out
+
+
+def parse_files(files: Iterable[Path],
+                root: Path = REPO_ROOT) -> Tuple[List[FileCtx],
+                                                 List[Finding]]:
+    ctxs: List[FileCtx] = []
+    errors: List[Finding] = []
+    for f in files:
+        rel = f.relative_to(root).as_posix() if f.is_relative_to(root) \
+            else f.as_posix()
+        try:
+            src = f.read_text()
+            ctxs.append(FileCtx(f, rel, src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            lineno = getattr(e, "lineno", 1) or 1
+            errors.append(Finding("parse-error", rel, lineno, str(e)))
+    return ctxs, errors
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+def load_baseline(path: Path = DEFAULT_BASELINE) -> Set[Tuple[str, str,
+                                                              str]]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {tuple(fp) for fp in data.get("fingerprints", [])}
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Path = DEFAULT_BASELINE) -> None:
+    fps = sorted({f.fingerprint for f in findings})
+    path.write_text(json.dumps(
+        {"comment": "mxlint accepted-findings baseline; regenerate "
+                    "with `python -m tools.mxlint --write-baseline`",
+         "fingerprints": [list(fp) for fp in fps]}, indent=1) + "\n")
+
+
+def split_by_baseline(findings: Sequence[Finding],
+                      baseline: Set[Tuple[str, str, str]]
+                      ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old
+
+
+# ----------------------------------------------------------------------
+# runner
+# ----------------------------------------------------------------------
+def lint_repo(paths: Sequence[str] = DEFAULT_PATHS,
+              root: Path = REPO_ROOT) -> List[Finding]:
+    """Run every rule over ``paths``; returns unsuppressed findings
+    (baseline NOT applied — callers split against it)."""
+    from . import rules as R
+    ctxs, findings = parse_files(iter_py_files(paths, root), root)
+    per_file = R.file_rules()
+    for ctx in ctxs:
+        for rule in per_file:
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f.rule, f.line):
+                    if not f.snippet:
+                        f.snippet = ctx.line_text(f.line)
+                    findings.append(f)
+    findings.extend(R.repo_checks(ctxs, root))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

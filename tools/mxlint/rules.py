@@ -1,0 +1,547 @@
+"""mxlint rule families (ISSUE 5): retrace hazards, host-sync leaks,
+lock discipline, knob registry.
+
+Every rule is deliberately framework-aware and best-effort: it flags
+the patterns that have actually bitten this codebase, with the
+suppression comment as the escape hatch — NOT a general-purpose
+soundness analysis.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import (FileCtx, Finding, Rule, dotted_name,
+                   load_knobs_module, _GUARDED_RE)
+
+# ----------------------------------------------------------------------
+# jit-body discovery (shared by the retrace rules)
+# ----------------------------------------------------------------------
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit`` refs."""
+    d = dotted_name(node)
+    if d is None:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return last in _JIT_NAMES
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` — including ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _is_jit_callable(node.func):
+        return True
+    d = dotted_name(node.func)
+    if d is not None and d.rsplit(".", 1)[-1] == "partial":
+        return any(_is_jit_callable(a) for a in node.args)
+    return False
+
+
+def find_jit_bodies(tree: ast.AST) -> List[ast.AST]:
+    """Function defs (or lambdas) that become jit entries:
+
+    * decorated with ``@jit`` / ``@jax.jit`` /
+      ``@partial(jax.jit, ...)``;
+    * a ``def f`` whose NAME is later passed to a ``jax.jit(...)``
+      call anywhere in the module;
+    * a lambda appearing directly inside a ``jax.jit(...)`` call.
+    """
+    jitted_names: Set[str] = set()
+    bodies: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    jitted_names.add(a.id)
+                elif isinstance(a, ast.Lambda):
+                    bodies.append(a)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in jitted_names:
+                bodies.append(node)
+            elif any(_is_jit_call(d) or _is_jit_callable(d)
+                     for d in node.decorator_list):
+                bodies.append(node)
+    return bodies
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in
+             args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n != "self"}
+
+
+# ----------------------------------------------------------------------
+# retrace rules
+# ----------------------------------------------------------------------
+_IMPURE_EXACT = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "os.getenv", "os.urandom", "uuid.uuid4", "input",
+}
+_IMPURE_PREFIX = ("random.", "np.random.", "numpy.random.",
+                  "os.environ.", "secrets.")
+# jax.random / self._rng etc. must NOT match: prefixes anchor at the
+# full dotted chain, so "jax.random.split" is safe.
+
+
+class RetraceImpureCall(Rule):
+    """Host-impure calls in a jit body run ONCE at trace time and are
+    baked into the compiled program — time stands still, randomness
+    freezes, env reads go stale."""
+
+    name = "retrace-impure-call"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for body in find_jit_bodies(ctx.tree):
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d is None:
+                    continue
+                if d in _IMPURE_EXACT or \
+                        any(d.startswith(p) for p in _IMPURE_PREFIX) \
+                        or d == "print":
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"impure call `{d}` inside a jit body executes "
+                        f"once at trace time and is constant-folded "
+                        f"into the compiled program"))
+        return out
+
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+class RetraceTracedBranch(Rule):
+    """``if``/``while`` on a traced parameter's VALUE forces a
+    concretization error or per-value retrace.  Branching on shape,
+    dtype, or None-ness is static under tracing and allowed."""
+
+    name = "retrace-traced-branch"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for body in find_jit_bodies(ctx.tree):
+            params = _param_names(body)
+            if not params or isinstance(body, ast.Lambda):
+                continue
+            for node in ast.walk(body):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                bad = self._value_use(node.test, params)
+                if bad:
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"branching on traced parameter `{bad}`'s "
+                        f"value inside a jit body (use jnp.where/"
+                        f"lax.cond, or make it a static arg)"))
+        return out
+
+    def _value_use(self, test: ast.AST, params: Set[str]
+                   ) -> Optional[str]:
+        """First param whose VALUE (not shape/dtype/None-ness) feeds
+        the condition."""
+        # `x is None` / `x is not None` guards are static
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+            return None
+        return self._scan(test, params)
+
+    def _scan(self, node: ast.AST, params: Set[str]) -> Optional[str]:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return None  # static metadata access
+            return self._scan(node.value, params)
+        if isinstance(node, ast.Name):
+            return node.id if node.id in params else None
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("len", "isinstance", "hasattr", "getattr",
+                     "callable", "type"):
+                return None  # static under tracing
+            for a in list(node.args) + [kw.value
+                                        for kw in node.keywords]:
+                hit = self._scan(a, params)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Compare):
+            for sub in [node.left] + list(node.comparators):
+                hit = self._scan(sub, params)
+                if hit:
+                    return hit
+            return None
+        for child in ast.iter_child_nodes(node):
+            hit = self._scan(child, params)
+            if hit:
+                return hit
+        return None
+
+
+class RetraceInlineJit(Rule):
+    """``jax.jit(f)(x)`` — a fresh jit wrapper invoked immediately.
+    When ``f`` is a fresh closure/lambda the cache never hits and
+    every call recompiles (the exact churn mxtpu.guards catches at
+    runtime)."""
+
+    name = "retrace-inline-jit"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Call) and \
+                    _is_jit_call(node.func):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "inline `jax.jit(...)(...)` immediate invocation "
+                    "— bind the jitted callable once (or AOT "
+                    "lower/compile) so the cache can hit"))
+        return out
+
+
+_CONCRETIZE_METHODS = {"item", "tolist", "asnumpy"}
+_CONCRETIZE_FUNCS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "float", "bool"}
+
+
+class RetraceConcretize(Rule):
+    """Concretizing a traced value (``float()``, ``np.asarray``,
+    ``.item()``) inside a jit body either raises a
+    ConcretizationTypeError or silently constant-folds."""
+
+    name = "retrace-concretize"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for body in find_jit_bodies(ctx.tree):
+            params = _param_names(body)
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _CONCRETIZE_METHODS and \
+                        not node.args:
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"`.{node.func.attr}()` inside a jit body "
+                        f"concretizes a traced value"))
+                    continue
+                d = dotted_name(node.func)
+                if d in _CONCRETIZE_FUNCS and node.args and \
+                        self._touches_param(node.args[0], params):
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        f"`{d}(...)` on a traced parameter inside a "
+                        f"jit body concretizes it (use jnp/lax ops)"))
+        return out
+
+    @staticmethod
+    def _touches_param(node: ast.AST, params: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in params
+                   for n in ast.walk(node))
+
+
+# ----------------------------------------------------------------------
+# host-sync leaks (files marked `# mxlint: hot-path`)
+# ----------------------------------------------------------------------
+_SYNC_METHODS = {"item", "tolist", "asnumpy", "block_until_ready"}
+_SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray",
+               "numpy.array", "jax.device_get", "float", "bool"}
+
+
+class HostSync(Rule):
+    """In hot-path files, device→host syncs stall the dispatch
+    pipeline (the asnumpy() trap).  Deliberate materialization points
+    carry ``# mxlint: sync-point``."""
+
+    name = "host-sync"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        if not ctx.hot_path:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if node.lineno in ctx.sync_points:
+                continue
+            label = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS and not node.args:
+                label = f".{node.func.attr}()"
+            else:
+                d = dotted_name(node.func)
+                if d in _SYNC_FUNCS:
+                    if d in ("float", "bool") and (
+                            not node.args or isinstance(
+                                node.args[0], ast.Constant)):
+                        continue
+                    label = f"{d}(...)"
+            if label:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"{label} in a hot-path file forces a device→host "
+                    f"sync; move it off the hot path or annotate the "
+                    f"line `# mxlint: sync-point`"))
+        return out
+
+
+# ----------------------------------------------------------------------
+# lock discipline (`# guarded-by: <lock>` annotations)
+# ----------------------------------------------------------------------
+class LockDiscipline(Rule):
+    """``self.<attr>`` annotated ``# guarded-by: <lock>`` may only be
+    touched inside ``with self.<lock>:``.  ``__init__`` (no concurrent
+    access before construction completes) and methods named
+    ``*_locked`` (documented called-with-lock-held convention) are
+    exempt."""
+
+    name = "lock-discipline"
+
+    _ASSIGN_RE = re.compile(r"self\.(\w+)\s*(?::[^=]*)?=[^=]")
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(ctx, cls))
+        return out
+
+    def _annotations(self, ctx: FileCtx,
+                     cls: ast.ClassDef) -> Dict[str, str]:
+        """attr -> lock name, from guarded-by comments inside the
+        class body's line range."""
+        end = cls.end_lineno or len(ctx.lines)
+        guarded: Dict[str, str] = {}
+        for i in range(cls.lineno, end + 1):
+            line = ctx.lines[i - 1] if i <= len(ctx.lines) else ""
+            m = _GUARDED_RE.search(line)
+            if not m:
+                continue
+            lock = m.group(1)
+            # the guarded attribute: assignment on this line, else on
+            # the next (annotation above a multi-line statement)
+            am = self._ASSIGN_RE.search(line)
+            if am is None and i < len(ctx.lines):
+                am = self._ASSIGN_RE.search(ctx.lines[i])
+            if am:
+                guarded[am.group(1)] = lock
+        return guarded
+
+    def _check_class(self, ctx: FileCtx,
+                     cls: ast.ClassDef) -> List[Finding]:
+        guarded = self._annotations(ctx, cls)
+        if not guarded:
+            return []
+        out: List[Finding] = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__" or meth.name.endswith("_locked"):
+                continue
+            self._walk(ctx, meth, guarded, frozenset(), out)
+        return out
+
+    def _held_after(self, node: ast.With,
+                    held: frozenset) -> frozenset:
+        extra = set()
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                extra.add(expr.attr)
+        return held | extra
+
+    def _walk(self, ctx: FileCtx, node: ast.AST, guarded: Dict[str, str],
+              held: frozenset, out: List[Finding]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            held = self._held_after(node, held)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in guarded:
+            lock = guarded[node.attr]
+            if lock not in held:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"`self.{node.attr}` is `# guarded-by: {lock}` but "
+                    f"accessed outside `with self.{lock}:`"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)) and held:
+            # a nested def/lambda does not inherit the enclosing
+            # lock scope — it may run later, unlocked
+            held = frozenset()
+        for child in ast.iter_child_nodes(node):
+            self._walk(ctx, child, guarded, held, out)
+
+
+# ----------------------------------------------------------------------
+# knob registry rules
+# ----------------------------------------------------------------------
+def _knob_registry_names() -> Set[str]:
+    return set(load_knobs_module().registered())
+
+
+class _KnobRuleBase(Rule):
+    _registry: Optional[Set[str]] = None
+
+    @property
+    def registry(self) -> Set[str]:
+        if _KnobRuleBase._registry is None:
+            _KnobRuleBase._registry = _knob_registry_names()
+        return _KnobRuleBase._registry
+
+
+class KnobRawEnv(_KnobRuleBase):
+    """``os.environ`` reads of ``MXTPU_*``/``MXNET_*`` names must go
+    through ``mxtpu.knobs.get`` — the registry is the single source of
+    typing, defaults, and the README table.  Writes (launch scripts,
+    ablation probes) are allowed."""
+
+    name = "knob-raw-env"
+    _EXEMPT = ("mxtpu/knobs.py", "mxtpu/base.py")
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        if ctx.rel in self._EXEMPT:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            knob = self._env_read(node)
+            if knob:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"raw environment read of `{knob}` — use "
+                    f"`mxtpu.knobs.get(\"{knob}\")`"))
+        return out
+
+    @staticmethod
+    def _literal_knob(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith(("MXTPU_", "MXNET_")):
+            return node.value
+        return None
+
+    def _env_read(self, node: ast.AST) -> Optional[str]:
+        # os.environ.get("X") / os.environ.setdefault("X", ...) /
+        # os.getenv("X")
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("os.environ.get", "os.environ.setdefault",
+                     "os.getenv") and node.args:
+                return self._literal_knob(node.args[0])
+            return None
+        # os.environ["X"] reads (Load context only — assignment to
+        # os.environ["X"] is a write)
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                dotted_name(node.value) == "os.environ":
+            return self._literal_knob(node.slice)
+        return None
+
+
+class KnobUnregistered(_KnobRuleBase):
+    """``knobs.get("NAME")`` must name a registered knob (knobs.get
+    raises at runtime; the lint catches it before that)."""
+
+    name = "knob-unregistered"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "get" and
+                    isinstance(node.func.value, ast.Name) and
+                    node.func.value.id == "knobs" and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    arg.value not in self.registry:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"knobs.get({arg.value!r}): not registered in "
+                    f"mxtpu/knobs.py"))
+        return out
+
+
+# ----------------------------------------------------------------------
+# repo-level checks
+# ----------------------------------------------------------------------
+def readme_drift(root: Path) -> List[Finding]:
+    """README knob table must match ``knobs.readme_table()``
+    (regenerate with ``python -m tools.mxlint --fix-readme``)."""
+    knobs = load_knobs_module()
+    readme = root / "README.md"
+    if not readme.exists():
+        return [Finding("knob-readme-drift", "README.md", 1,
+                        "README.md missing")]
+    text = readme.read_text()
+    begin, end = knobs.TABLE_BEGIN, knobs.TABLE_END
+    if begin not in text or end not in text:
+        return [Finding(
+            "knob-readme-drift", "README.md", 1,
+            "README.md lacks the mxlint:knob-table markers — run "
+            "`python -m tools.mxlint --fix-readme`")]
+    current = text.split(begin, 1)[1].split(end, 1)[0]
+    want = knobs.readme_table().split(begin, 1)[1].split(end, 1)[0]
+    if current.strip() != want.strip():
+        line = text[:text.index(begin)].count("\n") + 1
+        return [Finding(
+            "knob-readme-drift", "README.md", line,
+            "README knob table is stale vs mxtpu/knobs.py — run "
+            "`python -m tools.mxlint --fix-readme`",
+            snippet="knob-table")]
+    return []
+
+
+def fix_readme(root: Path) -> bool:
+    """Rewrite the README table between the markers; returns True when
+    the file changed."""
+    knobs = load_knobs_module()
+    readme = root / "README.md"
+    text = readme.read_text()
+    begin, end = knobs.TABLE_BEGIN, knobs.TABLE_END
+    if begin not in text or end not in text:
+        raise SystemExit(
+            f"README.md lacks the markers {begin!r} … {end!r}; add "
+            f"them where the table should live")
+    head = text.split(begin, 1)[0]
+    tail = text.split(end, 1)[1]
+    new = head + knobs.readme_table() + tail
+    if new != text:
+        readme.write_text(new)
+        return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# registry of rules
+# ----------------------------------------------------------------------
+def file_rules() -> List[Rule]:
+    return [RetraceImpureCall(), RetraceTracedBranch(),
+            RetraceInlineJit(), RetraceConcretize(), HostSync(),
+            LockDiscipline(), KnobRawEnv(), KnobUnregistered()]
+
+
+def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:
+    return readme_drift(root)

@@ -138,7 +138,8 @@ def main():
         print(f"{name:>10} {str(cbf):>9} {xf:7.3f} {pf} "
               f"{xb:8.3f} {pb}")
 
-    if os.environ.get("MXTPU_PROBE_CONV", "1") == "0":
+    from mxtpu import knobs
+    if not knobs.get("MXTPU_PROBE_CONV"):
         return
     print("\nconv3x3+BN+relu chain (fwd+bwd, marginal ms/layer):")
     for name, C, H in stages[1:]:

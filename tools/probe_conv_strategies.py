@@ -90,7 +90,8 @@ def main():
         w = (jax.random.normal(jax.random.PRNGKey(1), (3, 3, C, C),
                                jnp.bfloat16) / (3 * C ** 0.5))
         fl = 2 * N * H * H * C * C * 9
-        ref = jax.jit(xla_conv)(x, w)
+        # one-shot probe script: per-call compiles are the point
+        ref = jax.jit(xla_conv)(x, w)  # mxlint: disable=retrace-inline-jit
         print(f"-- b{N} {H}x{H} C={C} ({fl/1e9:.0f} GFLOP) --")
         for name, fn in [("xla_conv", xla_conv),
                          ("shifted_gemm", shifted_gemm_conv),
@@ -99,7 +100,8 @@ def main():
                          ("pallas bn=16", functools.partial(pallas_conv,
                                                            bn=16))]:
             try:
-                got = jax.jit(lambda x: fn(x, w))(x)
+                got = jax.jit(  # mxlint: disable=retrace-inline-jit
+                    lambda x: fn(x, w))(x)
                 err = float(jnp.max(jnp.abs(
                     got.astype(jnp.float32) - ref.astype(jnp.float32))))
                 t = sustained(lambda x: fn(x, w), x, n=20)

@@ -57,7 +57,8 @@ def _build_bert(seqlen, dropout=0.1):
     tiny/base tiers exist so the harness itself can be smoke-tested on
     a CPU box where a Large compile takes minutes."""
     import mxtpu.models.transformer as tr
-    kind = os.environ.get("MXTPU_PROFILE_BERT_MODEL", "large")
+    from mxtpu import knobs
+    kind = knobs.get("MXTPU_PROFILE_BERT_MODEL")
     if kind == "tiny":
         return tr.BERTModel(30522, 128, 512, 2, 2, max_length=seqlen,
                             dropout=dropout)
