@@ -48,6 +48,7 @@ runs are swept at startup.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -836,6 +837,24 @@ def main():
         ["resnet50", "resnet50_pipeline", "bert", "bert_s512",
          "transformer", "lenet"]
     est_total = sum(_ROW_EST[m] for m in order)
+    if "--contracts" in sys.argv[1:]:
+        # fail FAST if any program drifted from its committed
+        # lockfile — a whole bench round against a silently changed
+        # program (a vanished reduce-scatter, a new layout bracket)
+        # records numbers nobody should trust.  Runs as a subprocess:
+        # hlocheck pins its own CPU-backend lowering environment and
+        # must not inherit this process's accelerator state.
+        rc = subprocess.call(
+            [sys.executable, "-m", "tools.hlocheck", "--check"],
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if rc != 0:
+            sys.exit(f"bench: --contracts gate failed (hlocheck "
+                     f"rc={rc}) — a compiled program drifted from "
+                     f"its lockfile; inspect `python -m "
+                     f"tools.hlocheck` and either fix the drift or "
+                     f"regenerate with --update before benching")
+        print("bench: --contracts gate passed (programs match "
+              "contracts/)")
     if "--preflight" in sys.argv[1:]:
         # Answer "will the selected sweep fit the wall budget?" without
         # touching the TPU.  Non-zero exit = the sweep as configured

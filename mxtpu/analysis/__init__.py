@@ -1,0 +1,115 @@
+"""mxtpu.analysis — static analysis over lowered/compiled XLA
+programs (ISSUE 6).
+
+Three layers:
+
+* :mod:`.hlo` — the structural HLO-text parser (the ONE in the tree);
+* :mod:`.summary` — deterministic program summaries across the five
+  rule families (collectives, custom-call brackets, dtype policy,
+  budgets, host transfers) plus the report-only bracket evidence
+  table;
+* :mod:`.contracts` — committed lockfiles under ``contracts/`` and
+  the check that compares a fresh summary against them
+  (``python -m tools.hlocheck`` is the CLI).
+
+Tests inspect compiled programs through :func:`compiled_summary` /
+:func:`compiled_evidence` rather than grepping ``hlo_text()``
+directly — mxlint's ``hlo-raw-assert`` rule enforces this.
+
+The runtime audit (:func:`maybe_audit`, knob ``MXTPU_HLO_AUDIT``)
+applies the contract-free hygiene subset — no host transfers, no f64
+creep, no bracketed custom calls — to every program ``TrainStep`` and
+serving's ``ModelRunner`` compile: ``1`` warns, ``2`` raises, unset
+costs nothing.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+from .hlo import HloProgram, parse_hlo
+from .summary import (BRACKET_OPS, COLLECTIVE_OPS, HOST_TRANSFER_OPS,
+                      audit_findings, bracket_evidence,
+                      format_evidence_table, summarize)
+from .contracts import (CONTRACTS_DIR, DEFAULT_TOLERANCES, Violation,
+                        check_contract, contract_path, load_contract,
+                        make_contract, save_contract)
+
+__all__ = [
+    "HloProgram", "parse_hlo", "summarize", "bracket_evidence",
+    "format_evidence_table", "audit_findings", "Violation",
+    "check_contract", "make_contract", "save_contract",
+    "load_contract", "contract_path", "CONTRACTS_DIR",
+    "DEFAULT_TOLERANCES", "COLLECTIVE_OPS", "BRACKET_OPS",
+    "HOST_TRANSFER_OPS", "mem_stats", "compiled_artifact",
+    "compiled_summary", "compiled_evidence", "maybe_audit",
+    "audit_mode",
+]
+
+
+def mem_stats(compiled) -> Optional[Dict[str, int]]:
+    """``memory_analysis()`` of a compiled program as the
+    ``hbm_peak``-bearing dict (same shape as
+    ``mxtpu.parallel._mem_stats``); None when the backend doesn't
+    report."""
+    from mxtpu.parallel import _mem_stats
+    return _mem_stats(compiled)
+
+
+def compiled_artifact(fn, *args, **jit_kwargs
+                      ) -> Tuple[str, Optional[Dict[str, int]]]:
+    """``(hlo_text, mem_stats)`` of ``fn`` lowered and compiled on
+    the current backend — the sanctioned route for tests that need a
+    compiled program (keeps raw ``.lower()``/``.hlo_text()`` calls
+    out of ``tests/``)."""
+    import jax
+    compiled = jax.jit(fn, **jit_kwargs).lower(*args).compile()
+    return compiled.as_text(), mem_stats(compiled)
+
+
+def compiled_summary(fn, *args, **jit_kwargs) -> Dict:
+    """Contract-shaped summary of ``fn`` compiled on the current
+    backend."""
+    text, mem = compiled_artifact(fn, *args, **jit_kwargs)
+    return summarize(text, mem)
+
+
+def compiled_evidence(fn, *args, **jit_kwargs) -> List[Dict[str, str]]:
+    """Custom-call bracket evidence rows for ``fn`` compiled on the
+    current backend."""
+    text, _ = compiled_artifact(fn, *args, **jit_kwargs)
+    return bracket_evidence(parse_hlo(text))
+
+
+# ----------------------------------------------------------------------
+# runtime audit (MXTPU_HLO_AUDIT)
+# ----------------------------------------------------------------------
+def audit_mode() -> int:
+    """0 off (default), 1 warn, 2 raise."""
+    from mxtpu import knobs
+    v = str(knobs.get("MXTPU_HLO_AUDIT")).strip().lower()
+    if v in ("", "0", "false", "off"):
+        return 0
+    return 2 if v == "2" else 1
+
+
+def maybe_audit(compiled, label: str = "",
+                mem: Optional[Dict[str, int]] = None
+                ) -> Optional[Dict]:
+    """Audit one freshly compiled program if ``MXTPU_HLO_AUDIT`` asks
+    for it; returns the summary (or None when the audit is off).
+    Called at compile sites only — compiles are rare and expensive,
+    so reading the knob here keeps the off path at zero overhead."""
+    mode = audit_mode()
+    if not mode:
+        return None
+    summ = summarize(compiled.as_text(),
+                     mem if mem is not None else mem_stats(compiled))
+    findings = audit_findings(summ, label)
+    if findings:
+        msg = "HLO audit: " + "; ".join(findings)
+        if mode >= 2:
+            from mxtpu.base import MXNetError
+            raise MXNetError(msg + " (MXTPU_HLO_AUDIT=2)")
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+    return summ

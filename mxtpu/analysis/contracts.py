@@ -1,0 +1,229 @@
+"""Contract lockfiles for compiled programs (``contracts/*.json``).
+
+A contract pins, per named program of a target (e.g. the
+``train_step`` of ``bert_zero``), the summary produced by
+``mxtpu.analysis.summarize``.  ``check_contract`` compares a fresh
+summary against the stored one under the five rule families:
+
+* ``collectives`` — exact match both ways.  A *vanished*
+  reduce-scatter is as alarming as a new all-reduce (it means ZeRO
+  silently fell back to the replicated path).
+* ``custom-call-bracket`` — per-target call count exact; bracketed
+  count may shrink (an improvement) but not grow.
+* ``dtype-policy`` — f64 op count and each upcast pair may not grow.
+* ``budget`` — fusion/instruction counts and peak bytes must stay
+  within ``stored * (1 + tolerance)``; dropping *below*
+  ``stored * (1 - tolerance)`` is reported as a notice (regenerate
+  the lockfile to bank the win), not a failure.
+* ``host-transfer`` — the transfer count may not grow.
+
+Violations fail ``--check``; notices don't.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+CONTRACTS_DIR = REPO_ROOT / "contracts"
+
+DEFAULT_TOLERANCES = {"fusion_count": 0.10,
+                      "instruction_count": 0.10,
+                      "peak_bytes": 0.10}
+
+
+class Violation:
+    __slots__ = ("rule", "target", "program", "message")
+
+    def __init__(self, rule: str, target: str, program: str,
+                 message: str):
+        self.rule = rule
+        self.target = target
+        self.program = program
+        self.message = message
+
+    def format(self) -> str:
+        return (f"{self.target}/{self.program}: [{self.rule}] "
+                f"{self.message}")
+
+    def as_json(self) -> Dict[str, str]:
+        return {"rule": self.rule, "target": self.target,
+                "program": self.program, "message": self.message}
+
+
+def make_contract(target: str,
+                  programs: Dict[str, Dict],
+                  tolerances: Optional[Dict[str, float]] = None
+                  ) -> Dict:
+    return {
+        "comment": "hlocheck lockfile -- regenerate with "
+                   f"`python -m tools.hlocheck --update {target}`",
+        "target": target,
+        "tolerances": dict(tolerances or DEFAULT_TOLERANCES),
+        "programs": programs,
+    }
+
+
+def contract_path(target: str,
+                  directory: Path = CONTRACTS_DIR) -> Path:
+    return directory / f"{target}.json"
+
+
+def save_contract(contract: Dict,
+                  directory: Path = CONTRACTS_DIR) -> Path:
+    directory.mkdir(parents=True, exist_ok=True)
+    path = contract_path(contract["target"], directory)
+    path.write_text(json.dumps(contract, indent=1, sort_keys=True)
+                    + "\n")
+    return path
+
+
+def load_contract(target: str,
+                  directory: Path = CONTRACTS_DIR) -> Dict:
+    return json.loads(contract_path(target, directory).read_text())
+
+
+def _check_collectives(stored: Dict, observed: Dict, target: str,
+                       program: str) -> List[Violation]:
+    out = []
+    for op in sorted(set(stored) | set(observed)):
+        s, o = stored.get(op), observed.get(op)
+        if s is None:
+            out.append(Violation(
+                "collectives", target, program,
+                f"new collective `{op}` not in contract: {o}"))
+        elif o is None:
+            out.append(Violation(
+                "collectives", target, program,
+                f"collective `{op}` vanished (contract has {s})"))
+        elif s != o:
+            out.append(Violation(
+                "collectives", target, program,
+                f"`{op}` drifted: contract {s} vs observed {o}"))
+    return out
+
+
+def _check_custom_calls(stored: Dict, observed: Dict, target: str,
+                        program: str) -> List[Violation]:
+    out = []
+    for tgt in sorted(set(stored) | set(observed)):
+        s, o = stored.get(tgt), observed.get(tgt)
+        if s is None:
+            out.append(Violation(
+                "custom-call-bracket", target, program,
+                f"new custom call `{tgt}` not in contract: {o}"))
+            continue
+        if o is None:
+            out.append(Violation(
+                "custom-call-bracket", target, program,
+                f"custom call `{tgt}` vanished (kernel silently "
+                f"off?); contract has {s}"))
+            continue
+        if o["count"] != s["count"]:
+            out.append(Violation(
+                "custom-call-bracket", target, program,
+                f"`{tgt}` call count {s['count']} -> {o['count']}"))
+        if o["bracketed"] > s["bracketed"]:
+            out.append(Violation(
+                "custom-call-bracket", target, program,
+                f"`{tgt}` grew layout brackets: {s['bracketed']} -> "
+                f"{o['bracketed']} transpose/copy/bitcast ops at the "
+                f"call boundary"))
+    return out
+
+
+def _check_dtype(stored: Dict, observed: Dict, target: str,
+                 program: str) -> List[Violation]:
+    out = []
+    if observed.get("f64_ops", 0) > stored.get("f64_ops", 0):
+        out.append(Violation(
+            "dtype-policy", target, program,
+            f"f64 ops grew {stored.get('f64_ops', 0)} -> "
+            f"{observed.get('f64_ops', 0)} (silent f32->f64 "
+            f"promotion)"))
+    s_up = stored.get("upcasts", {})
+    for pair, n in sorted(observed.get("upcasts", {}).items()):
+        if n > s_up.get(pair, 0):
+            out.append(Violation(
+                "dtype-policy", target, program,
+                f"upcast `{pair}` grew {s_up.get(pair, 0)} -> {n}"))
+    return out
+
+
+def _check_budgets(stored: Dict, observed: Dict, tol: Dict,
+                   target: str, program: str
+                   ) -> Tuple[List[Violation], List[str]]:
+    out, notices = [], []
+    for key in sorted(set(stored) | set(observed)):
+        s, o = stored.get(key), observed.get(key)
+        if s is None or o is None:
+            # a budget appearing/vanishing (e.g. backend stopped
+            # reporting memory stats) is drift worth failing on
+            out.append(Violation(
+                "budget", target, program,
+                f"budget `{key}`: contract {s} vs observed {o}"))
+            continue
+        t = tol.get(key, DEFAULT_TOLERANCES.get(key, 0.10))
+        if o > s * (1 + t):
+            out.append(Violation(
+                "budget", target, program,
+                f"`{key}` over budget: {o} > {s} (+{t:.0%} "
+                f"tolerance)"))
+        elif o < s * (1 - t):
+            notices.append(
+                f"{target}/{program}: `{key}` improved {s} -> {o} "
+                f"(>{t:.0%} under contract — regenerate the lockfile "
+                f"to bank it)")
+    return out, notices
+
+
+def _check_host(stored: Dict, observed: Dict, target: str,
+                program: str) -> List[Violation]:
+    if observed.get("count", 0) > stored.get("count", 0):
+        return [Violation(
+            "host-transfer", target, program,
+            f"host transfers grew {stored.get('count', 0)} -> "
+            f"{observed.get('count', 0)}: {observed.get('ops')}")]
+    return []
+
+
+def check_contract(contract: Dict,
+                   observed_programs: Dict[str, Dict]
+                   ) -> Tuple[List[Violation], List[str]]:
+    """(violations, notices) of observed summaries vs the lockfile."""
+    target = contract.get("target", "?")
+    tol = contract.get("tolerances", DEFAULT_TOLERANCES)
+    stored_programs = contract.get("programs", {})
+    violations: List[Violation] = []
+    notices: List[str] = []
+    for prog in sorted(set(stored_programs) | set(observed_programs)):
+        s, o = stored_programs.get(prog), observed_programs.get(prog)
+        if s is None:
+            violations.append(Violation(
+                "contract", target, prog,
+                "program not in contract — run --update"))
+            continue
+        if o is None:
+            violations.append(Violation(
+                "contract", target, prog,
+                "program in contract but not produced by the "
+                "target"))
+            continue
+        violations += _check_collectives(
+            s.get("collectives", {}), o.get("collectives", {}),
+            target, prog)
+        violations += _check_custom_calls(
+            s.get("custom_calls", {}), o.get("custom_calls", {}),
+            target, prog)
+        violations += _check_dtype(
+            s.get("dtype", {}), o.get("dtype", {}), target, prog)
+        v, n = _check_budgets(
+            s.get("budgets", {}), o.get("budgets", {}), tol,
+            target, prog)
+        violations += v
+        notices += n
+        violations += _check_host(
+            s.get("host_transfers", {}), o.get("host_transfers", {}),
+            target, prog)
+    return violations, notices

@@ -1,0 +1,219 @@
+"""Structural parser for post-optimization XLA HLO text.
+
+The ONE HLO parser in the tree (ISSUE 6): ``tests/test_zero.py``'s
+regex helpers and every future compiled-artifact check go through
+this module instead of re-growing ad-hoc ``re.findall`` over
+``hlo_text()``.  Scope is deliberately the dump format this repo's
+jaxlib emits from ``compiled.as_text()`` — instruction lines of the
+form::
+
+    [ROOT ]%name = <shape> opcode(operands), attr=..., metadata={...}
+
+grouped into computations (``ENTRY`` marks the entry one).  Unknown
+lines are skipped, not errors: the parser must survive dialect drift
+across jaxlib upgrades and report *less*, never crash.
+
+Pure stdlib — importable without jax so ``tools/hlocheck`` can check
+saved dumps and mxlint-adjacent tooling can reuse it.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# bytes per element for HLO primitive types (token/opaque count as 0)
+DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_FLOAT_WIDTH = {"f8e4m3fn": 1, "f8e5m2": 1, "f16": 2, "bf16": 2,
+                "f32": 4, "f64": 8}
+
+_NAME_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*")
+_SIMPLE_SHAPE_RE = re.compile(
+    r"[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?")
+_SHAPE_TOKEN_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OPCODE_RE = re.compile(r"\s*([\w\-]+)\s*\(")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+_STRING_RE = re.compile(r'"[^"]*"')
+
+
+def shape_elems(dims: Tuple[int, ...]) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+class Instruction:
+    """One HLO instruction: result shape(s), opcode, operand names,
+    raw attribute text."""
+
+    __slots__ = ("name", "opcode", "root", "shapes", "operands",
+                 "attrs", "target", "calls")
+
+    def __init__(self, name: str, opcode: str, root: bool,
+                 shapes: List[Tuple[str, Tuple[int, ...]]],
+                 operands: List[str], attrs: str):
+        self.name = name
+        self.opcode = opcode
+        self.root = root
+        self.shapes = shapes          # [(dtype, dims), ...]
+        self.operands = operands      # %-names used inside the parens
+        self.attrs = attrs            # raw text after the operand list
+        m = _TARGET_RE.search(attrs)
+        self.target: Optional[str] = m.group(1) if m else None
+        # computations referenced from attributes (calls=, to_apply=,
+        # body=/condition=, branch_computations={...}); attribute
+        # strings are stripped first so quoted text can't alias a name
+        self.calls: List[str] = _OPERAND_NAME_RE.findall(
+            _STRING_RE.sub('""', attrs))
+
+    def result_bytes(self) -> int:
+        return sum(DTYPE_BYTES.get(dt, 0) * shape_elems(dims)
+                   for dt, dims in self.shapes)
+
+    def result_elems(self) -> int:
+        return sum(shape_elems(dims) for dt, dims in self.shapes
+                   if dt in DTYPE_BYTES)
+
+    def dtypes(self) -> List[str]:
+        return [dt for dt, _ in self.shapes]
+
+
+class Computation:
+    __slots__ = ("name", "is_entry", "instructions", "by_name",
+                 "_consumers")
+
+    def __init__(self, name: str, is_entry: bool):
+        self.name = name
+        self.is_entry = is_entry
+        self.instructions: List[Instruction] = []
+        self.by_name: Dict[str, Instruction] = {}
+        self._consumers: Optional[Dict[str, List[Instruction]]] = None
+
+    def add(self, instr: Instruction) -> None:
+        self.instructions.append(instr)
+        self.by_name[instr.name] = instr
+
+    def consumers(self, name: str) -> List[Instruction]:
+        if self._consumers is None:
+            cons: Dict[str, List[Instruction]] = {}
+            for i in self.instructions:
+                for op in i.operands:
+                    cons.setdefault(op, []).append(i)
+            self._consumers = cons
+        return self._consumers.get(name, [])
+
+
+class HloProgram:
+    """All computations of one HLO module, entry marked."""
+
+    def __init__(self, computations: Dict[str, Computation],
+                 entry: Optional[str]):
+        self.computations = computations
+        self.entry_name = entry
+
+    @property
+    def entry(self) -> Optional[Computation]:
+        return self.computations.get(self.entry_name) \
+            if self.entry_name else None
+
+    def all_instructions(self) -> Iterable[Instruction]:
+        for comp in self.computations.values():
+            for instr in comp.instructions:
+                yield instr
+
+    def instruction_count(self) -> int:
+        return sum(len(c.instructions)
+                   for c in self.computations.values())
+
+    def count_opcode(self, opcode: str) -> int:
+        return sum(1 for i in self.all_instructions()
+                   if i.opcode == opcode)
+
+
+def _parse_instruction(line: str) -> Optional[Instruction]:
+    m = _NAME_RE.match(line)
+    if not m:
+        return None
+    root, name = bool(m.group(1)), m.group(2)
+    rest = line[m.end():]
+    # result shape: either a (possibly nested) tuple or a simple
+    # array/token shape with optional layout braces
+    if rest.startswith("("):
+        depth = 0
+        end = -1
+        for i, ch in enumerate(rest):
+            depth += (ch == "(") - (ch == ")")
+            if depth == 0:
+                end = i
+                break
+        if end < 0:
+            return None
+        shape_text, rest = rest[:end + 1], rest[end + 1:]
+    else:
+        sm = _SIMPLE_SHAPE_RE.match(rest)
+        if not sm:
+            return None
+        shape_text, rest = sm.group(0), rest[sm.end():]
+    om = _OPCODE_RE.match(rest)
+    if not om:
+        return None
+    opcode = om.group(1)
+    # operand list: balanced parens starting at the opcode's "("
+    start = om.end() - 1
+    depth = 0
+    end = -1
+    for i in range(start, len(rest)):
+        depth += (rest[i] == "(") - (rest[i] == ")")
+        if depth == 0:
+            end = i
+            break
+    if end < 0:
+        return None
+    operand_text = rest[start + 1:end]
+    attrs = rest[end + 1:]
+    shapes = [(dt, tuple(int(x) for x in dims.split(",") if x))
+              for dt, dims in _SHAPE_TOKEN_RE.findall(shape_text)]
+    operands = _OPERAND_NAME_RE.findall(operand_text)
+    return Instruction(name, opcode, root, shapes, operands, attrs)
+
+
+def parse_hlo(text: str) -> HloProgram:
+    """Parse ``compiled.as_text()`` output.  Lines that are neither a
+    computation header, an instruction, nor a closing brace are
+    ignored."""
+    computations: Dict[str, Computation] = {}
+    entry: Optional[str] = None
+    current: Optional[Computation] = None
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("//"):
+            continue
+        # computation header: `[ENTRY ]%name (params) -> type {` —
+        # instruction lines always contain " = " before any brace
+        if stripped.endswith("{") and " = " not in stripped:
+            hm = re.match(r"(ENTRY\s+)?%?([\w.\-]+)\s*\(", stripped)
+            if hm:
+                current = Computation(hm.group(2), bool(hm.group(1)))
+                computations[current.name] = current
+                if current.is_entry:
+                    entry = current.name
+            continue
+        if stripped.startswith("}"):
+            current = None
+            continue
+        if current is None:
+            continue
+        instr = _parse_instruction(line)
+        if instr is not None:
+            current.add(instr)
+    return HloProgram(computations, entry)

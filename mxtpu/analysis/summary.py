@@ -1,0 +1,239 @@
+"""Program summaries: the five rule families of ISSUE 6 distilled
+from one parsed HLO module.
+
+``summarize()`` produces the deterministic dict that becomes a
+contract lockfile (``contracts/*.json``): only opcode counts, byte
+totals, dtype pairs, and budgets — never instruction names, channel
+ids, or anything else XLA is free to renumber between lowerings
+(pinned by tests/test_analysis.py's two-lowering stability test).
+
+``bracket_evidence()`` is the report-only companion: the per-call-site
+table of transpose/copy/bitcast ops feeding or consuming custom calls
+that ROADMAP item 3 asks for.  It names instructions, so it stays out
+of the lockfile.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .hlo import (DTYPE_BYTES, _FLOAT_WIDTH, Computation, HloProgram,
+                  Instruction, parse_hlo, shape_elems)
+
+# collective ops inventoried exactly (async `-start` forms count once,
+# their `-done` halves are skipped)
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "all-to-all", "collective-permute",
+                  "collective-broadcast")
+
+# layout-shuffling ops that, adjacent to a custom call, mean XLA is
+# paying data movement to satisfy the call's operand/result layouts
+BRACKET_OPS = ("transpose", "copy", "bitcast", "bitcast-convert")
+
+# host <-> device traffic visible in the program itself
+HOST_TRANSFER_OPS = ("infeed", "outfeed", "send", "recv",
+                     "send-done", "recv-done")
+_HOST_TARGET_MARKERS = ("callback", "host", "infeed", "outfeed")
+
+
+def _fmt_shapes(instr: Instruction) -> str:
+    return ", ".join(f"{dt}[{','.join(str(d) for d in dims)}]"
+                     for dt, dims in instr.shapes)
+
+
+def _chase_gte(comp: Computation,
+               instr: Optional[Instruction]) -> Optional[Instruction]:
+    seen = 0
+    while instr is not None and \
+            instr.opcode == "get-tuple-element" and seen < 8:
+        instr = comp.by_name.get(instr.operands[0]) \
+            if instr.operands else None
+        seen += 1
+    return instr
+
+
+def _fusion_bracket_ops(program: HloProgram,
+                        fusion: Instruction) -> List[Instruction]:
+    out: List[Instruction] = []
+    for cname in fusion.calls:
+        comp = program.computations.get(cname)
+        if comp is None:
+            continue
+        out.extend(i for i in comp.instructions
+                   if i.opcode in BRACKET_OPS)
+    return out
+
+
+def bracket_evidence(program: HloProgram) -> List[Dict[str, str]]:
+    """Per-call-site rows: every transpose/copy/bitcast directly
+    feeding or consuming a custom call (get-tuple-element hops are
+    transparent; a fusion neighbour is inspected for bracket ops it
+    hides).  Row keys: target, call, side (feeds/consumes), op,
+    shape, via ("" or the wrapping fusion's name)."""
+    rows: List[Dict[str, str]] = []
+
+    def add(call: Instruction, side: str, op: Instruction,
+            via: str = "") -> None:
+        rows.append({"target": call.target or "<unknown>",
+                     "call": call.name, "side": side,
+                     "op": op.opcode, "shape": _fmt_shapes(op),
+                     "via": via})
+
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode != "custom-call":
+                continue
+            for opname in instr.operands:
+                p = _chase_gte(comp, comp.by_name.get(opname))
+                if p is None:
+                    continue
+                if p.opcode in BRACKET_OPS:
+                    add(instr, "feeds", p)
+                elif p.opcode == "fusion":
+                    for b in _fusion_bracket_ops(program, p):
+                        add(instr, "feeds", b, via=p.name)
+            for u in comp.consumers(instr.name):
+                chain = [u]
+                if u.opcode == "get-tuple-element":
+                    chain = comp.consumers(u.name)
+                for c in chain:
+                    if c.opcode in BRACKET_OPS:
+                        add(instr, "consumes", c)
+                    elif c.opcode == "fusion":
+                        for b in _fusion_bracket_ops(program, c):
+                            add(instr, "consumes", b, via=c.name)
+    return rows
+
+
+def format_evidence_table(rows: List[Dict[str, str]]) -> str:
+    """The human-readable bracket report (BASELINE.md format)."""
+    if not rows:
+        return "(no bracket ops adjacent to custom calls)"
+    head = ("target", "side", "op", "shape", "via")
+    widths = [max(len(h), *(len(r[h]) for r in rows)) for h in head]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*head), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(r[h] for h in head)) for r in rows]
+    return "\n".join(lines)
+
+
+def _is_host_custom_call(target: str) -> bool:
+    t = target.lower()
+    return any(m in t for m in _HOST_TARGET_MARKERS)
+
+
+def summarize(program: Union[str, HloProgram],
+              mem: Optional[Dict[str, int]] = None) -> Dict:
+    """The contract view of one compiled program.
+
+    ``mem`` is the ``_mem_stats``-shaped dict (``hbm_peak`` = temp +
+    argument bytes); without it the peak-bytes budget is omitted.
+    Every field is deterministic across lowerings of the same
+    program.
+    """
+    if isinstance(program, str):
+        program = parse_hlo(program)
+
+    collectives: Dict[str, Dict[str, int]] = {}
+    custom_calls: Dict[str, Dict[str, int]] = {}
+    converts: Dict[str, int] = {}
+    f64_ops = 0
+    host_ops: Dict[str, int] = {}
+    fusion_count = 0
+
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            op = instr.opcode
+            if any(dt == "f64" for dt in instr.dtypes()):
+                f64_ops += 1
+            if op.endswith("-done"):
+                base = op[:-5]
+                if base in COLLECTIVE_OPS:
+                    continue  # counted at the -start half
+            kind = op[:-6] if op.endswith("-start") else op
+            if kind in COLLECTIVE_OPS:
+                slot = collectives.setdefault(
+                    kind, {"count": 0, "bytes": 0, "max_elems": 0})
+                slot["count"] += 1
+                slot["bytes"] += instr.result_bytes()
+                slot["max_elems"] = max(slot["max_elems"],
+                                        instr.result_elems())
+                continue
+            if op == "fusion":
+                fusion_count += 1
+            elif op == "custom-call":
+                tgt = instr.target or "<unknown>"
+                slot = custom_calls.setdefault(
+                    tgt, {"count": 0, "bracketed": 0})
+                slot["count"] += 1
+                if _is_host_custom_call(tgt):
+                    host_ops[tgt] = host_ops.get(tgt, 0) + 1
+            elif op in HOST_TRANSFER_OPS:
+                host_ops[op] = host_ops.get(op, 0) + 1
+            elif op == "convert" and instr.operands:
+                src = comp.by_name.get(instr.operands[0])
+                src_dt = src.shapes[0][0] if src and src.shapes \
+                    else "?"
+                dst_dt = instr.shapes[0][0] if instr.shapes else "?"
+                converts[f"{src_dt}->{dst_dt}"] = converts.get(
+                    f"{src_dt}->{dst_dt}", 0) + 1
+
+    for row in bracket_evidence(program):
+        slot = custom_calls.get(row["target"])
+        if slot is not None:
+            slot["bracketed"] += 1
+
+    upcasts = {pair: n for pair, n in converts.items()
+               if _is_upcast(pair)}
+    out = {
+        "collectives": {k: collectives[k] for k in sorted(collectives)},
+        "custom_calls": {k: custom_calls[k]
+                         for k in sorted(custom_calls)},
+        "dtype": {"f64_ops": f64_ops,
+                  "upcasts": {k: upcasts[k] for k in sorted(upcasts)},
+                  "converts": {k: converts[k]
+                               for k in sorted(converts)}},
+        "budgets": {"instruction_count": program.instruction_count(),
+                    "fusion_count": fusion_count},
+        "host_transfers": {"count": sum(host_ops.values()),
+                           "ops": {k: host_ops[k]
+                                   for k in sorted(host_ops)}},
+    }
+    if mem:
+        out["budgets"]["peak_bytes"] = int(
+            mem.get("hbm_peak") or
+            (mem.get("temp_size_in_bytes", 0) +
+             mem.get("argument_size_in_bytes", 0)))
+    return out
+
+
+def _is_upcast(pair: str) -> bool:
+    src, _, dst = pair.partition("->")
+    return (src in _FLOAT_WIDTH and dst in _FLOAT_WIDTH and
+            _FLOAT_WIDTH[dst] > _FLOAT_WIDTH[src])
+
+
+def audit_findings(summary: Dict, label: str = "") -> List[str]:
+    """Program-hygiene findings for the runtime audit knob
+    (``MXTPU_HLO_AUDIT``): properties that should hold for EVERY
+    production program, contract or not — no host transfers inside
+    the compiled step, no f64 creep, no layout brackets around custom
+    calls."""
+    where = f" in {label}" if label else ""
+    out: List[str] = []
+    ht = summary.get("host_transfers", {})
+    if ht.get("count"):
+        out.append(f"host transfer(s){where}: {ht.get('ops')} — the "
+                   f"compiled step should never round-trip the host")
+    f64 = summary.get("dtype", {}).get("f64_ops", 0)
+    if f64:
+        out.append(f"{f64} f64 op(s){where} — silent f32->f64 "
+                   f"promotion (check jax_enable_x64 and np scalar "
+                   f"leaks)")
+    bracketed = {t: s["bracketed"]
+                 for t, s in summary.get("custom_calls", {}).items()
+                 if s.get("bracketed")}
+    if bracketed:
+        out.append(f"custom call(s) bracketed by transpose/copy/"
+                   f"bitcast{where}: {bracketed} — XLA is paying "
+                   f"layout movement at the kernel boundary")
+    return out

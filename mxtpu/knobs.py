@@ -148,6 +148,14 @@ register("MXTPU_GUARDS_CHURN_LIMIT", 10, "int",
          "Compiles tolerated per guarded jit entry before the "
          "recompile-churn guard fires (ModelRunner adds its bucket-"
          "ladder size).", "guards")
+register("MXTPU_HLO_AUDIT", "", "str",
+         "Static HLO audit (mxtpu.analysis) of every program "
+         "TrainStep / serving ModelRunner compiles: `1` warn when "
+         "the compiled step contains host transfers, f64 creep, or "
+         "custom calls bracketed by transpose/copy; `2` raise; "
+         "unset/`0` = off with zero overhead.  Contract checks "
+         "against committed lockfiles live in `python -m "
+         "tools.hlocheck`.", "guards")
 
 # -- numerics / engine -------------------------------------------------
 register("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice", "str",

@@ -609,6 +609,8 @@ class TrainStep:
                 y_raw).compile()
             mem = _mem_stats(fn)
             self._last_mem = mem
+            from mxtpu import analysis
+            analysis.maybe_audit(fn, label="TrainStep", mem=mem)
         else:
             # learn the aux structure without device work
             jax.eval_shape(step, train_vals, frozen_vals,
@@ -913,6 +915,9 @@ class TrainStep:
                     train_vals, frozen_vals, self._opt_state, keys,
                     lrs, wds, xs, ys).compile()
                 self._last_mem = _mem_stats(multi)
+                from mxtpu import analysis
+                analysis.maybe_audit(multi, label="TrainStep.run_steps",
+                                     mem=self._last_mem)
             self._compiled[msig] = multi
         if self._guards:
             self._churn.note_call()
@@ -980,10 +985,23 @@ class TrainStep:
 
     def hlo_text(self, x, y):
         """Compiled HLO of the one-step program for this batch
-        signature — the artifact the comm-layout regression tests
-        grep (reduce-scatter/all-gather under ZeRO-1, all-reduce on
-        the replicated path)."""
+        signature.  Tests should prefer :meth:`program_summary` —
+        mxlint's ``hlo-raw-assert`` rule bans regexing this text in
+        ``tests/``."""
         return self._compiled_for(x, y).as_text()
+
+    def program_summary(self, x, y):
+        """Contract-shaped static summary (``mxtpu.analysis``) of the
+        one-step compiled program for this batch signature:
+        collective inventory, custom-call brackets, dtype policy,
+        fusion/memory budgets, host transfers.  What the comm-layout
+        regression tests assert on (reduce-scatter/all-gather under
+        ZeRO-1, all-reduce on the replicated path) instead of
+        grepping ``hlo_text``."""
+        from mxtpu import analysis
+        compiled = self._compiled_for(x, y)
+        return analysis.summarize(compiled.as_text(),
+                                  _mem_stats(compiled))
 
     def last_memory_analysis(self):
         """Memory stats of the most recently compiled program (the

@@ -270,6 +270,13 @@ class ModelRunner:
             self.compile_seconds[bucket] = time.perf_counter() - t0
             entry = {"compiled": compiled, "in_structs": in_structs}
             self._entries[bucket] = entry
+            # MXTPU_HLO_AUDIT: static hygiene pass over every bucket
+            # executable as it is born (warmup() therefore audits the
+            # whole ladder) — no host transfers, no f64 creep, no
+            # layout-bracketed custom calls
+            from mxtpu import analysis
+            analysis.maybe_audit(compiled,
+                                 label=f"ModelRunner{bucket}")
             return entry
 
     def warmup(self, buckets: Optional[Sequence[Tuple]] = None
@@ -375,6 +382,21 @@ class ModelRunner:
         return bucket, host
 
     # -- introspection ----------------------------------------------------
+    def program_artifact(self, bucket: Tuple):
+        """``(hlo_text, mem_stats)`` of one bucket's compiled
+        executable (compiling it if cold) — what tools/hlocheck
+        summarizes into the serving contract."""
+        from mxtpu import analysis
+        compiled = self._entry(tuple(bucket))["compiled"]
+        return compiled.as_text(), analysis.mem_stats(compiled)
+
+    def program_summary(self, bucket: Tuple):
+        """Contract-shaped static summary (``mxtpu.analysis``) of one
+        bucket's compiled executable."""
+        from mxtpu import analysis
+        text, mem = self.program_artifact(bucket)
+        return analysis.summarize(text, mem)
+
     def num_compiled(self) -> int:
         with self._lock:
             return len(self._entries)

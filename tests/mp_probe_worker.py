@@ -1,0 +1,51 @@
+"""Backend-capability probe for the multi-process tests: can THIS
+jaxlib's CPU client execute a computation over a cross-process global
+array?  Some jaxlib builds refuse with "Multiprocess computations
+aren't implemented on the CPU backend" — a backend limitation, not a
+bug in the code paths under test.  The probe runs ONE jitted
+reduction over a global array spanning both processes and prints
+MP_PROBE_OK on success; the pytest parent turns a refusal into a
+skip-with-reason instead of a failure."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.array(jax.devices())  # GLOBAL devices, all processes
+    mesh = Mesh(devices, ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    n = len(devices)
+    host = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    idx_map = sharding.addressable_devices_indices_map(host.shape)
+    shards = [jax.device_put(host[idx], d)
+              for d, idx in idx_map.items()]
+    garr = jax.make_array_from_single_device_arrays(
+        host.shape, sharding, shards)
+    # the probe moment: a multiprocess computation.  Unsupported CPU
+    # clients raise XlaRuntimeError INVALID_ARGUMENT here.
+    total = jax.jit(lambda a: a.sum())(garr)
+    expect = float(host.sum())
+    got = float(total)
+    assert abs(got - expect) < 1e-5, (got, expect)
+    print("MP_PROBE_OK", jax.process_index(), got, flush=True)
+
+
+if __name__ == "__main__":
+    main()

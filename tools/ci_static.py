@@ -1,0 +1,48 @@
+"""One CI gate for the static tier: source lint + compiled-program
+contracts.
+
+``python -m tools.ci_static`` (or ``python tools/ci_static.py``) runs
+
+* ``python -m tools.mxlint --check``  (AST rules over the tree), then
+* ``python -m tools.hlocheck --check`` (lowered programs vs the
+  committed ``contracts/`` lockfiles),
+
+prints one PASS/FAIL line per stage, and exits non-zero if either
+failed — the single entry point a CI job or pre-push hook needs.
+Extra arguments are forwarded to BOTH tools (e.g. ``--json``).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = (
+    ("mxlint", ("-m", "tools.mxlint", "--check")),
+    ("hlocheck", ("-m", "tools.hlocheck", "--check")),
+)
+
+
+def main(argv=None) -> int:
+    extra = list(sys.argv[1:] if argv is None else argv)
+    failed = []
+    for name, args in STAGES:
+        cmd = [sys.executable, *args, *extra]
+        print(f"ci_static: {name}: {' '.join(cmd[1:])}", flush=True)
+        rc = subprocess.call(cmd, cwd=REPO_ROOT)
+        print(f"ci_static: {name}: "
+              f"{'PASS' if rc == 0 else f'FAIL (rc={rc})'}",
+              flush=True)
+        if rc != 0:
+            failed.append(name)
+    if failed:
+        print(f"ci_static: FAILED: {', '.join(failed)}")
+        return 1
+    print("ci_static: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""tools/hlocheck — compiled-program contracts (ISSUE 6).
+
+``python -m tools.hlocheck [--check|--update|--json] [targets...]``
+lowers the registered model x config targets on the CPU backend,
+summarizes each compiled program with ``mxtpu.analysis``, and
+compares (or rewrites) the committed lockfiles in ``contracts/``.
+Same 0/1/2 exit contract as ``tools/mxlint``.
+"""

@@ -1,0 +1,159 @@
+"""hlocheck CLI.
+
+Exit codes (the contract tests/test_analysis.py pins, mirroring
+mxlint):
+
+* 0 — every checked target matches its lockfile;
+* 1 — contract violations (or missing lockfile in --check mode);
+* 2 — usage / internal error (unknown target, unreadable contract).
+
+``--update`` rebuilds the named targets (default: all) and rewrites
+``contracts/<target>.json``; the default mode re-lowers and checks.
+Programs are always lowered on the CPU backend with the same
+8-virtual-device topology the test suite uses, so lockfiles are
+reproducible on any box regardless of what accelerator the caller's
+environment points at.
+"""
+from __future__ import annotations
+
+import os
+
+# pin the lowering environment BEFORE jax (imported via mxtpu) loads:
+# contracts are CPU-backend artifacts by definition
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse   # noqa: E402
+import json       # noqa: E402
+import sys        # noqa: E402
+import time       # noqa: E402
+from pathlib import Path  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hlocheck",
+        description="Static analysis over compiled XLA programs "
+                    "against committed contract lockfiles "
+                    "(collectives, custom-call brackets, dtype "
+                    "policy, budgets, host transfers).")
+    ap.add_argument("targets", nargs="*",
+                    help="targets to process (default: every "
+                         "committed contract for --check, every "
+                         "registered target for --update)")
+    ap.add_argument("--check", action="store_true",
+                    help="counts-only output; exit 1 on violations "
+                         "(CI mode — this is also the default "
+                         "behaviour)")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate lockfiles for the named "
+                         "targets and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit results as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets and exit")
+    ap.add_argument("--contracts-dir", type=Path, default=None,
+                    help="lockfile directory (default: contracts/)")
+    args = ap.parse_args(argv)
+
+    from mxtpu.analysis import contracts as C
+    from . import targets as T
+
+    directory = args.contracts_dir or C.CONTRACTS_DIR
+
+    if args.list:
+        for name in sorted(T.TARGETS):
+            state = "contract" if C.contract_path(
+                name, directory).exists() else "NO CONTRACT"
+            print(f"{name:20s} [{state}]  "
+                  f"{(T.TARGETS[name].__doc__ or '').strip().splitlines()[0]}")
+        return 0
+
+    if args.targets:
+        unknown = [t for t in args.targets if t not in T.TARGETS]
+        if unknown:
+            print(f"hlocheck: unknown target(s): "
+                  f"{', '.join(unknown)} (see --list)",
+                  file=sys.stderr)
+            return 2
+        names = list(args.targets)
+    elif args.update:
+        names = sorted(T.TARGETS)
+    else:
+        # check everything that has a committed lockfile AND is still
+        # a registered target; a contract whose target vanished is an
+        # error, not silence
+        names = sorted(p.stem for p in directory.glob("*.json"))
+        orphans = [n for n in names if n not in T.TARGETS]
+        if orphans:
+            print(f"hlocheck: contract(s) without a registered "
+                  f"target: {', '.join(orphans)}", file=sys.stderr)
+            return 2
+        if not names:
+            print(f"hlocheck: no contracts in {directory} — run "
+                  f"--update first", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    all_violations, all_notices = [], []
+    results = {}
+    for name in names:
+        t1 = time.perf_counter()
+        observed = T.build(name)
+        dt = time.perf_counter() - t1
+        if args.update:
+            path = C.save_contract(
+                C.make_contract(name, observed), directory)
+            results[name] = {"updated": str(path),
+                             "programs": sorted(observed),
+                             "seconds": round(dt, 1)}
+            if not args.as_json:
+                print(f"hlocheck: wrote {path} "
+                      f"({len(observed)} program(s), {dt:.1f}s)")
+            continue
+        try:
+            contract = C.load_contract(name, directory)
+        except FileNotFoundError:
+            all_violations.append(C.Violation(
+                "contract", name, "*",
+                f"no lockfile {C.contract_path(name, directory)} — "
+                f"run --update {name}"))
+            continue
+        except (ValueError, OSError) as e:
+            print(f"hlocheck: cannot read contract for {name}: {e}",
+                  file=sys.stderr)
+            return 2
+        violations, notices = C.check_contract(contract, observed)
+        all_violations += violations
+        all_notices += notices
+        results[name] = {
+            "violations": [v.as_json() for v in violations],
+            "notices": notices, "seconds": round(dt, 1)}
+        if not args.as_json and not args.check:
+            print(f"hlocheck: {name}: {len(violations)} violation(s)"
+                  f" ({dt:.1f}s)")
+
+    dt = time.perf_counter() - t0
+    if args.update:
+        if args.as_json:
+            print(json.dumps(results, indent=1))
+        return 0
+    if args.as_json:
+        print(json.dumps({"results": results,
+                          "seconds": round(dt, 1)}, indent=1))
+    else:
+        for n in all_notices:
+            print(f"  note: {n}")
+        for v in all_violations:
+            print("  " + v.format())
+        print(f"hlocheck: {len(names)} target(s), "
+              f"{len(all_violations)} violation(s), "
+              f"{len(all_notices)} notice(s) ({dt:.1f}s)")
+    return 1 if all_violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""hlocheck target registry: the named model x config programs whose
+compiled HLO is pinned by a lockfile in ``contracts/``.
+
+Every target is a zero-argument builder returning
+``{program_name: (hlo_text, mem_stats_dict_or_None)}``.  Builders run
+on the CPU backend with the 8-virtual-device mesh the CLI pins
+(``__main__`` sets ``JAX_PLATFORMS``/``XLA_FLAGS`` before jax loads),
+so a lockfile regenerated on any box matches CI.
+
+The models are *tiny stand-ins* for the bench configurations — same
+code paths (ZeRO shard_map step, batched optimizer, fused epilogues,
+serving bucket ladder), scaled so the whole ``--check`` sweep lowers
+in a couple of minutes on CPU.  Contract properties (which
+collectives, dtype policy, zero host transfers) are scale-invariant;
+budget properties (fusion counts, peak bytes) pin the tiny config's
+numbers, which still move when the underlying compilation strategy
+changes — that is the regression-tripwire the lockfile exists for.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+Artifact = Tuple[str, Optional[dict]]
+Builder = Callable[[], Dict[str, Artifact]]
+
+TARGETS: Dict[str, Builder] = {}
+
+
+def register_target(name: str):
+    def deco(fn: Builder) -> Builder:
+        TARGETS[name] = fn
+        return fn
+    return deco
+
+
+def build(name: str) -> Dict[str, dict]:
+    """Summaries (contract-shaped) for every program of ``name``."""
+    from mxtpu.analysis import summarize
+    artifacts = TARGETS[name]()
+    return {prog: summarize(text, mem)
+            for prog, (text, mem) in sorted(artifacts.items())}
+
+
+# ----------------------------------------------------------------------
+# shared pieces
+# ----------------------------------------------------------------------
+_VOCAB = 512
+
+
+def _mlm_loss():
+    from mxtpu.gluon import loss as gloss
+    ce = gloss.SoftmaxCrossEntropyLoss()
+
+    def loss(pred, y):
+        return ce(pred.reshape((-1, _VOCAB)), y.reshape((-1,)))
+    return loss
+
+
+def _train_step_artifact(step, x, y) -> Artifact:
+    return step.hlo_text(x, y), step.memory_analysis(x, y)
+
+
+def _bert_step(zero: int) -> Dict[str, Artifact]:
+    import jax
+    from mxtpu import nd, parallel
+    from mxtpu.models.transformer import BERTModel
+    net = BERTModel(_VOCAB, 64, 128, 2, 2, max_length=32,
+                    dropout=0.1)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, _VOCAB, (8, 16)).astype(np.float32))
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+    step = parallel.build_train_step(
+        net, _mlm_loss(), "adam", {"learning_rate": 1e-3},
+        mesh=mesh, cast_batch=False, zero=zero)
+    return {"train_step": _train_step_artifact(step, x, x)}
+
+
+@register_target("bert_replicated")
+def bert_replicated() -> Dict[str, Artifact]:
+    """Tiny BERT, dp8 data-parallel with replicated optimizer states
+    (the pre-ZeRO path: gradient all-reduce)."""
+    return _bert_step(zero=0)
+
+
+@register_target("bert_zero")
+def bert_zero() -> Dict[str, Artifact]:
+    """Tiny BERT, dp8 ZeRO-1: reduce-scatter + all-gather per bucket,
+    no big all-reduce — the comm signature tests/test_zero.py pins."""
+    return _bert_step(zero=1)
+
+
+@register_target("transformer")
+def transformer() -> Dict[str, Artifact]:
+    """Tiny encoder-decoder transformer (the bench `transformer` row's
+    shape: src|tgt concatenated on the time axis)."""
+    from mxtpu import nd, parallel
+    from mxtpu.gluon.block import HybridBlock
+    from mxtpu.models.transformer import TransformerModel
+
+    class MTWrap(HybridBlock):
+        def __init__(self, split, **kw):
+            super().__init__(**kw)
+            self._split = split
+            self.model = TransformerModel(
+                _VOCAB, units=64, hidden_size=128, num_layers=2,
+                num_heads=2, max_length=64, dropout=0.1)
+
+        def hybrid_forward(self, F, x):
+            src = F.slice_axis(x, axis=1, begin=0, end=self._split)
+            tgt = F.slice_axis(x, axis=1, begin=self._split,
+                               end=None)
+            return self.model(src, tgt)
+
+    net = MTWrap(16)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, _VOCAB, (4, 32)).astype(np.float32))
+    y = nd.array(rng.randint(0, _VOCAB, (4, 16)).astype(np.float32))
+    step = parallel.build_train_step(
+        net, _mlm_loss(), "adam", {"learning_rate": 1e-4},
+        cast_batch=False)
+    return {"train_step": _train_step_artifact(step, x, y)}
+
+
+@register_target("resnet18")
+def resnet18() -> Dict[str, Artifact]:
+    """resnet18 thumbnail (BN-heavy conv net — the fused-BN bracket
+    watchpoint of ROADMAP item 3)."""
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import loss as gloss
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, 3, 32, 32).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
+    step = parallel.build_train_step(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9})
+    return {"train_step": _train_step_artifact(step, x, y)}
+
+
+@register_target("serving_bert")
+def serving_bert() -> Dict[str, Artifact]:
+    """Serving bucket ladder: tiny exported BERT through
+    ModelRunner's AOT (batch, seq) executables — every bucket gets
+    its own contract entry."""
+    import os
+    import tempfile
+    from mxtpu import nd
+    from mxtpu.models.transformer import BERTModel
+    from mxtpu.serving import ModelRunner
+    net = BERTModel(_VOCAB, 64, 128, 2, 2, max_length=32,
+                    dropout=0.0)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    net(nd.array(rng.randint(0, _VOCAB, (1, 32))
+                 .astype(np.float32)))
+    d = tempfile.mkdtemp(prefix="hlocheck_bert_")
+    sym_file, param_file = net.export(os.path.join(d, "bert"))
+    runner = ModelRunner.from_export(
+        sym_file, param_file, input_specs={"data": (None,)},
+        seq_buckets=[16, 32], max_batch_size=4)
+    runner.warmup()
+    out: Dict[str, Artifact] = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        text, mem = runner.program_artifact(bucket)
+        out[f"bucket_b{batch}_s{seq}"] = (text, mem)
+    return out
+
+
+@register_target("selftest")
+def selftest() -> Dict[str, Artifact]:
+    """A deliberately small program that exercises every summary
+    family in milliseconds: a lapack custom call (the CPU backend's
+    genuine custom-call + layout-bracket specimen), fusions, and a
+    clean f32 dtype story.  Keeps one end-to-end CLI round trip
+    cheap enough for tier-1."""
+    import jax.numpy as jnp
+    from mxtpu.analysis import compiled_artifact
+
+    def f(a, b):
+        w, v = jnp.linalg.eigh(a.T @ a)
+        return ((v * w).sum() + (a @ b).sum())
+
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    b = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    text, mem = compiled_artifact(f, a, b)
+    return {"eigh_matmul": (text, mem)}

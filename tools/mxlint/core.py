@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, \
     Tuple
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
-DEFAULT_PATHS = ("mxtpu", "tools", "bench.py")
+DEFAULT_PATHS = ("mxtpu", "tools", "bench.py", "tests")
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
 _SUPPRESS_RE = re.compile(r"#\s*mxlint:\s*disable=([\w\-, ]+)")
@@ -111,6 +111,14 @@ class Rule:
     whole repo)."""
 
     name = ""
+
+    def applies(self, ctx: FileCtx) -> bool:
+        """Scope gate.  The source-hygiene rules audit the shipped
+        tree, not the test suite (tests legitimately poke monkeys:
+        raw env reads in conftest, deliberate traced branches in
+        regression repros); test-suite-specific rules override this
+        to target ``tests/`` instead."""
+        return not ctx.rel.startswith("tests/")
 
     def check(self, ctx: FileCtx) -> List[Finding]:
         return []
@@ -222,6 +230,8 @@ def lint_repo(paths: Sequence[str] = DEFAULT_PATHS,
     per_file = R.file_rules()
     for ctx in ctxs:
         for rule in per_file:
+            if not rule.applies(ctx):
+                continue
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.rule, f.line):
                     if not f.snippet:

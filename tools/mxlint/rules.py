@@ -485,6 +485,47 @@ class KnobUnregistered(_KnobRuleBase):
 
 
 # ----------------------------------------------------------------------
+# compiled-artifact discipline (tests/ only)
+# ----------------------------------------------------------------------
+class HloRawAssert(Rule):
+    """Tests must not inspect compiled artifacts raw: ``.hlo_text(``
+    / ``.as_text(`` grepping and manual ``.lower(x)`` chains in
+    ``tests/`` fragment the HLO-parsing story ISSUE 6 consolidated
+    into ``mxtpu.analysis`` (``program_summary`` /
+    ``compiled_summary`` / ``compiled_evidence``).  Argument-less
+    ``.lower()`` is string casing and stays exempt.  Suppress a
+    deliberate exception with ``# mxlint: disable=hlo-raw-assert``."""
+
+    name = "hlo-raw-assert"
+    _TEXT_ATTRS = ("hlo_text", "as_text")
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return ctx.rel.startswith("tests/")
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._TEXT_ATTRS:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"raw `.{attr}()` in a test — assert on "
+                    f"`program_summary()` / "
+                    f"`mxtpu.analysis.compiled_summary` instead"))
+            elif attr == "lower" and (node.args or node.keywords):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "manual `.lower(...)` in a test — use "
+                    "`mxtpu.analysis.compiled_artifact` (or the "
+                    "TrainStep/ModelRunner summary APIs) so contract "
+                    "checks stay on one parser"))
+        return out
+
+
+# ----------------------------------------------------------------------
 # repo-level checks
 # ----------------------------------------------------------------------
 def readme_drift(root: Path) -> List[Finding]:
@@ -540,7 +581,8 @@ def fix_readme(root: Path) -> bool:
 def file_rules() -> List[Rule]:
     return [RetraceImpureCall(), RetraceTracedBranch(),
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
-            LockDiscipline(), KnobRawEnv(), KnobUnregistered()]
+            LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
+            HloRawAssert()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:
