@@ -5,10 +5,10 @@ training throughput** (north-star #1, BASELINE.md); the BERT-Large
 (north-star #2) and LeNet numbers ride along in ``extras`` so every
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
 MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
-transformer|moe_ffn|ssd|bert_zero|serving_bert to run a single
-workload (moe_ffn, ssd, bert_zero and serving_bert are on-demand only
-— not part of the default ``all`` sweep, which is sized to the wall
-budget).  Every row's ``details``
+transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet to run a
+single workload (moe_ffn, ssd, bert_zero, serving_bert and
+serving_fleet are on-demand only — not part of the default ``all``
+sweep, which is sized to the wall budget).  Every row's ``details``
 carries ``hbm_peak`` — the per-device resident high-water
 (temp + argument bytes) of the compiled program, from XLA's
 memory_analysis.  ``bench.py --preflight`` prints the per-row wall
@@ -80,6 +80,7 @@ _METRIC_NAMES = {
     "ssd": "ssd300_voc_train_throughput",
     "bert_zero": "bert_large_zero1_train_throughput",
     "serving_bert": "serving_bert_sustained_throughput",
+    "serving_fleet": "serving_fleet_soak_throughput",
     "lenet": "lenet_mnist_train_throughput",
 }
 
@@ -109,6 +110,8 @@ _TRAIN_FLOPS = {
                               # MFU would flatter the conv backbone
     "serving_bert": None,     # latency/throughput row — the served/raw
                               # ratio is the result, not MFU
+    "serving_fleet": None,    # robustness row — zero in-deadline drops
+                              # through a kill/restart is the result
     "lenet": None,            # too small for MFU to mean anything
 }
 
@@ -777,6 +780,132 @@ def bench_serving_bert(seq_len=64, max_batch=8, repeats=3):
     return stats, _METRIC_NAMES["serving_bert"], "req/sec"
 
 
+def bench_serving_fleet(n_workers=3, n_req=600, repeats=3):
+    """Fault-tolerant fleet soak row (on-demand,
+    MXTPU_BENCH_MODEL=serving_fleet): open-loop traffic against a
+    :class:`FleetRouter` over ``n_workers`` workers while one worker
+    is KILLED mid-run (preemption) and a warm replacement is attached
+    from the victim's compiled-ladder handoff.
+
+    The acceptance contract (ISSUE 7): ZERO in-deadline requests
+    dropped or hanging across the kill/restart — every submitted
+    request either completes with a correct result or fails its own
+    deadline, none blocks forever.  The primary value is sustained
+    served req/sec THROUGH the failure; ``details`` carries
+    p50/p95/p99 end-to-end latency and the recovery counters
+    (retries, requeues, deaths, drains) the router aggregates."""
+    from mxtpu import symbol as sym
+    from mxtpu.serving import (FleetRouter, FleetWorker, ModelRunner,
+                               RequestTimeout)
+
+    dim, max_batch = 64, 8
+    w = np.arange(1, dim + 1, dtype=np.float32)
+    rng = np.random.RandomState(0)
+
+    def make_runner():
+        return ModelRunner(sym.var("data") * sym.var("w"), {"w": w},
+                           {"data": (dim,)}, max_batch_size=max_batch)
+
+    # raw capacity of one worker's saturation bucket: sets the offered
+    # rate so the fleet runs loaded but not in permanent shed
+    probe = make_runner()
+    bucket = (max_batch, None)
+    rows = [{"data": rng.rand(dim).astype(np.float32)}
+            for _ in range(max_batch)]
+    vals = probe._pad_stack(rows, bucket)
+    np.asarray(probe.run_raw(vals, bucket)[0])        # compile+settle
+    t0 = time.perf_counter()
+    raw_iters = 50
+    for _ in range(raw_iters):
+        outs = probe.run_raw(vals, bucket)
+    np.asarray(outs[0])
+    raw_rps = max_batch * raw_iters / (time.perf_counter() - t0)
+
+    def soak():
+        canary = {"data": np.ones(dim, np.float32)}
+        router = FleetRouter(threaded=True, tick_s=0.002,
+                             canary=canary, canary_expect=[w.copy()],
+                             canary_interval_s=0.25,
+                             canary_timeout_s=2.0)
+        offered = min(0.5 * n_workers * raw_rps, 4000.0)
+        interval = 1.0 / offered
+        kill_at, replace_at = n_req // 3, n_req // 2
+        with router:
+            for i in range(n_workers):
+                router.add_worker(FleetWorker(
+                    make_runner(), f"w{i}", max_queue_delay_us=2000.0))
+            handoff = router._workers["w0"].handoff()
+            reqs, vecs = [], []
+            t_start = time.perf_counter()
+            for i in range(n_req):
+                lag = t_start + i * interval - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                if i == kill_at:
+                    router.kill("w0")                 # preemption
+                if i == replace_at:
+                    router.add_worker(FleetWorker(
+                        make_runner(), "wR",
+                        max_queue_delay_us=2000.0), warm_from=handoff)
+                vec = rng.rand(dim).astype(np.float32)
+                vecs.append(vec)
+                reqs.append(router.submit({"data": vec},
+                                          timeout_s=30.0))
+            done, dropped, hung, wrong = 0, 0, 0, 0
+            for vec, r in zip(vecs, reqs):
+                try:
+                    out = r.result(timeout=30.0)[0]
+                    done += 1
+                    if not np.allclose(out, vec * w, rtol=1e-5):
+                        wrong += 1
+                except RequestTimeout:
+                    hung += 1      # result() wait expired = a hang
+                except Exception:  # noqa: BLE001 — anything terminal
+                    dropped += 1   # inside the 30s deadline = a drop
+            served = done / (time.perf_counter() - t_start)
+            snap = router.fleet_stats()
+        return served, snap, dropped, hung, wrong
+
+    vals_run, last = [], None
+    dropped = hung = wrong = 0
+    for _ in range(repeats):
+        served, last, d, h, wr = soak()
+        vals_run.append(served)
+        dropped += d
+        hung += h
+        wrong += wr
+    vals_run.sort()
+    median = vals_run[len(vals_run) // 2] if len(vals_run) % 2 else \
+        0.5 * (vals_run[len(vals_run) // 2 - 1]
+               + vals_run[len(vals_run) // 2])
+    ex = last["extras"]
+    stats = {
+        "best": max(vals_run), "median": median, "n": len(vals_run),
+        "spread": round((max(vals_run) - min(vals_run)) / median, 4),
+        "runs": [round(v, 1) for v in vals_run],
+        "info": {
+            "hbm_peak": None,      # inference path; no scan program
+            "in_deadline_dropped": dropped,   # the contract: all zero
+            "in_deadline_hung": hung,
+            "wrong_results": wrong,
+            "p50_ms": last["latency_ms"]["p50"],
+            "p95_ms": last["latency_ms"]["p95"],
+            "p99_ms": last["latency_ms"]["p99"],
+            "retries": ex.get("retries", 0),
+            "requeues": ex.get("requeues", 0),
+            "deaths": ex.get("deaths", 0),
+            "hedges_won": ex.get("hedges_won", 0),
+            "timed_out": last["timed_out"],
+            "workers": {n: s["state"]
+                        for n, s in last["workers"].items()},
+            "raw_back_to_back_rps": round(raw_rps, 1),
+            "n_workers": n_workers,
+            "n_req_per_run": n_req,
+        },
+    }
+    return stats, _METRIC_NAMES["serving_fleet"], "req/sec"
+
+
 def _mfu(model, value, peak, per_unit=None):
     per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
@@ -794,7 +923,10 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             "moe_ffn": 60, "ssd": 90, "bert_zero": 150,
             # 8 bucket compiles (4-rung ladder x 2 seq buckets) of a
             # 4-layer BERT + two latency sweeps + 3 saturation runs
-            "serving_bert": 180}
+            "serving_bert": 180,
+            # tiny model, but 3 soak runs x (n_workers + replacement)
+            # ladder compiles + open-loop pacing
+            "serving_fleet": 120}
 
 
 def _sweep_stale_tmpdirs():
@@ -822,13 +954,14 @@ def main():
                  metric_key="bert_s512"),
              "transformer": bench_transformer,
              # on-demand rows (MXTPU_BENCH_MODEL=moe_ffn / ssd /
-             # bert_zero / serving_bert): each fits the budget on its
-             # own but the default sweep is already near the wall, so
-             # they are not in "all"
+             # bert_zero / serving_bert / serving_fleet): each fits
+             # the budget on its own but the default sweep is already
+             # near the wall, so they are not in "all"
              "moe_ffn": bench_moe_ffn,
              "ssd": bench_ssd,
              "bert_zero": bench_bert_zero,
-             "serving_bert": bench_serving_bert}
+             "serving_bert": bench_serving_bert,
+             "serving_fleet": bench_serving_fleet}
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")

@@ -195,6 +195,38 @@ register("MXTPU_SERVING_DONATE", True, "bool",
          "Donate padded input buffers to the serving executable on "
          "accelerator backends.", "serving")
 
+# -- serving fleet (router / health / retry) ---------------------------
+register("MXTPU_FLEET_LIVENESS_S", 2.0, "float",
+         "Liveness deadline on a dispatched batch: in-flight past "
+         "this is SUSPECT, past 2x is a hang (DEAD).", "fleet")
+register("MXTPU_FLEET_DEAD_AFTER", 3, "int",
+         "Consecutive canary failures on a SUSPECT worker before it "
+         "is declared DEAD.", "fleet")
+register("MXTPU_FLEET_CANARY_INTERVAL_S", 5.0, "float",
+         "Seconds between canary inferences per worker (0 disables "
+         "active health checks).", "fleet")
+register("MXTPU_FLEET_CANARY_TIMEOUT_S", 1.0, "float",
+         "Deadline on each canary inference.", "fleet")
+register("MXTPU_FLEET_RETRY_MAX", 3, "int",
+         "Router-level re-dispatch cap per request (retriable "
+         "failures only).", "fleet")
+register("MXTPU_FLEET_BACKOFF_BASE_US", 1000, "int",
+         "Retry backoff base: min(cap, base * 2^(n-1)) + jitter.",
+         "fleet")
+register("MXTPU_FLEET_BACKOFF_CAP_US", 64000, "int",
+         "Retry backoff cap in microseconds.", "fleet")
+register("MXTPU_FLEET_JITTER", 0.2, "float",
+         "Backoff jitter fraction (deterministic seeded RNG).",
+         "fleet")
+register("MXTPU_FLEET_HEDGE_AFTER_US", 0, "int",
+         "Hedge a still-in-flight request onto a second worker after "
+         "this many microseconds (0 disables hedging).", "fleet")
+register("MXTPU_FLEET_MAX_PENDING", 1024, "int",
+         "Bound on the router's parked-retry buffer before "
+         "ServerBusy shedding.", "fleet")
+register("MXTPU_FLEET_TICK_S", 0.005, "float",
+         "Router ticker period in threaded mode.", "fleet")
+
 # -- bench / tools -----------------------------------------------------
 register("MXTPU_BENCH_MODEL", "all", "str",
          "bench.py workload selector (lenet|resnet50|bert|transformer|"
@@ -245,6 +277,7 @@ _GROUP_TITLES = [
     ("guards", "Runtime guards"),
     ("engine", "Engine / numerics"),
     ("serving", "Serving"),
+    ("fleet", "Serving fleet"),
     ("bench", "Bench & profiling tools"),
     ("launch", "Distributed launch"),
     ("test", "Test harness"),

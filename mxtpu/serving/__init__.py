@@ -1,4 +1,5 @@
-"""mxtpu.serving — dynamic-batching TPU inference serving (ISSUE 4).
+"""mxtpu.serving — dynamic-batching TPU inference serving (ISSUE 4)
+plus the fault-tolerant serving fleet (ISSUE 7).
 
 The TPU-native equivalent of the reference's C predict API +
 ``BucketingModule`` deployment story (SURVEY.md §3), grown into a
@@ -17,17 +18,39 @@ serving layer:
 - :class:`ServingStats` (stats.py): rolling p50/p95/p99, queue depth,
   batch fill-rate, req/sec; Speedometer-style log line; chrome-trace
   spans via ``mxtpu.profiler``.
+- :class:`FleetRouter` / :class:`FleetWorker` (router.py): front-end
+  router over N workers — canary health checks driving the
+  :class:`WorkerHealth` state machine (health.py), retry with capped
+  exponential backoff + hedging, preemption-safe draining with
+  compiled-ladder handoff, and requeue-never-drop on worker death.
+- :mod:`faults` (faults.py): deterministic scripted fault injection
+  (hang, slow-start, crash-at-k, corruption, queue wedge) for tier-1
+  recovery-path tests.
 
-Knobs (also README "Serving"): ``MXTPU_SERVING_MAX_BATCH``,
-``MXTPU_SERVING_MAX_DELAY_US``, ``MXTPU_SERVING_MAX_QUEUE``,
-``MXTPU_SERVING_DONATE``.
+Error taxonomy: :class:`RetriableError` is the base; ``ServerBusy``
+and ``WorkerLost`` are retriable, ``RequestTimeout`` is terminal
+(``retriable`` attribute says which).
+
+Knobs (also README "Serving" / "Serving fleet"):
+``MXTPU_SERVING_*`` and ``MXTPU_FLEET_*``.
 """
 from .batcher import (Batch, DynamicBatcher, InferenceRequest,
-                      RequestTimeout, ServerBusy)
+                      RequestTimeout, RetriableError, ServerBusy,
+                      WorkerLost)
+from .faults import (CrashAt, Corrupt, Fault, FaultPlan, Hang,
+                     QueueWedge, SlowStart, SlowStartError,
+                     WorkerCrashed)
+from .health import WorkerHealth, WorkerState
+from .router import FleetRequest, FleetRouter, FleetWorker
 from .runner import ModelRunner, batch_ladder
 from .server import InferenceServer
 from .stats import ServingStats
 
 __all__ = ["ModelRunner", "InferenceServer", "DynamicBatcher",
            "ServingStats", "InferenceRequest", "Batch", "ServerBusy",
-           "RequestTimeout", "batch_ladder"]
+           "RequestTimeout", "RetriableError", "WorkerLost",
+           "batch_ladder",
+           "FleetRouter", "FleetWorker", "FleetRequest",
+           "WorkerHealth", "WorkerState",
+           "Fault", "FaultPlan", "Hang", "SlowStart", "CrashAt",
+           "Corrupt", "QueueWedge", "WorkerCrashed", "SlowStartError"]

@@ -29,25 +29,51 @@ from typing import Any, Callable, List, Optional
 
 from ..base import MXNetError
 
-__all__ = ["ServerBusy", "RequestTimeout", "InferenceRequest",
-           "Batch", "DynamicBatcher"]
+__all__ = ["RetriableError", "ServerBusy", "RequestTimeout",
+           "WorkerLost", "InferenceRequest", "Batch", "DynamicBatcher"]
 
 
-class ServerBusy(MXNetError):
-    """Backpressure: the bounded request queue is full."""
+class RetriableError(MXNetError):
+    """Common base of the serving error taxonomy (ISSUE 7): every
+    request-path error carries a ``retriable`` attribute so a caller
+    (or the fleet router) can distinguish "retry elsewhere / later"
+    from "give up".  Subclasses with ``retriable = False`` are
+    terminal — retrying cannot help."""
+    retriable = True
 
 
-class RequestTimeout(MXNetError):
-    """The request's deadline expired before a result was available."""
+class ServerBusy(RetriableError):
+    """Backpressure: the bounded request queue is full.  Retriable —
+    back off and resubmit, or route to another worker."""
+
+
+class RequestTimeout(RetriableError):
+    """The request's deadline expired before a result was available.
+    Terminal: the deadline is gone no matter where you retry."""
+    retriable = False
+
+
+class WorkerLost(RetriableError):
+    """The worker/batcher holding this request died or shut down
+    before completing it.  Retriable — the same payload may well
+    succeed on another worker (the fleet router does exactly that)."""
 
 
 class InferenceRequest:
     """Submit-side future.  ``result()`` blocks for the outcome;
     completion is one-shot — whichever of {result, timeout, error}
-    lands first wins and later writes are ignored."""
+    lands first wins and later writes are ignored (a tiny per-request
+    lock arbitrates concurrent completers: a hung worker coming back
+    to life races the router failing it with :class:`WorkerLost`).
+
+    ``add_done_callback`` lets the fleet router observe attempt
+    outcomes without polling; callbacks may fire while a batcher lock
+    is held, so they must only touch leaf state (the router appends to
+    an event deque)."""
 
     __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
-                 "_event", "_value", "_error", "t_dequeue", "t_done")
+                 "_event", "_value", "_error", "t_dequeue", "t_done",
+                 "requeues", "_wlock", "_watchers")
 
     def __init__(self, payload: Any, group: Any = None,
                  seq_len: Optional[int] = None,
@@ -60,33 +86,52 @@ class InferenceRequest:
         self.deadline = deadline
         self.t_dequeue: Optional[float] = None
         self.t_done: Optional[float] = None
+        self.requeues = 0          # times this re-entered a queue
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
+        self._wlock = threading.Lock()
+        self._watchers: List[Callable[[], None]] = []
 
     # -- completion (batcher/server side) -------------------------------
+    def _finish(self, value: Any, error: Optional[BaseException],
+                now: float) -> bool:
+        with self._wlock:
+            if self._event.is_set():
+                return False
+            self._value = value
+            self._error = error
+            self.t_done = now
+            self._event.set()
+            watchers, self._watchers = self._watchers, []
+        for fn in watchers:
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — a watcher must never
+                pass            # poison the completing worker
+        return True
+
     def _complete(self, value: Any, now: float) -> bool:
         """Deliver a result — unless the deadline already passed, in
         which case the caller gets RequestTimeout, never a late
         payload."""
-        if self._event.is_set():
-            return False
         if self.deadline is not None and now > self.deadline:
             return self._fail(RequestTimeout(
                 f"serving: request missed its deadline by "
                 f"{(now - self.deadline) * 1e3:.2f} ms"), now)
-        self._value = value
-        self.t_done = now
-        self._event.set()
-        return True
+        return self._finish(value, None, now)
 
     def _fail(self, error: BaseException, now: float) -> bool:
-        if self._event.is_set():
-            return False
-        self._error = error
-        self.t_done = now
-        self._event.set()
-        return True
+        return self._finish(None, error, now)
+
+    def add_done_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` (no args) once the request completes — or
+        immediately if it already has."""
+        with self._wlock:
+            if not self._event.is_set():
+                self._watchers.append(fn)
+                return
+        fn()
 
     # -- caller side ----------------------------------------------------
     def done(self) -> bool:
@@ -153,6 +198,9 @@ class DynamicBatcher:
         self._clock = clock
         self._cond = threading.Condition()
         self._queue: List[InferenceRequest] = []  # guarded-by: _cond
+        # dispatched (pulled into a Batch) but not yet completed —
+        # what close() must fail so no waiter hangs on a dead worker
+        self._inflight: List[InferenceRequest] = []  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         self._on_timeout = on_timeout
         self._on_depth = on_depth
@@ -171,7 +219,9 @@ class DynamicBatcher:
             deadline=None if timeout_s is None else now + timeout_s)
         with self._cond:
             if self._closed:
-                raise MXNetError("serving: batcher is closed")
+                raise WorkerLost(
+                    "serving: batcher is closed (worker shut down or "
+                    "lost) — resubmit elsewhere")
             if len(self._queue) >= self.max_queue:
                 raise ServerBusy(
                     f"serving: queue full ({self.max_queue} waiting); "
@@ -223,7 +273,67 @@ class DynamicBatcher:
         self._note_depth_locked()
         for r in take:
             r.t_dequeue = now
+        # register in-flight (reaping completed ones keeps it bounded)
+        self._inflight = [r for r in self._inflight if not r.done()]
+        self._inflight.extend(take)
         return Batch(take, head.group)
+
+    def requeue(self, requests: List[InferenceRequest],
+                now: Optional[float] = None) -> int:
+        """Return the not-yet-done requests of a FAILED batch execution
+        to the queue — each request re-enters AT MOST ONCE, with its
+        original deadline and ``t_submit`` (so ``queue_us`` accounting
+        stays honest: it spans submit → final dequeue).  A request
+        whose deadline already passed expires as :class:`RequestTimeout`
+        (it must not loop); one that already burned its requeue — or
+        arriving after close — fails as :class:`WorkerLost` so the
+        fleet layer can retry it on another worker.  Returns the number
+        actually requeued."""
+        now = self._clock() if now is None else now
+        requeued: List[InferenceRequest] = []
+        timed_out = 0
+        with self._cond:
+            processed = set(map(id, requests))
+            self._inflight = [r for r in self._inflight
+                              if id(r) not in processed]
+            for r in requests:
+                if r.done():
+                    continue
+                if r.deadline is not None and now > r.deadline:
+                    r._fail(RequestTimeout(
+                        "serving: deadline expired before the failed "
+                        "batch could requeue"), now)
+                    timed_out += 1
+                elif r.requeues >= 1 or self._closed:
+                    r._fail(WorkerLost(
+                        "serving: batch execution failed "
+                        + ("again after a requeue"
+                           if r.requeues else "and the batcher is "
+                           "closed")), now)
+                else:
+                    r.requeues += 1
+                    r.t_dequeue = None
+                    requeued.append(r)
+            if requeued:
+                # back to the FRONT: they were the oldest waiters and
+                # FIFO head priority is what bounds tail latency
+                self._queue[0:0] = requeued
+                self._note_depth_locked()
+                self._cond.notify_all()
+        if timed_out and self._on_timeout is not None:
+            self._on_timeout(timed_out)
+        return len(requeued)
+
+    def oldest_waiting_age(self, now: Optional[float] = None
+                           ) -> Optional[float]:
+        """Age of the oldest QUEUED request — the queue-wedge liveness
+        signal: on a healthy worker this stays under the assembly
+        delay, on a wedged one it grows without bound."""
+        with self._cond:
+            if not self._queue:
+                return None
+            return (self._clock() if now is None else now) \
+                - self._queue[0].t_submit
 
     def poll(self, now: Optional[float] = None) -> Optional[Batch]:
         """Non-blocking assembly decision at time ``now`` (defaults to
@@ -273,12 +383,25 @@ class DynamicBatcher:
                 self._cond.wait(wait if wait is None or wait > 0
                                 else 1e-4)
 
-    def close(self) -> None:
-        """Fail everything still queued and wake all waiters."""
+    def close(self, error: Optional[BaseException] = None) -> None:
+        """Fail everything still queued AND still in flight with a
+        terminal-for-this-worker :class:`WorkerLost` (retriable
+        elsewhere), and wake all waiters.  Nothing may be left blocked
+        in ``result()`` after a worker dies — this is the ISSUE 7
+        no-hung-waiters contract.  ``error`` overrides the default
+        WorkerLost (e.g. the router passes the death reason)."""
         with self._cond:
             self._closed = True
             now = self._clock()
+            err = error if error is not None else WorkerLost(
+                "serving: batcher closed — worker lost before the "
+                "request completed")
             for r in self._queue:
-                r._fail(MXNetError("serving: batcher closed"), now)
+                r._fail(err, now)
             self._queue.clear()
+            for r in self._inflight:
+                if not r.done():
+                    r._fail(err, now)
+            self._inflight = []
+            self._note_depth_locked()
             self._cond.notify_all()

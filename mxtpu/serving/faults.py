@@ -1,0 +1,170 @@
+"""Deterministic fault-injection harness for the serving fleet
+(ISSUE 7 tentpole item d).
+
+Faults are *scripted*, keyed on the worker's dispatch counter (batch
+``k`` = the k-th batch that worker ever dispatched, canaries included)
+— the same determinism discipline as the batcher's injected clock: a
+test that scripts ``CrashAt(at_batch=1)`` sees the crash on exactly
+the second dispatch, every run, no sleeps, no races.  The plan is
+consulted by :class:`~.router.FleetWorker` at its dispatch seam, so
+every recovery path in the router/health machinery is exercised by
+tier-1 tests instead of only showing up in a soak:
+
+* :class:`Hang` — the dispatched batch never completes (the worker
+  thread is stuck in the executable).  Detected by the in-flight
+  liveness deadline; outstanding requests are stolen and retried.
+* :class:`SlowStart` — the first ``first_n`` dispatches fail with a
+  retriable startup error (cold replica, weights still loading).
+  A RECOVERING worker keeps failing canaries until warm.
+* :class:`CrashAt` — dispatch ``k`` raises :class:`WorkerCrashed`
+  (preemption / OOM-kill).  DEAD immediately; in-flight requeued.
+* :class:`Corrupt` — dispatches from ``k`` on return silently wrong
+  results (bit-flip, bad DMA).  No exception anywhere — only a
+  canary comparing against its expected output can catch it.
+* :class:`QueueWedge` — from dispatch ``k`` on, the worker stops
+  pulling from its queue while still accepting submissions.  Detected
+  by the queued-request liveness age.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["WorkerCrashed", "SlowStartError", "HangSignal",
+           "Fault", "Hang", "SlowStart", "CrashAt", "Corrupt",
+           "QueueWedge", "FaultPlan"]
+
+
+class WorkerCrashed(MXNetError):
+    """The worker process died mid-dispatch (preemption, OOM-kill)."""
+
+
+class SlowStartError(MXNetError):
+    """Transient startup failure — the replica is not warm yet."""
+
+
+class HangSignal(Exception):
+    """Internal sentinel: the dispatch would block forever.  The
+    worker leaves the batch registered in-flight and parks — exactly
+    what a hung executable looks like from the outside — instead of
+    actually blocking a thread the test could never join."""
+
+
+class Fault:
+    """One scripted fault.  Subclasses override the hooks they need;
+    ``k`` is the worker's dispatch counter (0-based)."""
+
+    def wedged(self, k: int) -> bool:
+        return False
+
+    def before_batch(self, k: int) -> None:
+        """Raise to fail/crash/hang dispatch ``k``."""
+
+    def mutate(self, k: int,
+               host: List[np.ndarray]) -> List[np.ndarray]:
+        """Transform the host outputs of dispatch ``k`` (corruption)."""
+        return host
+
+
+class Hang(Fault):
+    def __init__(self, at_batch: int = 0):
+        self.at_batch = int(at_batch)
+
+    def before_batch(self, k: int) -> None:
+        if k == self.at_batch:
+            raise HangSignal(f"scripted hang at batch {k}")
+
+
+class SlowStart(Fault):
+    def __init__(self, first_n: int = 2):
+        self.first_n = int(first_n)
+
+    def before_batch(self, k: int) -> None:
+        if k < self.first_n:
+            raise SlowStartError(
+                f"scripted slow start: dispatch {k} of first "
+                f"{self.first_n} fails (replica still warming)")
+
+
+class CrashAt(Fault):
+    def __init__(self, at_batch: int = 0):
+        self.at_batch = int(at_batch)
+
+    def before_batch(self, k: int) -> None:
+        if k == self.at_batch:
+            raise WorkerCrashed(f"scripted crash at batch {k}")
+
+
+class Corrupt(Fault):
+    """Silently corrupt every output from dispatch ``from_batch`` on
+    (negate and offset — guaranteed to miss any expected value)."""
+
+    def __init__(self, from_batch: int = 0):
+        self.from_batch = int(from_batch)
+
+    def mutate(self, k: int,
+               host: List[np.ndarray]) -> List[np.ndarray]:
+        if k < self.from_batch:
+            return host
+        return [np.asarray(-(h.astype(np.float64)) + 1e6).astype(h.dtype)
+                if np.issubdtype(h.dtype, np.number) else h
+                for h in host]
+
+
+class QueueWedge(Fault):
+    """From dispatch ``after_batches`` on, the worker stops pulling
+    batches (its queue wedges) while submissions keep landing."""
+
+    def __init__(self, after_batches: int = 0):
+        self.after_batches = int(after_batches)
+
+    def wedged(self, k: int) -> bool:
+        return k >= self.after_batches
+
+
+class FaultPlan:
+    """A deterministic script: the union of its faults, consulted by
+    the worker at each dispatch.  ``fired`` records what actually
+    triggered, so tests can assert the scenario ran."""
+
+    def __init__(self, *faults: Fault):
+        self.faults = list(faults)
+        self.fired: List[str] = []
+
+    def wedged(self, k: int) -> bool:
+        for f in self.faults:
+            if f.wedged(k):
+                if not self.fired or self.fired[-1] != "wedge":
+                    self.fired.append("wedge")
+                return True
+        return False
+
+    def before_batch(self, k: int) -> None:
+        for f in self.faults:
+            try:
+                f.before_batch(k)
+            except Exception:
+                self.fired.append(f"{type(f).__name__.lower()}@{k}")
+                raise
+
+    def mutator(self, k: int) -> Optional[
+            Callable[[List[np.ndarray]], List[np.ndarray]]]:
+        muts = [f for f in self.faults
+                if type(f).mutate is not Fault.mutate]
+        if not muts:
+            return None
+
+        def apply(host: List[np.ndarray]) -> List[np.ndarray]:
+            out = host
+            for f in muts:
+                before = out
+                out = f.mutate(k, out)
+                if out is not before:
+                    self.fired.append(
+                        f"{type(f).__name__.lower()}@{k}")
+            return out
+
+        return apply

@@ -1,0 +1,839 @@
+"""Fault-tolerant serving fleet: a front-end router over N workers
+(ISSUE 7 tentpole).
+
+``InferenceServer`` round-robins device replicas inside one process
+with no notion of a worker dying; this layer is the fleet story on
+top: N :class:`FleetWorker`\\ s (each one runner + one bounded
+:class:`DynamicBatcher` + optionally one execution thread) behind a
+:class:`FleetRouter` that
+
+* runs **active health checks** — periodic canary inferences (result
+  compared against an expected output, so silent corruption is a
+  detected failure) plus liveness deadlines on dispatched batches and
+  queued requests — driving the per-worker
+  :class:`~.health.WorkerHealth` state machine
+  (HEALTHY → SUSPECT → DRAINING → DEAD → RECOVERING);
+* **retries with capped exponential backoff + deterministic jitter**
+  (seeded RNG), preferring a worker the request has not tried, with
+  optional **hedged requests** (a second attempt dispatched when the
+  first is slow; first completion wins, the loser is discarded);
+* **requeues — never drops** — the outstanding requests of a dead
+  worker: its batcher is closed with :class:`WorkerLost`, the
+  attempt watchers fire, and every request whose deadline still
+  permits re-enters the dispatch loop (late ones fail fast as
+  :class:`RequestTimeout`);
+* supports **preemption-safe draining**: ``drain(name)`` stops new
+  admissions, the worker flushes its queue and completes in-flight
+  work, and :meth:`FleetWorker.handoff` exposes the compiled-ladder
+  metadata a replacement warms from (``ModelRunner.warm_from``).
+
+Determinism: the router is clock-injected and tick-driven.  With
+``threaded=False`` nothing runs in the background — tests call
+``tick(now)`` with a hand-stepped clock and every recovery path in
+``tests/test_fleet.py`` is exercised reproducibly against the
+scripted :mod:`~.faults` plans.  With ``threaded=True`` (production)
+each worker runs an execution thread and the router runs a ticker
+thread; the policy code is identical.
+
+Lock order (must hold): ``FleetRouter._lock`` → ``DynamicBatcher
+._cond`` → leaf locks (``_evlock``, request ``_wlock``,
+``ServingStats._lock``).  Completion watchers can fire under a
+batcher lock, so they only ever touch ``_evlock`` / request / stats
+state — never the router lock.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import knobs
+from .batcher import (DynamicBatcher, InferenceRequest, RequestTimeout,
+                      ServerBusy, WorkerLost)
+from .faults import FaultPlan, HangSignal, WorkerCrashed
+from .health import WorkerHealth, WorkerState
+from .stats import ServingStats
+
+__all__ = ["FleetRequest", "FleetWorker", "FleetRouter"]
+
+logger = logging.getLogger("mxtpu.serving.fleet")
+
+
+class FleetRequest:
+    """Caller-side future spanning every attempt (retries, hedges) the
+    router makes for one logical request.  One-shot completion under a
+    leaf lock: with hedging, two workers can finish simultaneously."""
+
+    __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
+                 "retries", "requeues", "hedges", "tried", "last_error",
+                 "t_done", "won_by_hedge", "_event", "_value", "_error",
+                 "_wlock")
+
+    def __init__(self, payload: Any, group: Any, seq_len: Optional[int],
+                 t_submit: float, deadline: Optional[float]):
+        self.payload = payload
+        self.group = group
+        self.seq_len = seq_len
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self.retries = 0          # router-level re-dispatches
+        self.requeues = 0         # of those, forced by a worker death
+        self.hedges = 0           # hedge attempts dispatched
+        self.tried: List[str] = []    # worker names, dispatch order
+        self.last_error: Optional[BaseException] = None
+        self.t_done: Optional[float] = None
+        self.won_by_hedge = False
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self._wlock = threading.Lock()
+
+    def _complete(self, value: Any, now: float,
+                  hedge: bool = False) -> bool:
+        with self._wlock:
+            if self._event.is_set():
+                return False
+            if self.deadline is not None and now > self.deadline:
+                self._error = RequestTimeout(
+                    f"serving: fleet request missed its deadline by "
+                    f"{(now - self.deadline) * 1e3:.2f} ms")
+            else:
+                self._value = value
+                self.won_by_hedge = hedge
+            self.t_done = now
+            self._event.set()
+            return True
+
+    def _fail(self, error: BaseException, now: float) -> bool:
+        with self._wlock:
+            if self._event.is_set():
+                return False
+            self._error = error
+            self.t_done = now
+            self._event.set()
+            return True
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                "serving: fleet result() wait timed out (request "
+                "still in flight)")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_us(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1e6
+
+
+class FleetWorker:
+    """One fleet worker: a runner + its own bounded batcher + health
+    record (+ an execution thread in threaded mode).  The dispatch
+    seam consults an optional :class:`~.faults.FaultPlan`, which is
+    how every failure mode is injected deterministically."""
+
+    def __init__(self, runner, name: str = "w0", *,
+                 clock=time.monotonic,
+                 max_queue_delay_us: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 faults: Optional[FaultPlan] = None,
+                 start_recovering: bool = False,
+                 liveness_s: Optional[float] = None,
+                 dead_after: Optional[int] = None,
+                 exec_recovers: bool = False):
+        self.runner = runner
+        self.name = name
+        self._clock = clock
+        self.faults = faults
+        if max_queue_delay_us is None:
+            max_queue_delay_us = knobs.get("MXTPU_SERVING_MAX_DELAY_US")
+        if max_queue is None:
+            mq = knobs.get("MXTPU_SERVING_MAX_QUEUE")
+            max_queue = mq if mq else None
+        self.stats = ServingStats(name=f"fleet/{name}", clock=clock)
+        self.batcher = DynamicBatcher(
+            max_batch_size=runner.max_batch_size,
+            max_queue_delay_us=max_queue_delay_us,
+            max_queue=max_queue, clock=clock,
+            on_timeout=self.stats.record_timeout,
+            on_depth=self.stats.record_queue_depth)
+        self.health = WorkerHealth(
+            name,
+            liveness_s=liveness_s if liveness_s is not None
+            else knobs.get("MXTPU_FLEET_LIVENESS_S"),
+            dead_after=dead_after if dead_after is not None
+            else knobs.get("MXTPU_FLEET_DEAD_AFTER"),
+            start_recovering=start_recovering,
+            exec_recovers=exec_recovers)
+        self._lock = threading.Lock()
+        self._inflight_t: Optional[float] = None  # guarded-by: _lock
+        self._inflight_n = 0  # guarded-by: _lock
+        self._stuck = False  # guarded-by: _lock
+        self._batch_seq = 0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._shut = False
+
+    # -- admission --------------------------------------------------------
+    def submit_attempt(self, payload: Any, group: Any,
+                       seq_len: Optional[int],
+                       deadline: Optional[float], now: float,
+                       canary: bool = False) -> InferenceRequest:
+        """Admit one attempt into this worker's queue.  Client traffic
+        only lands on a HEALTHY worker; canaries also probe SUSPECT
+        and RECOVERING ones (that IS the recovery path).  Raises
+        :class:`WorkerLost` (retriable) on refusal, :class:`ServerBusy`
+        when the bounded queue is full."""
+        ok = self.health.admits_canary() if canary \
+            else self.health.admits()
+        if not ok:
+            raise WorkerLost(
+                f"serving: worker {self.name} is {self.health.state} "
+                f"({self.health.reason}) — not admitting")
+        timeout_s = None if deadline is None \
+            else max(0.0, deadline - now)
+        return self.batcher.submit(payload, group=group,
+                                   seq_len=seq_len, timeout_s=timeout_s)
+
+    # -- execution ---------------------------------------------------------
+    def pump(self, now: Optional[float] = None) -> bool:
+        """Deterministic single-step execution: assemble at most one
+        ready batch and run it inline.  Returns True if a batch was
+        dispatched.  The threaded loop and the router's sync tick both
+        funnel through `_dispatch`, so the policy is identical."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._stuck or self._inflight_t is not None:
+                return False
+            k = self._batch_seq
+        if self._stop.is_set() or \
+                self.health.state == WorkerState.DEAD or \
+                (self.faults is not None and self.faults.wedged(k)):
+            return False            # a dead worker executes nothing
+        batch = self.batcher.poll(now)
+        if batch is None:
+            return False
+        self._dispatch(batch, now)
+        return True
+
+    def _dispatch(self, batch, now: float) -> None:
+        with self._lock:
+            k = self._batch_seq
+            self._batch_seq += 1
+            self._inflight_t = now
+            self._inflight_n = len(batch.requests)
+        try:
+            if self.faults is not None:
+                self.faults.before_batch(k)
+            mutate = self.faults.mutator(k) \
+                if self.faults is not None else None
+            bucket, _ = self.runner.run_requests(
+                batch.requests, now=self._clock(), mutate=mutate)
+        except HangSignal:
+            # the dispatch would block forever: leave the batch
+            # registered in-flight (liveness will notice) and park —
+            # from the outside this IS a hung executable
+            with self._lock:
+                self._stuck = True
+            self.stats.bump("hangs")
+            return
+        except WorkerCrashed as e:
+            with self._lock:
+                self._inflight_t = None
+                self._inflight_n = 0
+            self.health.crashed(now, str(e))
+            self.stats.bump("crashes")
+            # requests stay incomplete; the router observes DEAD and
+            # closes the batcher, which fails them to their watchers
+            return
+        except Exception as e:  # noqa: BLE001 — transient execution
+            with self._lock:    # failure: requeue-once, stay alive
+                self._inflight_t = None
+                self._inflight_n = 0
+            n = self.batcher.requeue(batch.requests, now=self._clock())
+            if n:
+                self.stats.bump("requeues", n)
+            self.health.exec_fail(now)
+            logger.debug("fleet worker %s: batch failed (%s), "
+                         "requeued %d", self.name, e, n)
+            return
+        with self._lock:
+            self._inflight_t = None
+            self._inflight_n = 0
+        self.health.exec_ok(now)
+        self.stats.record_batch(len(batch.requests), bucket[0])
+        for r in batch.requests:
+            if r.latency_us is not None:
+                self.stats.record_completion(r.latency_us,
+                                             r.queue_us or 0.0)
+        self.stats.maybe_log()
+
+    # -- liveness signals --------------------------------------------------
+    def inflight_age(self, now: float) -> Optional[float]:
+        with self._lock:
+            return None if self._inflight_t is None \
+                else now - self._inflight_t
+
+    def queued_age(self, now: float) -> Optional[float]:
+        return self.batcher.oldest_waiting_age(now)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            inflight = self._inflight_n
+        return self.batcher.depth + inflight
+
+    # -- threaded mode -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mxtpu-fleet-{self.name}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                stuck, k = self._stuck, self._batch_seq
+            if stuck or self.health.state == WorkerState.DEAD or \
+                    (self.faults is not None
+                     and self.faults.wedged(k)):
+                # a hung/wedged worker: the thread parks; the router's
+                # liveness check is what reaps it
+                self._stop.wait(0.02)
+                continue
+            batch = self.batcher.wait_next(timeout=0.05)
+            if batch is None:
+                continue
+            self._dispatch(batch, self._clock())
+
+    def shutdown(self, error: Optional[BaseException] = None) -> None:
+        """Stop the thread (if any) and fail every queued + in-flight
+        request with WorkerLost so no waiter hangs.  Idempotent."""
+        if self._shut:
+            return
+        self._shut = True
+        self._stop.set()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=1.0)
+        self.batcher.close(error=error)
+
+    # -- drain handoff -----------------------------------------------------
+    def handoff(self) -> Dict[str, Any]:
+        """The donor metadata a replacement warms from: which buckets
+        this worker's ladder actually compiled (see
+        ``ModelRunner.ladder_metadata``)."""
+        return self.runner.ladder_metadata()
+
+
+class _Pending:
+    """One parked (re)dispatch: due time + the fleet request."""
+    __slots__ = ("due", "freq")
+
+    def __init__(self, due: float, freq: FleetRequest):
+        self.due = due
+        self.freq = freq
+
+
+class FleetRouter:
+    """Front-end router over N :class:`FleetWorker`\\ s.  See module
+    docstring for the full contract.
+
+    >>> router = FleetRouter(clock=..., threaded=False,
+    ...                      canary={"data": x}, canary_expect=[y])
+    >>> router.add_worker(FleetWorker(runner, "w0", clock=...))
+    >>> req = router.submit({"data": x}, timeout_s=1.0)
+    >>> router.tick(now)   # deterministic mode: crank the loop
+    >>> req.result(timeout=0)
+    """
+
+    def __init__(self, *, clock=time.monotonic, threaded: bool = True,
+                 canary: Optional[Dict[str, np.ndarray]] = None,
+                 canary_expect: Optional[List[np.ndarray]] = None,
+                 canary_seq_len: Optional[int] = None,
+                 canary_interval_s: Optional[float] = None,
+                 canary_timeout_s: Optional[float] = None,
+                 retry_max: Optional[int] = None,
+                 backoff_base_us: Optional[int] = None,
+                 backoff_cap_us: Optional[int] = None,
+                 jitter: Optional[float] = None,
+                 hedge_after_us: Optional[int] = None,
+                 max_pending: Optional[int] = None,
+                 tick_s: Optional[float] = None,
+                 seed: int = 0, log_every_s: float = 10.0):
+        self._clock = clock
+        self._threaded = threaded
+        self._lock = threading.Lock()
+        self._workers: Dict[str, FleetWorker] = {}  # guarded-by: _lock
+        self._order: List[str] = []  # guarded-by: _lock
+        self._rr = 0  # guarded-by: _lock
+        self._pending: List[_Pending] = []  # guarded-by: _lock
+        self._live: List[tuple] = []  # guarded-by: _lock
+        self._dead_handled: set = set()  # guarded-by: _lock
+        self._next_canary: Dict[str, float] = {}  # guarded-by: _lock
+        # completion events from attempt watchers; leaf lock ONLY —
+        # watchers fire under batcher locks (see module lock order)
+        self._evlock = threading.Lock()
+        self._events: deque = deque()  # guarded-by: _evlock
+        self._canary = canary
+        self._canary_expect = canary_expect
+        self._canary_seq_len = canary_seq_len
+        g = knobs.get
+        self._canary_interval_s = canary_interval_s \
+            if canary_interval_s is not None \
+            else g("MXTPU_FLEET_CANARY_INTERVAL_S")
+        self._canary_timeout_s = canary_timeout_s \
+            if canary_timeout_s is not None \
+            else g("MXTPU_FLEET_CANARY_TIMEOUT_S")
+        self._retry_max = retry_max if retry_max is not None \
+            else g("MXTPU_FLEET_RETRY_MAX")
+        self._backoff_base_us = backoff_base_us \
+            if backoff_base_us is not None \
+            else g("MXTPU_FLEET_BACKOFF_BASE_US")
+        self._backoff_cap_us = backoff_cap_us \
+            if backoff_cap_us is not None \
+            else g("MXTPU_FLEET_BACKOFF_CAP_US")
+        self._jitter = jitter if jitter is not None \
+            else g("MXTPU_FLEET_JITTER")
+        self._hedge_after_us = hedge_after_us \
+            if hedge_after_us is not None \
+            else g("MXTPU_FLEET_HEDGE_AFTER_US")
+        self._max_pending = max_pending if max_pending is not None \
+            else g("MXTPU_FLEET_MAX_PENDING")
+        self._tick_s = tick_s if tick_s is not None \
+            else g("MXTPU_FLEET_TICK_S")
+        self._rng = random.Random(seed)
+        self.stats = ServingStats(name="fleet", clock=clock,
+                                  log_every_s=log_every_s)
+        self._closed = False
+        self._stop = threading.Event()
+        self._ticker: Optional[threading.Thread] = None
+        if threaded:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True,
+                name="mxtpu-fleet-router")
+            self._ticker.start()
+
+    # -- fleet membership --------------------------------------------------
+    def add_worker(self, worker: FleetWorker,
+                   warm_from: Optional[Dict[str, Any]] = None) -> None:
+        """Attach a worker.  ``warm_from`` is a donor's
+        :meth:`FleetWorker.handoff` — the replacement pre-compiles the
+        donor's bucket working set before its first canary.  All
+        workers must share the bucket ladder (same batching groups)."""
+        if warm_from is not None:
+            worker.runner.warm_from(warm_from)
+        with self._lock:
+            if self._closed:
+                raise WorkerLost("serving: fleet router is closed")
+            if worker.name in self._workers:
+                raise MXNetError(
+                    f"serving: fleet already has worker "
+                    f"{worker.name!r}")
+            if self._order:
+                r0 = self._workers[self._order[0]].runner
+                r = worker.runner
+                if r.max_batch_size != r0.max_batch_size or \
+                        r.seq_buckets != r0.seq_buckets:
+                    raise MXNetError(
+                        "serving: fleet workers must share the bucket "
+                        "ladder (max_batch_size/seq_buckets)")
+            self._workers[worker.name] = worker
+            self._order.append(worker.name)
+            self._next_canary[worker.name] = self._clock()
+        if self._threaded:
+            worker.start()
+
+    def drain(self, name: str, now: Optional[float] = None
+              ) -> Dict[str, Any]:
+        """Preemption-safe retirement: stop new admissions on
+        ``name``; its queue flushes and in-flight work completes on
+        the next ticks (bounded by the liveness deadline — a hung
+        drain is reaped like any hang).  Returns the handoff metadata
+        a replacement warms from."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            worker = self._require_locked(name)
+        worker.health.drain(now)
+        self.stats.bump("drains")
+        return worker.handoff()
+
+    def kill(self, name: str, now: Optional[float] = None) -> None:
+        """Operator/preemption kill: the worker is DEAD immediately;
+        its outstanding requests are stolen and retried on the next
+        tick (deadline permitting) — never dropped."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            worker = self._require_locked(name)
+        worker.health.crashed(now, "killed (preemption)")
+
+    def _require_locked(self, name: str) -> FleetWorker:
+        w = self._workers.get(name)
+        if w is None:
+            raise MXNetError(f"serving: fleet has no worker {name!r}")
+        return w
+
+    def workers(self) -> Dict[str, str]:
+        with self._lock:
+            return {n: w.health.state
+                    for n, w in self._workers.items()}
+
+    # -- request path ------------------------------------------------------
+    def submit(self, payload: Dict[str, np.ndarray], *,
+               seq_len: Optional[int] = None,
+               timeout_s: Optional[float] = None) -> FleetRequest:
+        """Route one request into the fleet.  Returns a
+        :class:`FleetRequest` future; raises :class:`ServerBusy` only
+        when the router's own pending buffer is full (per-worker
+        backpressure is handled by retrying elsewhere)."""
+        now = self._clock()
+        with self._lock:
+            if self._closed:
+                raise WorkerLost("serving: fleet router is closed")
+            if not self._order:
+                raise MXNetError("serving: fleet has no workers")
+            r0 = self._workers[self._order[0]].runner
+            if len(self._pending) >= self._max_pending:
+                self.stats.record_rejected()
+                raise ServerBusy(
+                    f"serving: fleet pending buffer full "
+                    f"({self._max_pending}); retry with backoff")
+        group = r0.seq_bucket_for(seq_len)
+        freq = FleetRequest(payload, group, seq_len, now,
+                            None if timeout_s is None
+                            else now + timeout_s)
+        with self._lock:
+            if not self._dispatch_locked(freq, now):
+                self._pending.append(_Pending(now, freq))
+        return freq
+
+    def infer(self, payload: Dict[str, np.ndarray], *,
+              seq_len: Optional[int] = None,
+              timeout_s: Optional[float] = None) -> Any:
+        """Blocking convenience wrapper (threaded mode)."""
+        req = self.submit(payload, seq_len=seq_len, timeout_s=timeout_s)
+        return req.result(timeout=None if timeout_s is None
+                          else timeout_s + 5.0)
+
+    # -- dispatch core -----------------------------------------------------
+    def _pick_locked(self, freq: Optional[FleetRequest]
+                     ) -> Optional[FleetWorker]:
+        """Round-robin over HEALTHY workers, preferring one this
+        request has not tried yet ("retry elsewhere")."""
+        healthy = [n for n in self._order
+                   if self._workers[n].health.admits()]
+        if not healthy:
+            return None
+        tried = set(freq.tried) if freq is not None else ()
+        fresh = [n for n in healthy if n not in tried]
+        pool = fresh or healthy
+        name = pool[self._rr % len(pool)]
+        self._rr += 1
+        return self._workers[name]
+
+    def _dispatch_locked(self, freq: FleetRequest, now: float,
+                         hedge: bool = False) -> bool:
+        """Try to place one attempt; False = no worker took it (park
+        it).  Called with ``_lock`` held."""
+        for _ in range(len(self._order)):
+            worker = self._pick_locked(freq)
+            if worker is None:
+                return False
+            try:
+                attempt = worker.submit_attempt(
+                    freq.payload, freq.group, freq.seq_len,
+                    freq.deadline, now)
+            except (WorkerLost, ServerBusy):
+                # this worker refused; round-robin advances, try next
+                continue
+            freq.tried.append(worker.name)
+            if hedge:
+                freq.hedges += 1
+            self._live.append((freq, attempt, worker.name, now,
+                               hedge))
+            attempt.add_done_callback(
+                self._watcher(freq, attempt, worker.name, hedge))
+            return True
+        return False
+
+    def _watcher(self, freq: FleetRequest, attempt: InferenceRequest,
+                 wname: str, hedge: bool):
+        """Attempt-completion hook.  May fire under a batcher lock:
+        touches only the fleet request, stats, and the event deque
+        (leaf locks) — never the router lock."""
+        def cb() -> None:
+            now = self._clock()
+            if attempt._error is None:
+                if freq._complete(attempt._value, now, hedge=hedge):
+                    self.stats.record_completion(
+                        (now - freq.t_submit) * 1e6,
+                        (attempt.queue_us or 0.0))
+                    if hedge:
+                        self.stats.bump("hedges_won")
+            else:
+                with self._evlock:
+                    self._events.append(
+                        ("attempt_failed", freq, wname,
+                         attempt._error))
+        return cb
+
+    def _backoff_s(self, n_retry: int) -> float:
+        base = min(float(self._backoff_cap_us),
+                   float(self._backoff_base_us) * (2 ** (n_retry - 1)))
+        return base * (1.0 + self._jitter * self._rng.random()) / 1e6
+
+    def _handle_attempt_failed_locked(self, freq: FleetRequest,
+                                      wname: str, error: BaseException,
+                                      now: float) -> None:
+        if freq.done():
+            return              # a hedge already won (or terminal)
+        freq.last_error = error
+        retriable = bool(getattr(error, "retriable", False))
+        if freq.deadline is not None and now >= freq.deadline:
+            freq._fail(RequestTimeout(
+                "serving: deadline expired before a retry could be "
+                "placed"), now)
+            self.stats.record_timeout()
+            return
+        if not retriable or freq.retries >= self._retry_max:
+            freq._fail(error, now)
+            return
+        freq.retries += 1
+        self.stats.bump("retries")
+        if isinstance(error, WorkerLost):
+            # the attempt died WITH its worker: this is the
+            # requeue-never-drop path, counted separately
+            freq.requeues += 1
+            self.stats.bump("requeues")
+        due = now + self._backoff_s(freq.retries)
+        self._pending.append(_Pending(due, freq))
+
+    # -- canaries ----------------------------------------------------------
+    def _canary_due_locked(self, now: float) -> List[FleetWorker]:
+        if self._canary is None or self._canary_interval_s <= 0:
+            return []
+        due = []
+        for name in self._order:
+            w = self._workers[name]
+            if not w.health.admits_canary():
+                continue
+            if now >= self._next_canary.get(name, now):
+                self._next_canary[name] = now + self._canary_interval_s
+                due.append(w)
+        return due
+
+    def _send_canary(self, worker: FleetWorker, now: float) -> None:
+        try:
+            attempt = worker.submit_attempt(
+                self._canary, self._canary_group(), self._canary_seq_len,
+                now + self._canary_timeout_s, now, canary=True)
+        except ServerBusy:
+            # a full queue means the worker is saturated with real
+            # traffic, not broken — skip this round (liveness
+            # deadlines still catch a wedged queue)
+            return
+        except WorkerLost:
+            with self._evlock:
+                self._events.append(("canary", worker.name, False,
+                                     "refused"))
+            return
+        expect = self._canary_expect
+
+        def cb() -> None:
+            if attempt._error is not None:
+                ok, why = False, f"error: {attempt._error}"
+            elif expect is None:
+                ok, why = True, "completed"
+            else:
+                try:
+                    ok = len(attempt._value) == len(expect) and all(
+                        np.allclose(np.asarray(got), np.asarray(want),
+                                    rtol=1e-4, atol=1e-5)
+                        for got, want in zip(attempt._value, expect))
+                    why = "match" if ok else "result CORRUPT " \
+                        "(mismatch vs expected canary output)"
+                except Exception as e:  # noqa: BLE001
+                    ok, why = False, f"compare failed: {e}"
+            with self._evlock:
+                self._events.append(("canary", worker.name, ok, why))
+        attempt.add_done_callback(cb)
+
+    def _canary_group(self) -> Any:
+        with self._lock:
+            if not self._order:
+                return None
+            r0 = self._workers[self._order[0]].runner
+        return r0.seq_bucket_for(self._canary_seq_len)
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """One scheduling round: canaries → (sync) pump → liveness →
+        reap the dead → process completion events → re-dispatch due
+        retries → hedge slow attempts.  In threaded mode a background
+        ticker calls this every ``tick_s``; deterministic tests call
+        it directly with the fake clock."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            canary_due = self._canary_due_locked(now)
+        for w in canary_due:
+            self._send_canary(w, now)
+        with self._lock:
+            workers = [self._workers[n] for n in self._order]
+        if not self._threaded:
+            for w in workers:
+                for _ in range(64):     # bounded drain of ready work
+                    if not w.pump(now):
+                        break
+        # liveness + death reaping
+        for w in workers:
+            w.health.liveness(now, w.inflight_age(now),
+                              w.queued_age(now))
+            if w.health.state == WorkerState.DRAINING and \
+                    w.outstanding() == 0:
+                w.health.drained(now)
+                self.stats.bump("drains_completed")
+            if w.health.state == WorkerState.DEAD:
+                with self._lock:
+                    if w.name in self._dead_handled:
+                        continue
+                    self._dead_handled.add(w.name)
+                if not w.health.retired:
+                    self.stats.bump("deaths")
+                    logger.warning(
+                        "fleet: worker %s is DEAD (%s) — stealing "
+                        "outstanding requests", w.name, w.health.reason)
+                # closing the batcher fails queued+inflight with
+                # WorkerLost → watchers enqueue retry events below
+                w.shutdown(error=None if w.health.retired else
+                           WorkerLost(f"serving: worker {w.name} died "
+                                      f"({w.health.reason})"))
+        # completion / canary events
+        while True:
+            with self._evlock:
+                if not self._events:
+                    break
+                ev = self._events.popleft()
+            if ev[0] == "attempt_failed":
+                with self._lock:
+                    self._handle_attempt_failed_locked(
+                        ev[1], ev[2], ev[3], now)
+            elif ev[0] == "canary":
+                _, wname, ok, why = ev
+                with self._lock:
+                    w = self._workers.get(wname)
+                if w is None:
+                    continue
+                if ok:
+                    w.health.canary_ok(now)
+                else:
+                    prev = w.health.state
+                    w.health.canary_fail(now, f"canary ({why})")
+                    if prev != w.health.state:
+                        logger.warning(
+                            "fleet: worker %s %s → %s: %s", wname,
+                            prev, w.health.state, why)
+        # due retries / parked dispatches
+        with self._lock:
+            # a live attempt stuck on a slow worker must still honor
+            # the caller's deadline — fail the fleet request now (the
+            # stale attempt, whenever it surfaces, finds it done)
+            for entry in self._live:
+                freq = entry[0]
+                if not freq.done() and freq.deadline is not None \
+                        and now > freq.deadline:
+                    if freq._fail(RequestTimeout(
+                            "serving: deadline expired with the "
+                            "attempt still in flight"), now):
+                        self.stats.record_timeout()
+            pending, self._pending = self._pending, []
+            for p in pending:
+                if p.freq.done():
+                    continue
+                if p.freq.deadline is not None and \
+                        now > p.freq.deadline:
+                    p.freq._fail(RequestTimeout(
+                        "serving: deadline expired while waiting for "
+                        "a fleet worker"), now)
+                    self.stats.record_timeout()
+                    continue
+                if p.due > now or not self._dispatch_locked(
+                        p.freq, now):
+                    self._pending.append(p)
+            # hedging: a slow single IN-FLIGHT attempt gets a second
+            # chance on another worker; first completion wins.  An
+            # entry whose attempt already finished (either way) is out
+            # of hedging scope — retries own that path.
+            self._live = [e for e in self._live
+                          if not e[0].done() and not e[1].done()]
+            if self._hedge_after_us > 0:
+                for freq, attempt, wname, t0, hedge in list(self._live):
+                    if hedge or freq.hedges > 0:
+                        continue
+                    if (now - t0) * 1e6 >= self._hedge_after_us:
+                        if self._dispatch_locked(freq, now,
+                                                 hedge=True):
+                            self.stats.bump("hedges")
+        self.stats.maybe_log()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self._tick_s):
+            try:
+                self.tick()
+            except Exception:   # noqa: BLE001 — the ticker must never
+                logger.exception("fleet: tick failed")  # die silently
+
+    # -- observability -----------------------------------------------------
+    def fleet_stats(self) -> Dict[str, Any]:
+        """Fleet-level aggregation: router counters (retries,
+        requeues, hedges won, drains, deaths + rolling end-to-end
+        percentiles) plus one per-worker block (state machine snapshot
+        + that worker's ServingStats)."""
+        snap = self.stats.snapshot()
+        with self._lock:
+            workers = dict(self._workers)
+            snap["pending"] = len(self._pending)
+        snap["workers"] = {
+            n: {**w.health.snapshot(), **w.stats.snapshot()}
+            for n, w in workers.items()}
+        states = [w.health.state for w in workers.values()]
+        snap["healthy_workers"] = sum(
+            1 for s in states if s == WorkerState.HEALTHY)
+        snap["total_workers"] = len(states)
+        return snap
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = list(self._workers.values())
+            pending = self._pending
+            self._pending = []
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=2.0)
+        now = self._clock()
+        for p in pending:
+            p.freq._fail(WorkerLost(
+                "serving: fleet router closed"), now)
+        for w in workers:
+            w.shutdown()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

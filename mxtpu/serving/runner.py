@@ -354,11 +354,15 @@ class ModelRunner:
         return [np.asarray(o)[:n] for o in outs]
 
     def run_requests(self, requests: List[InferenceRequest],
-                     now: Optional[float] = None) -> Tuple:
+                     now: Optional[float] = None,
+                     mutate=None) -> Tuple:
         """Server path: execute one assembled same-group batch and
         scatter each request its OWN output rows (sequence axis trimmed
         back to the request's true length).  Returns (bucket, outputs)
-        for stats."""
+        for stats.  ``mutate`` (host outputs -> host outputs) is the
+        fault-injection seam — mxtpu.serving.faults corrupts results
+        here so canary-based detection is exercised deterministically;
+        production callers leave it None."""
         n = len(requests)
         seq = requests[0].group if self.seq_buckets is not None else None
         bucket = self.bucket_for(n, seq)
@@ -366,6 +370,8 @@ class ModelRunner:
         outs = self.run_raw(vals, bucket)
         # mxlint: sync-point — deliberate D2H before scattering rows
         host = [np.asarray(o) for o in outs]
+        if mutate is not None:
+            host = mutate(host)
         done_t = time.monotonic() if now is None else now
         for i, r in enumerate(requests):
             row_outs = []
@@ -400,6 +406,32 @@ class ModelRunner:
     def num_compiled(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    # -- fleet handoff (ISSUE 7: preemption-safe draining) ---------------
+    def ladder_metadata(self) -> Dict[str, Any]:
+        """What a draining worker hands its replacement: the ladder
+        shape plus WHICH buckets were actually compiled (traffic-driven
+        subset) and what each cost — so the replacement warms exactly
+        the donor's working set instead of the full cross product."""
+        with self._lock:
+            compiled = sorted(self._entries)
+            secs = dict(self.compile_seconds)
+        return {"max_batch_size": self.max_batch_size,
+                "seq_buckets": list(self.seq_buckets)
+                if self.seq_buckets is not None else None,
+                "compiled_buckets": [list(b) for b in compiled],
+                "compile_seconds": {str(k): v for k, v in secs.items()},
+                "weight_bytes": self.weight_bytes()}
+
+    def warm_from(self, metadata: Dict[str, Any]) -> Dict[Tuple, float]:
+        """Warm this (replacement) runner from a donor's
+        :meth:`ladder_metadata` — compiles the donor's bucket set,
+        restricted to buckets this runner's own ladder actually has
+        (a replacement with a different ladder warms the
+        intersection)."""
+        own = set(self.buckets())
+        donor = [tuple(b) for b in metadata.get("compiled_buckets", [])]
+        return self.warmup([b for b in donor if b in own])
 
     def weight_buffers(self) -> Tuple:
         """The committed device arrays every bucket executable reads —

@@ -96,11 +96,13 @@ class _Endpoint:
             t0 = profiler._now_us()
             try:
                 bucket, _ = runner.run_requests(batch.requests)
-            except Exception as e:  # noqa: BLE001 — fail the batch,
-                now = time.monotonic()  # never kill the worker
-                for r in batch.requests:
-                    r._fail(MXNetError(
-                        f"serving: batch execution failed: {e}"), now)
+            except Exception:  # noqa: BLE001 — requeue the batch,
+                # never kill the worker.  Each request re-enters the
+                # queue exactly once (deadline intact); a second
+                # failure — or an expired deadline — fails it there.
+                n = self.batcher.requeue(batch.requests)
+                if n:
+                    self.stats.bump("requeues", n)
                 continue
             dur = profiler._now_us() - t0
             profiler.record_span(
@@ -116,10 +118,16 @@ class _Endpoint:
             self.stats.maybe_log()
 
     def stop(self) -> None:
+        # Order matters (ISSUE 7 no-hung-waiters fix): signal the
+        # workers first and let them FINISH their current batch (those
+        # results are real), THEN close the batcher — which fails
+        # everything still queued and anything a stuck worker left in
+        # flight with WorkerLost, so no caller blocks in result()
+        # forever on a dead endpoint.
         self._stop.set()
-        self.batcher.close()
         for t in self.threads:
             t.join(timeout=2.0)
+        self.batcher.close()
 
 
 class InferenceServer:

@@ -61,6 +61,11 @@ class ServingStats:
         self.batched_requests = 0  # guarded-by: _lock
         self.queue_depth = 0  # guarded-by: _lock
         self.peak_queue_depth = 0  # guarded-by: _lock
+        # open-ended fleet counters (retries, requeues, hedges_won,
+        # drains, deaths, ...) — bump() increments, snapshot() exposes
+        # them under "extras", maybe_log() appends the nonzero ones to
+        # the Speedometer line (extended, not duplicated)
+        self.extras: Dict[str, int] = {}  # guarded-by: _lock
 
     # -- event hooks (called by batcher/server) -------------------------
     def record_queue_depth(self, depth: int) -> None:
@@ -76,6 +81,12 @@ class ServingStats:
     def record_timeout(self, n: int = 1) -> None:
         with self._lock:
             self.timed_out += n
+
+    def bump(self, key: str, n: int = 1) -> None:
+        """Increment a named fleet counter (``retries``, ``requeues``,
+        ``hedges_won``, ``drains``, ``deaths``, ...)."""
+        with self._lock:
+            self.extras[key] = self.extras.get(key, 0) + n
 
     def record_batch(self, n_real: int, capacity: int) -> None:
         with self._lock:
@@ -137,6 +148,7 @@ class ServingStats:
                 if self.batches else None,
                 "queue_depth": self.queue_depth,
                 "peak_queue_depth": self.peak_queue_depth,
+                "extras": dict(self.extras),
             }
 
     def maybe_log(self) -> Optional[str]:
@@ -159,5 +171,9 @@ class ServingStats:
                     f"queue={self.queue_depth} "
                     f"(peak {self.peak_queue_depth}) "
                     f"timeout={self.timed_out} busy={self.rejected}")
+            extras = " ".join(f"{k}={v}" for k, v in
+                              sorted(self.extras.items()) if v)
+            if extras:
+                line += " " + extras
         logger.info(line)
         return line

@@ -1,0 +1,499 @@
+"""mxtpu.serving fleet — router, health checks, retry/backoff,
+draining, fault injection (ISSUE 7).
+
+Every recovery-path scenario here is fully deterministic: the router
+is tick-driven with a hand-stepped clock (``threaded=False`` — nothing
+runs in the background) and the faults are scripted per-batch-index
+plans from :mod:`mxtpu.serving.faults`.  Each scenario test exercises
+exactly ONE recovery path.  Only the threaded smoke test and the
+slow-marked soak touch real time, and they assert outcomes, not
+latencies.
+"""
+import numpy as np
+import pytest
+
+from mxtpu import symbol as sym
+from mxtpu.base import MXNetError
+from mxtpu.serving import (Corrupt, CrashAt, FaultPlan, FleetRouter,
+                           FleetWorker, Hang, ModelRunner, QueueWedge,
+                           RequestTimeout, RetriableError, ServerBusy,
+                           SlowStart, WorkerHealth, WorkerLost,
+                           WorkerState)
+
+
+class FakeClock:
+    """Hand-stepped monotonic clock (same pattern as test_serving)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mul_runner(**kwargs):
+    """out = data * w, per-row independent (padding detectable)."""
+    data = sym.var("data")
+    w = sym.var("w")
+    return ModelRunner(data * w, {"w": np.array([1.0, 2.0, 3.0],
+                                                np.float32)},
+                       {"data": (3,)}, max_batch_size=4, **kwargs)
+
+
+CANARY_IN = {"data": np.ones(3, np.float32)}
+CANARY_OUT = [np.array([1.0, 2.0, 3.0], np.float32)]
+
+
+def _router(clk, canary=True, **kw):
+    kw.setdefault("canary_interval_s", 1.0)
+    kw.setdefault("canary_timeout_s", 0.5)
+    return FleetRouter(clock=clk, threaded=False,
+                       canary=CANARY_IN if canary else None,
+                       canary_expect=CANARY_OUT if canary else None,
+                       **kw)
+
+
+def _worker(clk, name, **kw):
+    kw.setdefault("max_queue_delay_us", 0.0)
+    return FleetWorker(_mul_runner(), name, clock=clk, **kw)
+
+
+def _payload(v):
+    return {"data": np.full(3, float(v), np.float32)}
+
+
+def _crank(router, clk, n=8, dt=0.05):
+    for _ in range(n):
+        clk.advance(dt)
+        router.tick(clk())
+
+
+# ---------------------------------------------------------- health machine
+
+def test_health_canary_cycle():
+    h = WorkerHealth("w", dead_after=3)
+    assert h.state == WorkerState.HEALTHY and h.admits()
+    h.canary_fail(1.0)
+    assert h.state == WorkerState.SUSPECT
+    assert not h.admits() and h.admits_canary()
+    h.canary_ok(2.0)
+    assert h.state == WorkerState.HEALTHY and h.failures == 0
+
+
+def test_health_dead_after_consecutive_failures():
+    h = WorkerHealth("w", dead_after=3)
+    for t in (1.0, 2.0, 3.0):
+        h.canary_fail(t)
+    assert h.state == WorkerState.DEAD
+    # dead is terminal: a late canary success cannot resurrect it
+    h.canary_ok(4.0)
+    assert h.state == WorkerState.DEAD
+    # ... only an explicit recover() can, and it demands a canary pass
+    h.recover(5.0)
+    assert h.state == WorkerState.RECOVERING and not h.admits()
+    h.canary_ok(6.0)
+    assert h.state == WorkerState.HEALTHY
+
+
+def test_health_recovering_absorbs_canary_failures():
+    h = WorkerHealth("w", dead_after=2, start_recovering=True)
+    assert h.state == WorkerState.RECOVERING
+    for t in range(10):           # slow starter: failures don't kill it
+        h.canary_fail(float(t))
+    assert h.state == WorkerState.RECOVERING
+    h.canary_ok(11.0)
+    assert h.state == WorkerState.HEALTHY
+
+
+def test_health_exec_signals_respect_canary_authority():
+    h = WorkerHealth("w")
+    h.exec_fail(1.0)
+    assert h.state == WorkerState.SUSPECT
+    h.exec_ok(2.0)                # canaries on: exec can't self-clear
+    assert h.state == WorkerState.SUSPECT
+    h2 = WorkerHealth("w2", exec_recovers=True, dead_after=2)
+    h2.exec_fail(1.0)
+    h2.exec_ok(2.0)               # canaries off: exec IS the probe
+    assert h2.state == WorkerState.HEALTHY
+
+
+def test_health_liveness_hang_and_wedge():
+    h = WorkerHealth("w", liveness_s=2.0)
+    h.liveness(1.0, 1.0, None)
+    assert h.state == WorkerState.HEALTHY
+    h.liveness(2.0, 2.5, None)
+    assert h.state == WorkerState.SUSPECT and "hang" in h.reason
+    h.liveness(3.0, 4.5, None)
+    assert h.state == WorkerState.DEAD
+    h2 = WorkerHealth("w2", liveness_s=2.0)
+    h2.liveness(1.0, None, 5.0)
+    assert h2.state == WorkerState.DEAD and "wedge" in h2.reason
+
+
+def test_health_drain_is_retirement_not_death():
+    h = WorkerHealth("w")
+    h.drain(1.0)
+    assert h.state == WorkerState.DRAINING and not h.admits()
+    h.drained(2.0)
+    assert h.state == WorkerState.DEAD and h.retired
+    snap = h.snapshot()
+    assert snap["retired"] and snap["state"] == "dead"
+
+
+# ---------------------------------------------------------- fault scripts
+
+def test_fault_plan_scripting():
+    plan = FaultPlan(CrashAt(at_batch=2), Corrupt(from_batch=5))
+    plan.before_batch(0)
+    from mxtpu.serving import WorkerCrashed
+    with pytest.raises(WorkerCrashed):
+        plan.before_batch(2)
+    assert any("crashat@2" in f for f in plan.fired)
+    early = plan.mutator(3)          # before from_batch: pass-through
+    assert early is None or np.allclose(
+        early([np.array([1.0, 2.0], np.float32)])[0], [1.0, 2.0])
+    mut = plan.mutator(6)
+    out = mut([np.array([1.0, 2.0], np.float32)])
+    assert not np.allclose(out[0], [1.0, 2.0])   # silently wrong
+    assert not plan.wedged(0)
+    assert FaultPlan(QueueWedge(after_batches=1)).wedged(1)
+
+
+# ---------------------------------------------------------- error taxonomy
+
+def test_error_taxonomy():
+    assert issubclass(ServerBusy, RetriableError)
+    assert issubclass(WorkerLost, RetriableError)
+    assert issubclass(RequestTimeout, RetriableError)
+    assert issubclass(RetriableError, MXNetError)
+    assert ServerBusy("x").retriable and WorkerLost("x").retriable
+    # a missed deadline is terminal: retrying cannot un-miss it
+    assert not RequestTimeout("x").retriable
+
+
+# ------------------------------------------------- scenario: happy path
+
+def test_fleet_happy_path_round_robin():
+    clk = FakeClock()
+    with _router(clk) as router:
+        router.add_worker(_worker(clk, "w0"))
+        router.add_worker(_worker(clk, "w1"))
+        reqs = [router.submit(_payload(i), timeout_s=5.0)
+                for i in range(4)]
+        _crank(router, clk, n=3)
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+            assert r.retries == 0 and not r.won_by_hedge
+        assert {r.tried[0] for r in reqs} == {"w0", "w1"}
+        snap = router.fleet_stats()
+        assert snap["healthy_workers"] == 2
+        assert snap["workers"]["w0"]["state"] == "healthy"
+
+
+# --------------------------------------------- scenario: crash at step k
+
+def test_fleet_crash_requeues_never_drops():
+    clk = FakeClock()
+    with _router(clk) as router:
+        router.add_worker(_worker(clk, "w0"))
+        router.add_worker(_worker(
+            clk, "w1", faults=FaultPlan(CrashAt(at_batch=0))))
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(4)]
+        _crank(router, clk)
+        assert router.workers()["w1"] == "dead"
+        stolen = 0
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(       # in-deadline: all complete
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+            if r.requeues:
+                stolen += 1
+                assert r.retries == 1 and r.tried[-1] == "w0"
+        assert stolen == 2                    # w1's share was stolen
+        snap = router.fleet_stats()
+        assert snap["extras"]["deaths"] == 1
+        assert snap["extras"]["requeues"] == 2
+
+
+# ----------------------------------------------------- scenario: hang
+
+def test_fleet_hang_detected_by_liveness():
+    clk = FakeClock()
+    with _router(clk, canary=False) as router:
+        router.add_worker(_worker(clk, "w0",
+                                  faults=FaultPlan(Hang(at_batch=0)),
+                                  liveness_s=0.1))
+        router.add_worker(_worker(clk, "w1", liveness_s=0.1))
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(2)]
+        router.tick(clk())                 # dispatch: w0 hangs mid-batch
+        hung = [r for r in reqs if r.tried[0] == "w0"]
+        assert len(hung) == 1 and not hung[0].done()
+        _crank(router, clk, n=8, dt=0.05)  # > 2x liveness passes
+        w0 = router.workers()["w0"]
+        assert w0 == "dead"
+        for i, r in enumerate(reqs):       # the hung request was stolen
+            np.testing.assert_allclose(
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+        assert hung[0].requeues == 1
+        assert "hang" in router.fleet_stats()["workers"]["w0"]["reason"]
+
+
+# ----------------------------------------------- scenario: queue wedge
+
+def test_fleet_queue_wedge_detected_by_liveness():
+    clk = FakeClock()
+    with _router(clk, canary=False) as router:
+        router.add_worker(_worker(
+            clk, "w0", faults=FaultPlan(QueueWedge(after_batches=0)),
+            liveness_s=0.1))
+        router.add_worker(_worker(clk, "w1", liveness_s=0.1))
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(2)]
+        wedged = [r for r in reqs if r.tried[0] == "w0"]
+        assert len(wedged) == 1
+        _crank(router, clk, n=8, dt=0.05)
+        assert router.workers()["w0"] == "dead"
+        assert "wedge" in \
+            router.fleet_stats()["workers"]["w0"]["reason"]
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+
+
+# ------------------------------------------ scenario: silent corruption
+
+def test_fleet_corruption_caught_by_canary():
+    clk = FakeClock()
+    with _router(clk) as router:
+        router.add_worker(_worker(
+            clk, "w0", dead_after=3,
+            faults=FaultPlan(Corrupt(from_batch=0))))
+        assert router.workers()["w0"] == "healthy"
+        # canaries run, results mismatch the expected output, and the
+        # worker dies after dead_after consecutive verdicts — no
+        # exception is ever raised; only the compare catches it
+        _crank(router, clk, n=10, dt=1.1)
+        assert router.workers()["w0"] == "dead"
+        reason = router.fleet_stats()["workers"]["w0"]["reason"]
+        assert "canary" in reason.lower()
+
+
+# ----------------------------------------- scenario: slow-start warmup
+
+def test_fleet_slow_start_recovers_via_canary():
+    clk = FakeClock()
+    with _router(clk) as router:
+        router.add_worker(_worker(clk, "w0"))
+        router.add_worker(_worker(
+            clk, "w1", start_recovering=True,
+            faults=FaultPlan(SlowStart(first_n=2))))
+        assert router.workers()["w1"] == "recovering"
+        req = router.submit(_payload(5), timeout_s=10.0)
+        router.tick(clk())
+        assert req.tried == ["w0"]         # no client traffic while
+        req.result(timeout=0)              # still recovering
+        _crank(router, clk, n=6, dt=1.1)   # canaries warm it up
+        assert router.workers()["w1"] == "healthy"
+        # now it takes traffic again
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(4)]
+        _crank(router, clk, n=2)
+        assert {r.tried[0] for r in reqs} == {"w0", "w1"}
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+
+
+# --------------------------------------------- scenario: drain + handoff
+
+def test_fleet_drain_handoff_warm_replacement():
+    clk = FakeClock()
+    with _router(clk) as router:
+        w0 = _worker(clk, "w0")
+        router.add_worker(w0)
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(3)]
+        _crank(router, clk, n=2)
+        for r in reqs:
+            r.result(timeout=0)
+        meta = router.drain("w0")          # preemption notice arrives
+        assert router.workers()["w0"] == "draining"
+        assert meta["max_batch_size"] == 4
+        assert meta["compiled_buckets"]    # donor working set
+        _crank(router, clk, n=2)
+        assert router.workers()["w0"] == "dead"
+        assert router.fleet_stats()["workers"]["w0"]["retired"]
+        assert "deaths" not in router.fleet_stats()["extras"]
+        # replacement warms the donor's compiled ladder before traffic
+        w2 = _worker(clk, "w2")
+        router.add_worker(w2, warm_from=meta)
+        assert w2.runner.num_compiled() >= len(meta["compiled_buckets"])
+        req = router.submit(_payload(7), timeout_s=10.0)
+        _crank(router, clk, n=2)
+        np.testing.assert_allclose(req.result(timeout=0)[0],
+                                   [7.0, 14.0, 21.0])
+        assert req.tried == ["w2"]
+
+
+# ------------------------------------------------- scenario: hard kill
+
+def test_fleet_kill_steals_outstanding():
+    clk = FakeClock()
+    with _router(clk, canary=False) as router:
+        router.add_worker(_worker(clk, "w0"))
+        router.add_worker(_worker(clk, "w1"))
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(4)]
+        router.kill("w0")                  # preemption, no flush
+        _crank(router, clk, n=4)
+        for i, r in enumerate(reqs):       # zero in-deadline drops
+            np.testing.assert_allclose(
+                r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+        assert all(r.tried[-1] == "w1" for r in reqs)
+
+
+# ------------------------------------------- retry/backoff determinism
+
+def test_backoff_deterministic_and_capped():
+    clk = FakeClock()
+    r1 = _router(clk, canary=False, seed=7, backoff_base_us=1000,
+                 backoff_cap_us=64000, jitter=0.2)
+    r2 = _router(clk, canary=False, seed=7, backoff_base_us=1000,
+                 backoff_cap_us=64000, jitter=0.2)
+    seq1 = [r1._backoff_s(n) for n in range(1, 10)]
+    seq2 = [r2._backoff_s(n) for n in range(1, 10)]
+    assert seq1 == seq2                    # seeded: reproducible
+    assert all(b <= 64000 * 1.2 / 1e6 for b in seq1)
+    assert seq1[0] >= 1000 / 1e6           # base + non-negative jitter
+    # exponential growth until the cap
+    bare = [b / (1 + 0.2) for b in seq1]   # strip max jitter bound
+    assert bare[3] > bare[0]
+    r1.close()
+    r2.close()
+
+
+def test_fleet_retry_exhaustion_fails_terminally():
+    clk = FakeClock()
+    with _router(clk, canary=False, retry_max=1,
+                 backoff_base_us=100) as router:
+        # every worker crashes on every batch: retries must exhaust
+        router.add_worker(_worker(
+            clk, "w0", faults=FaultPlan(*[CrashAt(at_batch=k)
+                                          for k in range(8)])))
+        router.add_worker(_worker(
+            clk, "w1", faults=FaultPlan(*[CrashAt(at_batch=k)
+                                          for k in range(8)])))
+        req = router.submit(_payload(1), timeout_s=50.0)
+        _crank(router, clk, n=6)
+        assert req.done() and req.retries == 1
+        with pytest.raises(WorkerLost):
+            req.result(timeout=0)
+
+
+def test_fleet_deadline_expiry_is_timeout_not_loop():
+    clk = FakeClock()
+    with _router(clk, canary=False) as router:
+        router.add_worker(_worker(
+            clk, "w0", faults=FaultPlan(QueueWedge(after_batches=0)),
+            liveness_s=50.0))              # wedge never detected: the
+        req = router.submit(_payload(1), timeout_s=0.2)   # deadline
+        _crank(router, clk, n=8, dt=0.1)   # machinery must still fire
+        assert req.done()
+        with pytest.raises(RequestTimeout):
+            req.result(timeout=0)
+
+
+def test_fleet_pending_buffer_sheds_server_busy():
+    clk = FakeClock()
+    with _router(clk, canary=False, max_pending=2) as router:
+        router.add_worker(_worker(clk, "w0", start_recovering=True))
+        router.submit(_payload(0), timeout_s=5.0)   # parked: no
+        router.submit(_payload(1), timeout_s=5.0)   # healthy worker
+        with pytest.raises(ServerBusy):
+            router.submit(_payload(2), timeout_s=5.0)
+
+
+# ------------------------------------------------------ scenario: hedge
+
+def test_fleet_hedged_request_wins_elsewhere():
+    clk = FakeClock()
+    with _router(clk, canary=False, hedge_after_us=100) as router:
+        router.add_worker(_worker(
+            clk, "w0", faults=FaultPlan(QueueWedge(after_batches=0)),
+            liveness_s=100.0))             # slow, not (yet) dead
+        router.add_worker(_worker(clk, "w1", liveness_s=100.0))
+        req = router.submit(_payload(2), timeout_s=10.0)
+        while req.tried[:1] != ["w0"]:     # force the slow worker first
+            req = router.submit(_payload(2), timeout_s=10.0)
+        router.tick(clk())
+        assert not req.done()              # stuck behind the wedge
+        _crank(router, clk, n=3, dt=0.01)  # > hedge_after_us passes
+        np.testing.assert_allclose(req.result(timeout=0)[0],
+                                   [2.0, 4.0, 6.0])
+        assert req.won_by_hedge and req.hedges == 1
+        assert req.tried[-1] == "w1"
+        assert router.fleet_stats()["extras"]["hedges_won"] == 1
+
+
+# --------------------------------------------------- threaded smoke
+
+def test_fleet_threaded_smoke_with_kill():
+    router = FleetRouter(threaded=True, tick_s=0.002,
+                         canary=CANARY_IN, canary_expect=CANARY_OUT,
+                         canary_interval_s=0.05,
+                         canary_timeout_s=1.0)
+    with router:
+        router.add_worker(FleetWorker(_mul_runner(), "w0",
+                                      max_queue_delay_us=500.0))
+        router.add_worker(FleetWorker(_mul_runner(), "w1",
+                                      max_queue_delay_us=500.0))
+        reqs = [router.submit(_payload(i % 5), timeout_s=10.0)
+                for i in range(8)]
+        router.kill("w0")
+        reqs += [router.submit(_payload(i % 5), timeout_s=10.0)
+                 for i in range(8, 16)]
+        for i, r in enumerate(reqs):       # nobody hangs, nobody drops
+            v = i % 5
+            np.testing.assert_allclose(r.result(timeout=10.0)[0],
+                                       [v, 2.0 * v, 3.0 * v])
+        snap = router.fleet_stats()
+        assert snap["workers"]["w0"]["state"] == "dead"
+        assert snap["workers"]["w1"]["state"] == "healthy"
+        assert snap["completed"] == 16
+
+
+@pytest.mark.slow
+def test_fleet_kill_restart_soak():
+    """Kill/restart soak: sustained traffic, a worker killed mid-run,
+    a warm replacement attached from the drain handoff — zero
+    in-deadline requests dropped or hanging."""
+    router = FleetRouter(threaded=True, tick_s=0.002,
+                         canary=CANARY_IN, canary_expect=CANARY_OUT,
+                         canary_interval_s=0.05, canary_timeout_s=1.0)
+    with router:
+        w0 = FleetWorker(_mul_runner(), "w0", max_queue_delay_us=500.0)
+        router.add_worker(w0)
+        router.add_worker(FleetWorker(_mul_runner(), "w1",
+                                      max_queue_delay_us=500.0))
+        meta = w0.handoff()
+        reqs = []
+        for i in range(120):
+            reqs.append(router.submit(_payload(i % 7), timeout_s=30.0))
+            if i == 40:
+                router.kill("w0")
+            if i == 60:
+                router.add_worker(FleetWorker(
+                    _mul_runner(), "w2", max_queue_delay_us=500.0),
+                    warm_from=meta)
+        for i, r in enumerate(reqs):
+            v = i % 7
+            np.testing.assert_allclose(r.result(timeout=30.0)[0],
+                                       [v, 2.0 * v, 3.0 * v])
+        snap = router.fleet_stats()
+        assert snap["completed"] == 120
+        assert snap["workers"]["w2"]["state"] == "healthy"

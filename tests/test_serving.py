@@ -15,7 +15,7 @@ from mxtpu import symbol as sym
 from mxtpu.base import MXNetError
 from mxtpu.serving import (DynamicBatcher, InferenceServer, ModelRunner,
                            RequestTimeout, ServerBusy, ServingStats,
-                           batch_ladder)
+                           WorkerLost, batch_ladder)
 
 
 class FakeClock:
@@ -177,10 +177,79 @@ def test_batcher_close_fails_queued():
                        clock=fc)
     req = b.submit("x")
     b.close()
-    with pytest.raises(MXNetError, match="closed"):
+    with pytest.raises(WorkerLost, match="closed"):
         req.result(timeout=0)
-    with pytest.raises(MXNetError, match="closed"):
+    with pytest.raises(WorkerLost, match="closed"):
         b.submit("y")
+
+
+def test_batcher_close_fails_inflight():
+    """ISSUE 7 no-hung-waiters fix: a request already PULLED into a
+    batch when the worker dies must fail too — before, only the queue
+    was failed and result() hung forever."""
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=2, max_queue_delay_us=0,
+                       clock=fc)
+    r1 = b.submit("x")
+    r2 = b.submit("y")
+    batch = b.poll(fc())                  # dispatched, not completed
+    assert batch is not None and len(batch) == 2
+    b.close()
+    for r in (r1, r2):
+        assert r.done()
+        with pytest.raises(WorkerLost):
+            r.result(timeout=0)
+
+
+def test_batcher_requeue_once_with_original_accounting():
+    """A failed batch re-enters the queue exactly once, at the FRONT,
+    with its original deadline and t_submit intact — queue_us spans
+    submit -> final dequeue."""
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=2, max_queue_delay_us=0,
+                       clock=fc)
+    old = b.submit("old", timeout_s=10.0)
+    fc.advance(0.5)
+    batch = b.poll(fc())
+    assert batch.requests == [old]
+    newer = b.submit("new")
+    assert b.requeue(batch.requests) == 1
+    assert old.requeues == 1
+    assert old.t_dequeue is None          # accounting reset, t_submit
+    assert old.deadline == 100.0 + 10.0   # and deadline preserved
+    fc.advance(0.5)
+    again = b.poll(fc())                  # front of the queue: the
+    assert again.requests[0] is old       # requeued one beats `newer`
+    old._complete("v", fc())
+    assert old.queue_us == pytest.approx(1.0 * 1e6)  # submit->redequeue
+    assert newer in again.requests or b.depth == 1
+
+
+def test_batcher_requeue_second_failure_is_worker_lost():
+    fc = FakeClock()
+    b = DynamicBatcher(max_batch_size=1, max_queue_delay_us=0,
+                       clock=fc)
+    r = b.submit("x")
+    b.requeue(b.poll(fc()).requests)
+    assert b.requeue(b.poll(fc()).requests) == 0   # burned its retry
+    assert r.done()
+    with pytest.raises(WorkerLost, match="again"):
+        r.result(timeout=0)
+
+
+def test_batcher_requeue_expired_deadline_times_out_not_loops():
+    fc = FakeClock()
+    timeouts = []
+    b = DynamicBatcher(max_batch_size=1, max_queue_delay_us=0,
+                       clock=fc, on_timeout=lambda n: timeouts.append(n))
+    r = b.submit("x", timeout_s=0.1)
+    batch = b.poll(fc())
+    fc.advance(0.5)                       # deadline passes mid-flight
+    assert b.requeue(batch.requests) == 0
+    assert b.depth == 0                   # expired, NOT re-enqueued
+    with pytest.raises(RequestTimeout):
+        r.result(timeout=0)
+    assert timeouts == [1]
 
 
 def test_batcher_wait_next_blocks_until_submit():
