@@ -54,13 +54,16 @@ import time
 
 import numpy as np
 
-from mxtpu import guards, knobs
+from mxtpu import guards, knobs, obs
 
 # MXTPU_GUARDS must never change bench semantics: self_check asserts
 # the disabled scope is the shared no-op object (zero per-call
 # overhead when guards are off) and, when enabled, that a jitted
 # probe returns bit-identical results inside the guard scope.
 guards.self_check()
+# Same contract for MXTPU_OBS: disabled instruments are the shared
+# no-op singletons, and the exposition formats round-trip losslessly.
+obs.self_check()
 
 # Peak dense bf16 FLOP/s per chip, by jax device_kind prefix.
 # v5 lite (v5e) 197 TFLOP/s; v5p 459; v4 275; v3 123 (bf16).
@@ -1077,6 +1080,9 @@ def main():
         if stats.get("info"):
             # row-specific context (e.g. moe_ffn's dense-FFN envelope)
             results[model]["details"] = stats["info"]
+        # ISSUE 8: every row carries the obs registry state as of its
+        # run — compile counts, step-time histograms, serving counters
+        results[model].setdefault("details", {})["obs"] = obs.summary()
     primary = next((results[m] for m in order
                     if results[m]["value"] is not None),
                    results[order[0]])

@@ -35,6 +35,7 @@ import warnings
 from typing import Any, Dict, Optional
 
 from . import knobs
+from . import obs
 from .base import MXNetError
 
 __all__ = ["enabled", "strict", "ChurnDetector", "RecompileChurn",
@@ -80,6 +81,13 @@ class ChurnDetector:
         self.calls = 0           # guarded-by: _lock
         self._last_keys = []     # guarded-by: _lock
         self._tripped = False    # guarded-by: _lock
+        # ISSUE 8: cache misses also land in the process-wide metrics
+        # registry so churn across every entry shows up in one scrape
+        self._obs = obs.enabled()
+        self._m_miss = obs.counter(
+            "mxtpu_compile_cache_miss_total",
+            "jit cache misses per guarded entry (ChurnDetector).",
+            labels=("entry",)).labels(entry=name)
 
     @property
     def limit(self) -> int:
@@ -93,6 +101,8 @@ class ChurnDetector:
 
     def note_compile(self, key: Any = None) -> None:
         """Record one jit cache miss; trips the guard past the limit."""
+        if self._obs:
+            self._m_miss.inc()
         with self._lock:
             self.compiles += 1
             self._last_keys.append(key)

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from . import obs
 from .base import MXNetError
 from .ndarray import NDArray, array
 
@@ -371,6 +372,12 @@ class DeviceFeedIter(DataIter):
             else jax.devices()[0]
         self._pending: Optional[DataBatch] = None
         self._done = False
+        # ISSUE 8: staged-batch throughput in the obs registry
+        self._obs = obs.enabled()
+        self._m_batches = obs.counter(
+            "mxtpu_io_batches_total",
+            "Batches staged to device, per iterator kind.",
+            labels=("iter",)).labels(iter="device_feed")
 
     @property
     def provide_data(self):
@@ -398,9 +405,12 @@ class DeviceFeedIter(DataIter):
 
     def _pull(self) -> Optional[DataBatch]:
         try:
-            return self._stage(self.data_iter.next())
+            batch = self._stage(self.data_iter.next())
         except StopIteration:
             return None
+        if self._obs:
+            self._m_batches.inc()
+        return batch
 
     def reset(self):
         self.data_iter.reset()

@@ -157,6 +157,22 @@ register("MXTPU_HLO_AUDIT", "", "str",
          "against committed lockfiles live in `python -m "
          "tools.hlocheck`.", "guards")
 
+# -- observability (mxtpu.obs) -----------------------------------------
+register("MXTPU_OBS", True, "bool",
+         "Unified observability layer (mxtpu.obs): metrics registry, "
+         "per-request trace ids, flight recorders.  `0` = off: the "
+         "factories hand back shared no-op instruments, so hot paths "
+         "pay nothing (asserted by `obs.self_check()` at bench "
+         "import).", "obs")
+register("MXTPU_OBS_FLIGHT_CAPACITY", 256, "int",
+         "Flight-recorder ring size — structured events kept per "
+         "worker (oldest evicted first).", "obs")
+register("MXTPU_OBS_DUMP_ON_ERROR", "", "str",
+         "Extra flight-recorder postmortems: unset = dump only on "
+         "worker death; `1` also dumps every recorder when a fleet "
+         "request fails terminally; a directory path additionally "
+         "writes each postmortem there as JSON.", "obs")
+
 # -- numerics / engine -------------------------------------------------
 register("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice", "str",
          "`NaiveEngine` forces synchronous execution for debugging "
@@ -275,6 +291,7 @@ register("MXTPU_TEST_SLOW", False, "bool",
 _GROUP_TITLES = [
     ("kill-switch", "Performance kill switches"),
     ("guards", "Runtime guards"),
+    ("obs", "Observability"),
     ("engine", "Engine / numerics"),
     ("serving", "Serving"),
     ("fleet", "Serving fleet"),

@@ -1,0 +1,241 @@
+"""``mxtpu.obs`` — the one observability layer (ISSUE 8).
+
+Three surfaces behind one switch (``MXTPU_OBS``, default on):
+
+* **Metrics registry** (:mod:`.metrics`) — typed counters / gauges /
+  histograms with label sets, O(1) under leaf locks, exported as
+  Prometheus text (:func:`prometheus_text`) and a JSON snapshot
+  (:func:`snapshot`) that carry the same values.  ``ServingStats``,
+  the fleet counters, ``guards.ChurnDetector``, ``DeviceFeedIter``
+  and ``TrainStep`` all publish here.
+* **Per-request tracing** (:mod:`.trace`) — trace ids minted at
+  submit, phase spans through the chrome-trace profiler,
+  :func:`trace_of` to rebuild one request's timeline.
+* **Flight recorder** (:mod:`.recorder`) — bounded per-worker ring of
+  structured events (health transitions, canary results, compile
+  misses, evictions, fault firings), dumped on worker death or
+  ``MXTPU_OBS_DUMP_ON_ERROR``.
+
+Zero-overhead-when-off contract (guards-style, asserted by
+:func:`self_check` which ``bench.py`` runs at import): with
+``MXTPU_OBS=0`` the factories return the SHARED no-op singletons
+(:data:`metrics.NULL_COUNTER` …, :data:`recorder.NULL_RECORDER`) — no
+registration, no locks, no allocation on the hot path — and results
+of any serving/training computation are bit-identical on vs off
+(observability never touches what is computed).
+
+Naming convention: ``mxtpu_<subsystem>_<metric>[_total|_seconds|_us|
+_bytes]`` — enforced at creation here and statically by the
+``obs-registry`` mxlint rule.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from .. import knobs
+from ..base import MXNetError
+from . import metrics as metrics
+from . import recorder as recorder
+from . import trace as trace
+from .metrics import (DEFAULT_BUCKETS, MetricsRegistry, NULL_COUNTER,
+                      NULL_GAUGE, NULL_HISTOGRAM,
+                      parse_prometheus_text, samples_from_snapshot)
+from .recorder import NULL_RECORDER, FlightRecorder
+from .trace import (SPAN_BACKOFF, SPAN_EXECUTE, SPAN_HEDGE,
+                    SPAN_PAD_SCATTER, SPAN_QUEUE_WAIT, SPAN_REDISPATCH,
+                    SPAN_REQUEUE, SPAN_RUN, SPAN_STEAL, SPAN_SUBMIT,
+                    new_trace_id, span, trace_of)
+
+__all__ = [
+    "enabled", "registry", "counter", "gauge", "histogram",
+    "prometheus_text", "snapshot", "summary", "reset",
+    "flight", "flight_recorders", "dump_all", "dump_on_error_path",
+    "new_trace_id", "span", "trace_of", "self_check",
+    "MetricsRegistry", "FlightRecorder",
+    "NULL_COUNTER", "NULL_GAUGE", "NULL_HISTOGRAM", "NULL_RECORDER",
+    "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE", "SPAN_BACKOFF",
+    "SPAN_STEAL", "SPAN_REDISPATCH", "SPAN_HEDGE", "SPAN_PAD_SCATTER",
+    "SPAN_RUN", "SPAN_REQUEUE",
+]
+
+_REGISTRY = MetricsRegistry()
+_FLIGHT_LOCK = threading.Lock()
+_FLIGHT: Dict[str, FlightRecorder] = {}  # guarded-by: _FLIGHT_LOCK
+
+
+def enabled() -> bool:
+    """Observability on?  ``MXTPU_OBS`` (default on; ``0`` = off)."""
+    return bool(knobs.get("MXTPU_OBS"))
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide metrics registry (always the real one —
+    gating happens in the factory functions below)."""
+    return _REGISTRY
+
+
+# -- instrument factories (the only sanctioned way to make metrics) ----
+def counter(name: str, help: str = "", labels: Sequence[str] = (),
+            enabled_override: Optional[bool] = None):
+    """Get-or-create a process-wide counter; the shared no-op when
+    obs is off.  Construct once (init time), ``inc()`` on hot paths."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_COUNTER
+    return _REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels: Sequence[str] = (),
+          enabled_override: Optional[bool] = None):
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_GAUGE
+    return _REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS,
+              enabled_override: Optional[bool] = None):
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_HISTOGRAM
+    return _REGISTRY.histogram(name, help, labels, buckets)
+
+
+# -- export surfaces ---------------------------------------------------
+def prometheus_text() -> str:
+    return _REGISTRY.prometheus_text()
+
+
+def snapshot() -> Dict[str, Any]:
+    return _REGISTRY.snapshot()
+
+
+def summary() -> Dict[str, Any]:
+    """Flat ``{name{labels}: value-or-histogram-summary}`` view (the
+    shape bench.py embeds in every row's ``details["obs"]``)."""
+    return _REGISTRY.summary()
+
+
+def reset() -> None:
+    """Tests only: drop all metric families and flight recorders."""
+    _REGISTRY.reset()
+    with _FLIGHT_LOCK:
+        _FLIGHT.clear()
+
+
+# -- flight recorders --------------------------------------------------
+def flight(name: str, capacity: Optional[int] = None,
+           clock: Optional[Callable[[], float]] = None,
+           enabled_override: Optional[bool] = None):
+    """Get-or-create the named flight recorder; the shared no-op when
+    obs is off.  ``clock`` only applies on first creation (fleet
+    workers pass their injected clock for deterministic tests)."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_RECORDER
+    with _FLIGHT_LOCK:
+        rec = _FLIGHT.get(name)
+        if rec is None:
+            kw: Dict[str, Any] = {"capacity": capacity}
+            if clock is not None:
+                kw["clock"] = clock
+            rec = _FLIGHT[name] = FlightRecorder(name, **kw)
+        return rec
+
+
+def flight_recorders() -> Dict[str, FlightRecorder]:
+    with _FLIGHT_LOCK:
+        return dict(_FLIGHT)
+
+
+def dump_all(reason: str = "", path: Optional[str] = None
+             ) -> Dict[str, str]:
+    """Dump every live flight recorder (``{name: json}``)."""
+    return {name: rec.dump(reason, path=path)
+            for name, rec in flight_recorders().items()}
+
+
+def dump_on_error_path() -> Optional[str]:
+    """``MXTPU_OBS_DUMP_ON_ERROR`` decoded: None = off, "" = log
+    only, a string = also write JSON under that directory."""
+    raw = str(knobs.get("MXTPU_OBS_DUMP_ON_ERROR")).strip()
+    if not raw or raw.lower() in ("0", "false", "no", "off"):
+        return None
+    if raw.lower() in ("1", "true", "yes", "on", "stderr"):
+        return ""
+    return raw
+
+
+# -- self check --------------------------------------------------------
+def self_check(probe: bool = False) -> Dict[str, Any]:
+    """The import-time assertion bench.py runs (mirror of
+    ``guards.self_check``):
+
+    * disabled ⇒ every factory returns its SHARED no-op singleton
+      (no allocation, no registration — zero overhead);
+    * the two export surfaces agree: a parsed Prometheus text dump
+      carries exactly the samples a flattened JSON snapshot does
+      (exercised on a private throwaway registry);
+    * ``probe=True`` additionally dispatches a tiny jitted computation
+      with instruments firing around it and asserts bit-identical
+      results vs the bare run (obs never touches what is computed).
+    """
+    if counter("mxtpu_self_check_total",
+               enabled_override=False) is not NULL_COUNTER \
+            or gauge("mxtpu_self_check",
+                     enabled_override=False) is not NULL_GAUGE \
+            or histogram("mxtpu_self_check_seconds",
+                         enabled_override=False) is not NULL_HISTOGRAM:
+        raise MXNetError(
+            "obs self_check: disabled metric factory is not the "
+            "shared no-op singleton")
+    if flight("self_check",
+              enabled_override=False) is not NULL_RECORDER:
+        raise MXNetError(
+            "obs self_check: disabled flight factory is not the "
+            "shared no-op recorder")
+
+    # Round-trip on a private registry (never pollutes the process one)
+    reg = MetricsRegistry()
+    c = reg.counter("mxtpu_selfcheck_events_total", "probe",
+                    labels=("kind",))
+    c.labels(kind="a").inc(3)
+    c.labels(kind='b"\\esc\n').inc()
+    reg.gauge("mxtpu_selfcheck_depth", "probe").set(-2.5)
+    h = reg.histogram("mxtpu_selfcheck_lat_seconds", "probe",
+                      buckets=(0.001, 0.1, 2.0))
+    for v in (0.0005, 0.05, 0.05, 5.0):
+        h.observe(v)
+    text_samples = parse_prometheus_text(reg.prometheus_text())
+    snap_samples = samples_from_snapshot(reg.snapshot())
+    if text_samples != snap_samples:
+        raise MXNetError(
+            f"obs self_check: exposition surfaces disagree — "
+            f"text={text_samples} snapshot={snap_samples}")
+
+    info: Dict[str, Any] = {
+        "enabled": enabled(),
+        "flight_capacity": int(knobs.get("MXTPU_OBS_FLIGHT_CAPACITY")),
+        "round_trip_samples": len(text_samples),
+    }
+    if probe:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        fn = jax.jit(lambda v: v * 3 - 1)
+        x = jnp.arange(8, dtype=jnp.float32)
+        bare = np.asarray(fn(x))
+        reg2 = MetricsRegistry()
+        pc = reg2.counter("mxtpu_selfcheck_probe_total")
+        ph = reg2.histogram("mxtpu_selfcheck_probe_seconds")
+        pc.inc()
+        instrumented = np.asarray(fn(x))
+        ph.observe(0.0)
+        if not np.array_equal(bare, instrumented):
+            raise MXNetError(
+                "obs self_check: instrumented dispatch changed "
+                "results")
+        info["probe"] = True
+    return info

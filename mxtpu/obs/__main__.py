@@ -1,0 +1,41 @@
+"""``python -m mxtpu.obs`` — operator CLI for the observability layer.
+
+* ``--self-check`` (default): run :func:`mxtpu.obs.self_check` and
+  print the info dict; non-zero exit on contract violation.  This is
+  the stage ``tools/ci_static.py`` runs.
+* ``--prom``: print the Prometheus text exposition of the process
+  registry.
+* ``--json``: print the JSON snapshot.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import prometheus_text, self_check, snapshot
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m mxtpu.obs")
+    ap.add_argument("--self-check", action="store_true",
+                    help="assert the zero-overhead + round-trip "
+                         "contracts (default action)")
+    ap.add_argument("--prom", action="store_true",
+                    help="print Prometheus text exposition")
+    ap.add_argument("--json", action="store_true",
+                    help="print JSON metrics snapshot")
+    args = ap.parse_args(argv)
+    if args.prom:
+        sys.stdout.write(prometheus_text())
+        return 0
+    if args.json:
+        print(json.dumps(snapshot(), indent=2, default=str))
+        return 0
+    info = self_check()
+    print(f"obs.self_check OK: {info}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from .. import guards
 from .. import knobs
+from .. import obs
+from .. import profiler as _prof
 from .. import optimizer as opt_mod
 from ..ndarray import random as _rnd
 from ..ndarray.ndarray import NDArray
@@ -346,6 +348,25 @@ class TrainStep:
         self._guards = guards.enabled()
         self._churn = guards.ChurnDetector(
             f"TrainStep[{type(net).__name__}]")
+        # ISSUE 8: obs registry instruments — step wall time, compile
+        # events, and the compiler-estimated FLOPs/step (the MFU
+        # numerator, set by cost_analysis).  Same cached-bool contract
+        # as _guards: MXTPU_OBS=0 costs one bool test per step.
+        self._obs = obs.enabled()
+        _entry = f"TrainStep[{type(net).__name__}]"
+        self._m_step = obs.histogram(
+            "mxtpu_train_step_seconds",
+            "Wall time per optimizer step (dispatch + writeback).",
+            labels=("entry",)).labels(entry=_entry)
+        self._m_compile = obs.counter(
+            "mxtpu_train_compile_total",
+            "TrainStep executable builds (one per new signature).",
+            labels=("entry",)).labels(entry=_entry)
+        self._m_flops = obs.gauge(
+            "mxtpu_train_flops_per_step",
+            "XLA cost_analysis FLOPs of the one-step program "
+            "(MFU numerator; 0 until cost_analysis runs).",
+            labels=("entry",)).labels(entry=_entry)
 
     def _decide_zero(self, zero) -> bool:
         """Resolve the ZeRO-1 mode: ``MXTPU_ZERO=0`` is the global
@@ -765,6 +786,8 @@ class TrainStep:
         if entry is None:
             if self._guards:
                 self._churn.note_compile(sig)
+            if self._obs:
+                self._m_compile.inc()
             entry = self._build(key, x_raw, y_raw)
             self._compiled[sig] = entry
         return entry
@@ -793,6 +816,7 @@ class TrainStep:
                             for i in entry["frozen_idx"])
         if self._guards:
             self._churn.note_call()
+        t0 = _prof._now_us() if self._obs else 0.0
         with guards.no_implicit_transfers(self._guards):
             loss, new_vals, new_state, raw_aux = entry["fn"](
                 train_vals, frozen_vals, self._opt_state,
@@ -802,6 +826,8 @@ class TrainStep:
         self._opt_state = new_state
         for p, v in zip(entry["aux_params"], raw_aux):
             p._data._data = v
+        if self._obs:
+            self._m_step.observe((_prof._now_us() - t0) / 1e6)
         return NDArray(loss, None, _placed=True)
 
     # -- bulked execution -------------------------------------------------
@@ -860,6 +886,8 @@ class TrainStep:
         if entry is None:
             if self._guards:
                 self._churn.note_compile(sig)
+            if self._obs:
+                self._m_compile.inc()
             xb0 = xs if reuse_batch else xs[0]
             yb0 = ys if reuse_batch else (ys[0] if ys.ndim else ys)
             entry = self._build(key, xb0, yb0)
@@ -879,6 +907,8 @@ class TrainStep:
         if multi is None:
             if self._guards:
                 self._churn.note_compile(msig)
+            if self._obs:
+                self._m_compile.inc()
             raw_step = entry["raw_step"]
             aux_pos = entry["aux_pos"]
 
@@ -921,6 +951,7 @@ class TrainStep:
             self._compiled[msig] = multi
         if self._guards:
             self._churn.note_call()
+        t0 = _prof._now_us() if self._obs else 0.0
         with guards.no_implicit_transfers(self._guards):
             losses, tv, frozen, st = multi(
                 train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
@@ -930,6 +961,11 @@ class TrainStep:
         for j, i in enumerate(entry["frozen_idx"]):
             params[i]._data._data = frozen[j]
         self._opt_state = st
+        if self._obs:
+            # one sample of amortized per-step wall time — dispatch is
+            # paid once for the whole scan, which is the point
+            self._m_step.observe(
+                (_prof._now_us() - t0) / 1e6 / steps)
         return NDArray(losses, None, _placed=True)
 
     # -- introspection ----------------------------------------------------
@@ -946,7 +982,11 @@ class TrainStep:
         compiled = self._compiled_for(x, y)
         ca = compiled.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        return dict(ca)
+        ca = dict(ca)
+        if self._obs and ca.get("flops"):
+            # cost_analysis returns host floats — no device sync here
+            self._m_flops.set(float(ca["flops"]))  # mxlint: sync-point
+        return ca
 
     def _compiled_for(self, x, y):
         """The compiled one-step executable for this (x, y) signature

@@ -20,18 +20,20 @@ from typing import Dict, List, Optional
 
 from .base import MXNetError
 
-__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "Task", "Frame", "Event", "Counter", "Marker",
-           "record_span", "start_jax_trace", "stop_jax_trace"]
+__all__ = ["set_config", "set_state", "state", "is_active", "dump",
+           "dumps", "pause", "resume", "events", "Task", "Frame",
+           "Event", "Counter", "Marker", "record_span",
+           "start_jax_trace", "stop_jax_trace"]
 
 _ACTIVE = False          # fast-path flag read by the op dispatcher
-_PAUSED = False
+_PAUSED = False          # guarded-by: _LOCK
 _LOCK = threading.Lock()
-_EVENTS: List[dict] = []
+_EVENTS: List[dict] = []  # guarded-by: _LOCK
 _CONFIG = {"filename": "profile.json", "aggregate_stats": False,
            "profile_imperative": True, "profile_api": True,
+           "profile_symbolic": True,
            "profile_memory": False, "profile_all": False}
-_START_TS: Optional[float] = None
+_START_TS: Optional[float] = None  # guarded-by: _LOCK
 
 
 def _now_us() -> float:
@@ -41,39 +43,58 @@ def _now_us() -> float:
 def set_config(**kwargs):
     """Configure (reference ``set_config``†).  Recognized keys:
     filename, aggregate_stats, profile_all, profile_symbolic,
-    profile_imperative, profile_memory, profile_api."""
-    for k, v in kwargs.items():
-        _CONFIG[k] = v
+    profile_imperative, profile_memory, profile_api.  Unknown keys
+    raise — silently accepting a typo (``filname=...``) used to leave
+    the profiler writing to the default path with no diagnostic."""
+    unknown = set(kwargs) - set(_CONFIG)
+    if unknown:
+        raise MXNetError(
+            f"profiler.set_config: unknown key(s) {sorted(unknown)}; "
+            f"recognized: {sorted(_CONFIG)}")
+    with _LOCK:
+        _CONFIG.update(kwargs)
 
 
 def set_state(state_: str = "stop"):
-    """'run' or 'stop' (reference ``set_state``†)."""
-    global _ACTIVE, _START_TS
+    """'run' or 'stop' (reference ``set_state``†).  ``stop`` also
+    clears any pending pause so a later ``resume()`` cannot silently
+    re-activate a stopped profiler."""
+    global _ACTIVE, _START_TS, _PAUSED
     if state_ not in ("run", "stop"):
         raise MXNetError("state must be 'run' or 'stop'")
-    if state_ == "run":
-        if _START_TS is None:
-            _START_TS = _now_us()
-        _ACTIVE = True
-    else:
-        _ACTIVE = False
+    with _LOCK:
+        if state_ == "run":
+            if _START_TS is None:
+                _START_TS = _now_us()
+            _ACTIVE, _PAUSED = True, False
+        else:
+            _ACTIVE, _PAUSED = False, False
 
 
 def state() -> str:
     return "run" if _ACTIVE else "stop"
 
 
+def is_active() -> bool:
+    """Cheap hot-path gate: True while the profiler collects.  Callers
+    that build span ``args`` dicts should check this first so the
+    profiler-off path stays allocation-free."""
+    return _ACTIVE
+
+
 def pause():
     """Temporarily stop collection (reference ``pause``†)."""
     global _ACTIVE, _PAUSED
-    if _ACTIVE:
-        _ACTIVE, _PAUSED = False, True
+    with _LOCK:
+        if _ACTIVE:
+            _ACTIVE, _PAUSED = False, True
 
 
 def resume():
     global _ACTIVE, _PAUSED
-    if _PAUSED:
-        _ACTIVE, _PAUSED = True, False
+    with _LOCK:
+        if _PAUSED:
+            _ACTIVE, _PAUSED = True, False
 
 
 def _record(name: str, cat: str, ts_us: float, dur_us: float,
@@ -117,6 +138,15 @@ def dumps(reset: bool = False) -> str:
         if reset:
             _EVENTS.clear()
     return out
+
+
+def events() -> List[dict]:
+    """Locked snapshot of the recorded trace events (shallow copies —
+    mutating the returned dicts cannot corrupt the trace buffer).
+    ``mxtpu.obs.trace_of`` reads this to rebuild per-request
+    timelines."""
+    with _LOCK:
+        return [dict(ev) for ev in _EVENTS]
 
 
 def dump(finished: bool = True, profile_process: str = "worker"):

@@ -28,6 +28,8 @@ import time
 from typing import Any, Callable, List, Optional
 
 from ..base import MXNetError
+from .. import profiler
+from ..obs import trace as _trace
 
 __all__ = ["RetriableError", "ServerBusy", "RequestTimeout",
            "WorkerLost", "InferenceRequest", "Batch", "DynamicBatcher"]
@@ -73,17 +75,19 @@ class InferenceRequest:
 
     __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
                  "_event", "_value", "_error", "t_dequeue", "t_done",
-                 "requeues", "_wlock", "_watchers")
+                 "requeues", "trace_id", "_wlock", "_watchers")
 
     def __init__(self, payload: Any, group: Any = None,
                  seq_len: Optional[int] = None,
                  t_submit: float = 0.0,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.payload = payload
         self.group = group
         self.seq_len = seq_len
         self.t_submit = t_submit
         self.deadline = deadline
+        self.trace_id = trace_id   # obs: minted at the submit edge
         self.t_dequeue: Optional[float] = None
         self.t_done: Optional[float] = None
         self.requeues = 0          # times this re-entered a queue
@@ -209,14 +213,17 @@ class DynamicBatcher:
     # -- submit side ----------------------------------------------------
     def submit(self, payload: Any, *, group: Any = None,
                seq_len: Optional[int] = None,
-               timeout_s: Optional[float] = None) -> InferenceRequest:
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> InferenceRequest:
         """Enqueue one request; raises :class:`ServerBusy` when the
         bounded queue is full (explicit rejection, never unbounded
-        growth)."""
+        growth).  ``trace_id`` (obs) rides the request through
+        assembly into the runner's phase spans."""
         now = self._clock()
         req = InferenceRequest(
             payload, group=group, seq_len=seq_len, t_submit=now,
-            deadline=None if timeout_s is None else now + timeout_s)
+            deadline=None if timeout_s is None else now + timeout_s,
+            trace_id=trace_id)
         with self._cond:
             if self._closed:
                 raise WorkerLost(
@@ -322,6 +329,12 @@ class DynamicBatcher:
                 self._cond.notify_all()
         if timed_out and self._on_timeout is not None:
             self._on_timeout(timed_out)
+        if requeued and profiler.is_active():
+            for r in requeued:
+                if r.trace_id is not None:
+                    _trace.span(_trace.SPAN_REQUEUE, now * 1e6, 0.0,
+                                trace_id=r.trace_id,
+                                requeues=r.requeues)
         return len(requeued)
 
     def oldest_waiting_age(self, now: Optional[float] = None

@@ -43,7 +43,7 @@ Signals and their sources:
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 __all__ = ["WorkerState", "WorkerHealth"]
 
@@ -67,7 +67,9 @@ class WorkerHealth:
 
     def __init__(self, name: str = "", *, liveness_s: float = 2.0,
                  dead_after: int = 3, start_recovering: bool = False,
-                 exec_recovers: bool = False):
+                 exec_recovers: bool = False,
+                 on_transition: Optional[
+                     Callable[[float, str, str, str], None]] = None):
         self.name = name
         self.liveness_s = float(liveness_s)
         self.dead_after = int(dead_after)
@@ -79,6 +81,10 @@ class WorkerHealth:
         self.reason = "start-recovering" if start_recovering else ""
         # bounded transition log: (now, from, to, reason)
         self.transitions: List[Tuple[float, str, str, str]] = []
+        # observer hook (obs flight recorder): called after every real
+        # transition with (now, from, to, reason); must be cheap and
+        # must not call back into this machine
+        self._on_transition = on_transition
 
     # -- transition core -------------------------------------------------
     def _to(self, now: float, state: str, reason: str) -> bool:
@@ -87,10 +93,13 @@ class WorkerHealth:
         if self.state == WorkerState.DEAD and \
                 state != WorkerState.RECOVERING:
             return False              # dead is terminal (bar recover())
-        self.transitions.append((now, self.state, state, reason))
+        prev = self.state
+        self.transitions.append((now, prev, state, reason))
         del self.transitions[:-32]
         self.state = state
         self.reason = reason
+        if self._on_transition is not None:
+            self._on_transition(now, prev, state, reason)
         return True
 
     # -- canary verdicts (authoritative) ---------------------------------

@@ -54,6 +54,8 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import knobs
+from .. import obs
+from .. import profiler
 from .batcher import (DynamicBatcher, InferenceRequest, RequestTimeout,
                       ServerBusy, WorkerLost)
 from .faults import FaultPlan, HangSignal, WorkerCrashed
@@ -72,16 +74,18 @@ class FleetRequest:
 
     __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
                  "retries", "requeues", "hedges", "tried", "last_error",
-                 "t_done", "won_by_hedge", "_event", "_value", "_error",
-                 "_wlock")
+                 "t_done", "won_by_hedge", "trace_id", "_event",
+                 "_value", "_error", "_wlock")
 
     def __init__(self, payload: Any, group: Any, seq_len: Optional[int],
-                 t_submit: float, deadline: Optional[float]):
+                 t_submit: float, deadline: Optional[float],
+                 trace_id: Optional[str] = None):
         self.payload = payload
         self.group = group
         self.seq_len = seq_len
         self.t_submit = t_submit
         self.deadline = deadline
+        self.trace_id = trace_id  # obs: minted at FleetRouter.submit
         self.retries = 0          # router-level re-dispatches
         self.requeues = 0         # of those, forced by a worker death
         self.hedges = 0           # hedge attempts dispatched
@@ -163,11 +167,16 @@ class FleetWorker:
             mq = knobs.get("MXTPU_SERVING_MAX_QUEUE")
             max_queue = mq if mq else None
         self.stats = ServingStats(name=f"fleet/{name}", clock=clock)
+        # obs flight recorder: bounded ring of structured events for
+        # this worker — health transitions, canary verdicts, fault
+        # firings, evictions — dumped by the router on death.  The
+        # shared no-op when MXTPU_OBS=0.
+        self.recorder = obs.flight(f"fleet/{name}", clock=clock)
         self.batcher = DynamicBatcher(
             max_batch_size=runner.max_batch_size,
             max_queue_delay_us=max_queue_delay_us,
             max_queue=max_queue, clock=clock,
-            on_timeout=self.stats.record_timeout,
+            on_timeout=self._on_evicted,
             on_depth=self.stats.record_queue_depth)
         self.health = WorkerHealth(
             name,
@@ -176,7 +185,8 @@ class FleetWorker:
             dead_after=dead_after if dead_after is not None
             else knobs.get("MXTPU_FLEET_DEAD_AFTER"),
             start_recovering=start_recovering,
-            exec_recovers=exec_recovers)
+            exec_recovers=exec_recovers,
+            on_transition=self._on_health_transition)
         self._lock = threading.Lock()
         self._inflight_t: Optional[float] = None  # guarded-by: _lock
         self._inflight_n = 0  # guarded-by: _lock
@@ -186,11 +196,23 @@ class FleetWorker:
         self._thread: Optional[threading.Thread] = None
         self._shut = False
 
+    # -- obs hooks (leaf-lock only: both may fire under batcher or
+    #    router locks) ----------------------------------------------------
+    def _on_health_transition(self, now: float, frm: str, to: str,
+                              reason: str) -> None:
+        self.recorder.record("health", frm=frm, to=to, reason=reason)
+
+    def _on_evicted(self, n: int) -> None:
+        self.stats.record_timeout(n)
+        self.recorder.record("evicted", n=n)
+
     # -- admission --------------------------------------------------------
     def submit_attempt(self, payload: Any, group: Any,
                        seq_len: Optional[int],
                        deadline: Optional[float], now: float,
-                       canary: bool = False) -> InferenceRequest:
+                       canary: bool = False,
+                       trace_id: Optional[str] = None
+                       ) -> InferenceRequest:
         """Admit one attempt into this worker's queue.  Client traffic
         only lands on a HEALTHY worker; canaries also probe SUSPECT
         and RECOVERING ones (that IS the recovery path).  Raises
@@ -205,7 +227,8 @@ class FleetWorker:
         timeout_s = None if deadline is None \
             else max(0.0, deadline - now)
         return self.batcher.submit(payload, group=group,
-                                   seq_len=seq_len, timeout_s=timeout_s)
+                                   seq_len=seq_len, timeout_s=timeout_s,
+                                   trace_id=trace_id)
 
     # -- execution ---------------------------------------------------------
     def pump(self, now: Optional[float] = None) -> bool:
@@ -234,6 +257,16 @@ class FleetWorker:
             self._batch_seq += 1
             self._inflight_t = now
             self._inflight_n = len(batch.requests)
+        # obs queue-wait spans: submit → dequeue, per traced request.
+        # Emitted before execution so a mid-flight kill still leaves
+        # the wait on record (the worker-clock time base, so the
+        # deterministic fake-clock tests see exact phase timings).
+        if profiler.is_active():
+            for r in batch.requests:
+                if r.trace_id is not None and r.t_dequeue is not None:
+                    obs.span(obs.SPAN_QUEUE_WAIT, r.t_submit * 1e6,
+                             (r.t_dequeue - r.t_submit) * 1e6,
+                             trace_id=r.trace_id, worker=self.name)
         try:
             if self.faults is not None:
                 self.faults.before_batch(k)
@@ -248,6 +281,8 @@ class FleetWorker:
             with self._lock:
                 self._stuck = True
             self.stats.bump("hangs")
+            self.recorder.record("fault", fault="hang", batch_seq=k,
+                                 n=len(batch.requests))
             return
         except WorkerCrashed as e:
             with self._lock:
@@ -255,6 +290,8 @@ class FleetWorker:
                 self._inflight_n = 0
             self.health.crashed(now, str(e))
             self.stats.bump("crashes")
+            self.recorder.record("fault", fault="crash", batch_seq=k,
+                                 n=len(batch.requests), error=str(e))
             # requests stay incomplete; the router observes DEAD and
             # closes the batcher, which fails them to their watchers
             return
@@ -266,12 +303,24 @@ class FleetWorker:
             if n:
                 self.stats.bump("requeues", n)
             self.health.exec_fail(now)
+            self.recorder.record("exec_fail", batch_seq=k,
+                                 requeued=n, error=str(e))
             logger.debug("fleet worker %s: batch failed (%s), "
                          "requeued %d", self.name, e, n)
             return
         with self._lock:
             self._inflight_t = None
             self._inflight_n = 0
+        # obs execute spans: dispatch → completion on the worker clock
+        if profiler.is_active():
+            t_end = self._clock()
+            for r in batch.requests:
+                if r.trace_id is not None:
+                    obs.span(obs.SPAN_EXECUTE, now * 1e6,
+                             (t_end - now) * 1e6,
+                             trace_id=r.trace_id, worker=self.name,
+                             batch=len(batch.requests),
+                             bucket=str(bucket))
         self.health.exec_ok(now)
         self.stats.record_batch(len(batch.requests), bucket[0])
         for r in batch.requests:
@@ -418,6 +467,10 @@ class FleetRouter:
         self._rng = random.Random(seed)
         self.stats = ServingStats(name="fleet", clock=clock,
                                   log_every_s=log_every_s)
+        # set when a fleet request fails terminally; tick() checks it
+        # outside locks and dumps flight recorders when
+        # MXTPU_OBS_DUMP_ON_ERROR asks for it
+        self._dump_terminal = False  # guarded-by: _lock
         self._closed = False
         self._stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
@@ -514,7 +567,12 @@ class FleetRouter:
         group = r0.seq_bucket_for(seq_len)
         freq = FleetRequest(payload, group, seq_len, now,
                             None if timeout_s is None
-                            else now + timeout_s)
+                            else now + timeout_s,
+                            trace_id=obs.new_trace_id()
+                            if profiler.is_active() else None)
+        if freq.trace_id is not None:
+            obs.span(obs.SPAN_SUBMIT, now * 1e6, 0.0,
+                     trace_id=freq.trace_id, group=str(group))
         with self._lock:
             if not self._dispatch_locked(freq, now):
                 self._pending.append(_Pending(now, freq))
@@ -555,13 +613,22 @@ class FleetRouter:
             try:
                 attempt = worker.submit_attempt(
                     freq.payload, freq.group, freq.seq_len,
-                    freq.deadline, now)
+                    freq.deadline, now, trace_id=freq.trace_id)
             except (WorkerLost, ServerBusy):
                 # this worker refused; round-robin advances, try next
                 continue
             freq.tried.append(worker.name)
             if hedge:
                 freq.hedges += 1
+            if freq.trace_id is not None:
+                if hedge:
+                    obs.span(obs.SPAN_HEDGE, now * 1e6, 0.0,
+                             trace_id=freq.trace_id,
+                             worker=worker.name)
+                elif freq.retries > 0:
+                    obs.span(obs.SPAN_REDISPATCH, now * 1e6, 0.0,
+                             trace_id=freq.trace_id,
+                             worker=worker.name, retry=freq.retries)
             self._live.append((freq, attempt, worker.name, now,
                                hedge))
             attempt.add_done_callback(
@@ -607,9 +674,11 @@ class FleetRouter:
                 "serving: deadline expired before a retry could be "
                 "placed"), now)
             self.stats.record_timeout()
+            self._dump_terminal = True
             return
         if not retriable or freq.retries >= self._retry_max:
             freq._fail(error, now)
+            self._dump_terminal = True
             return
         freq.retries += 1
         self.stats.bump("retries")
@@ -618,7 +687,13 @@ class FleetRouter:
             # requeue-never-drop path, counted separately
             freq.requeues += 1
             self.stats.bump("requeues")
+            if freq.trace_id is not None:
+                obs.span(obs.SPAN_STEAL, now * 1e6, 0.0,
+                         trace_id=freq.trace_id, worker=wname)
         due = now + self._backoff_s(freq.retries)
+        if freq.trace_id is not None:
+            obs.span(obs.SPAN_BACKOFF, now * 1e6, (due - now) * 1e6,
+                     trace_id=freq.trace_id, retry=freq.retries)
         self._pending.append(_Pending(due, freq))
 
     # -- canaries ----------------------------------------------------------
@@ -715,6 +790,14 @@ class FleetRouter:
                     logger.warning(
                         "fleet: worker %s is DEAD (%s) — stealing "
                         "outstanding requests", w.name, w.health.reason)
+                # flight-recorder postmortem: the death event plus an
+                # automatic dump of everything the ring still holds
+                w.recorder.record("death", reason=w.health.reason,
+                                  retired=w.health.retired,
+                                  outstanding=w.outstanding())
+                w.recorder.dump(
+                    reason=f"worker {w.name} DEAD: {w.health.reason}",
+                    path=obs.dump_on_error_path() or None)
                 # closing the batcher fails queued+inflight with
                 # WorkerLost → watchers enqueue retry events below
                 w.shutdown(error=None if w.health.retired else
@@ -736,6 +819,7 @@ class FleetRouter:
                     w = self._workers.get(wname)
                 if w is None:
                     continue
+                w.recorder.record("canary", ok=ok, why=why)
                 if ok:
                     w.health.canary_ok(now)
                 else:
@@ -786,6 +870,11 @@ class FleetRouter:
                         if self._dispatch_locked(freq, now,
                                                  hedge=True):
                             self.stats.bump("hedges")
+            dump_terminal, self._dump_terminal = \
+                self._dump_terminal, False
+        if dump_terminal and obs.dump_on_error_path() is not None:
+            obs.dump_all(reason="fleet request failed terminally",
+                         path=obs.dump_on_error_path() or None)
         self.stats.maybe_log()
 
     def _tick_loop(self) -> None:
@@ -813,6 +902,22 @@ class FleetRouter:
             1 for s in states if s == WorkerState.HEALTHY)
         snap["total_workers"] = len(states)
         return snap
+
+    def postmortem(self, name: str) -> Dict[str, Any]:
+        """Everything known about one worker, dead or alive: health
+        state machine snapshot + full transition log, serving stats,
+        and the flight-recorder ring (health transitions, canary
+        verdicts, faults, evictions) — the single dict an operator
+        reads after ``kill``/death to answer *why*."""
+        with self._lock:
+            w = self._require_locked(name)
+        return {
+            "worker": name,
+            "health": w.health.snapshot(),
+            "transitions": list(w.health.transitions),
+            "stats": w.stats.snapshot(),
+            "flight": w.recorder.snapshot(),
+        }
 
     def close(self) -> None:
         with self._lock:

@@ -32,6 +32,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import guards
 from .. import knobs
+from .. import obs
 from .. import profiler
 from .batcher import InferenceRequest
 
@@ -141,9 +142,21 @@ class ModelRunner:
         self._guards = guards.enabled()
         # One compile per ladder rung is the design; anything past the
         # ladder (+ slack for explicit extra warmup buckets) is churn.
+        self._entry_label = f"ModelRunner[{type(symbol).__name__}]"
         self._churn = guards.ChurnDetector(
-            f"ModelRunner[{type(symbol).__name__}]",
-            limit=len(self.buckets()) + 4)
+            self._entry_label, limit=len(self.buckets()) + 4)
+        # mxtpu.obs wiring (cached bool; no-op singletons when off):
+        # compile events feed the registry AND the "compile" flight
+        # recorder so a postmortem shows every cache miss with timing.
+        self._obs = obs.enabled()
+        self._m_compile = obs.counter(
+            "mxtpu_serving_compile_total",
+            "Bucket executables compiled (jit cache misses).",
+            labels=("entry",)).labels(entry=self._entry_label)
+        self._m_compile_s = obs.histogram(
+            "mxtpu_serving_compile_seconds",
+            "Per-bucket AOT compile wall time.",
+            labels=("entry",)).labels(entry=self._entry_label)
 
     @staticmethod
     def _as_np(v):
@@ -270,6 +283,13 @@ class ModelRunner:
             self.compile_seconds[bucket] = time.perf_counter() - t0
             entry = {"compiled": compiled, "in_structs": in_structs}
             self._entries[bucket] = entry
+            if self._obs:
+                self._m_compile.inc()
+                self._m_compile_s.observe(self.compile_seconds[bucket])
+                obs.flight("compile").record(
+                    "compile_miss", entry=self._entry_label,
+                    bucket=str(bucket),
+                    seconds=round(self.compile_seconds[bucket], 4))
             # MXTPU_HLO_AUDIT: static hygiene pass over every bucket
             # executable as it is born (warmup() therefore audits the
             # whole ladder) — no host transfers, no f64 creep, no
@@ -366,10 +386,24 @@ class ModelRunner:
         n = len(requests)
         seq = requests[0].group if self.seq_buckets is not None else None
         bucket = self.bucket_for(n, seq)
+        # obs phase spans (pad/scatter, execute) — gated BEFORE any
+        # timing/args work so the profiler-off path is one bool read
+        active = profiler.is_active()
+        tids = [r.trace_id for r in requests
+                if r.trace_id is not None] if active else []
+        t0 = profiler._now_us() if active else 0.0
         vals = self._pad_stack([r.payload for r in requests], bucket)
+        if active:
+            t1 = profiler._now_us()
+            obs.span(obs.SPAN_PAD_SCATTER, t0, t1 - t0, cat="serving",
+                     trace_ids=tids, bucket=str(bucket), batch=n)
         outs = self.run_raw(vals, bucket)
         # mxlint: sync-point — deliberate D2H before scattering rows
         host = [np.asarray(o) for o in outs]
+        if active:
+            obs.span(obs.SPAN_RUN, t1, profiler._now_us() - t1,
+                     cat="serving", trace_ids=tids,
+                     bucket=str(bucket), batch=n)
         if mutate is not None:
             host = mutate(host)
         done_t = time.monotonic() if now is None else now

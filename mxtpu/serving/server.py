@@ -23,6 +23,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import knobs
+from .. import obs
 from .. import profiler
 from .batcher import DynamicBatcher, InferenceRequest
 from .runner import ModelRunner
@@ -105,11 +106,14 @@ class _Endpoint:
                     self.stats.bump("requeues", n)
                 continue
             dur = profiler._now_us() - t0
+            tids = [r.trace_id for r in batch.requests
+                    if r.trace_id is not None]
             profiler.record_span(
                 f"serve/{self.name}:v{self.version}", t0, dur,
                 cat="serving",
                 args={"batch": len(batch.requests),
-                      "bucket": list(bucket), "replica": idx})
+                      "bucket": list(bucket), "replica": idx,
+                      "trace_ids": tids})
             self.stats.record_batch(len(batch.requests), bucket[0])
             for r in batch.requests:
                 if r.latency_us is not None:
@@ -234,9 +238,11 @@ class InferenceServer:
             seq_len = int(first.shape[0])
         group = r0.seq_bucket_for(seq_len)
         try:
-            return ep.batcher.submit(inputs, group=group,
-                                     seq_len=seq_len,
-                                     timeout_s=timeout_s)
+            return ep.batcher.submit(
+                inputs, group=group, seq_len=seq_len,
+                timeout_s=timeout_s,
+                trace_id=obs.new_trace_id()
+                if profiler.is_active() else None)
         except Exception:
             ep.stats.record_rejected()
             raise

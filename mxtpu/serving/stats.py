@@ -11,6 +11,13 @@ Everything is O(1) per event under one lock: percentiles come from a
 bounded ring of recent latencies (default 2048 — at serving rates this
 is seconds of traffic, enough for a rolling p99 without unbounded
 growth), rates from a deque of completion timestamps.
+
+ISSUE 8: every instance also publishes to the ``mxtpu.obs`` metrics
+registry (counters/gauges/histograms labeled ``endpoint=<name>``, the
+fleet ``bump()`` counters as ``mxtpu_fleet_events_total{kind=...}``) —
+the process-wide Prometheus/JSON surface.  With ``MXTPU_OBS=0`` the
+wiring is a cached-bool branch (guards-style zero overhead); the local
+snapshot()/log-line behaviour is identical either way.
 """
 from __future__ import annotations
 
@@ -19,6 +26,8 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Optional
+
+from .. import obs
 
 __all__ = ["ServingStats"]
 
@@ -66,6 +75,51 @@ class ServingStats:
         # them under "extras", maybe_log() appends the nonzero ones to
         # the Speedometer line (extended, not duplicated)
         self.extras: Dict[str, int] = {}  # guarded-by: _lock
+        # mxtpu.obs registry wiring — one labeled child per instrument,
+        # resolved once here; _obs gates the hot paths (cached bool)
+        self._obs = obs.enabled()
+        ep = name or "default"
+        self._m_completed = obs.counter(
+            "mxtpu_serving_completed_total",
+            "Requests completed per endpoint.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_timeout = obs.counter(
+            "mxtpu_serving_timeout_total",
+            "Requests failed on an expired deadline.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_rejected = obs.counter(
+            "mxtpu_serving_rejected_total",
+            "Requests shed at the edge (ServerBusy).",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_batches = obs.counter(
+            "mxtpu_serving_batches_total",
+            "Micro-batches executed.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_batched = obs.counter(
+            "mxtpu_serving_batched_requests_total",
+            "Real (non-padding) examples across executed batches.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_padded = obs.counter(
+            "mxtpu_serving_padded_slots_total",
+            "Padding slots across executed batches.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_depth = obs.gauge(
+            "mxtpu_serving_queue_depth",
+            "Current batcher queue depth.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_latency = obs.histogram(
+            "mxtpu_serving_latency_seconds",
+            "End-to-end request latency.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_queue_wait = obs.histogram(
+            "mxtpu_serving_queue_wait_seconds",
+            "Submit-to-dequeue wait.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_fleet = obs.counter(
+            "mxtpu_fleet_events_total",
+            "Fleet counters (the ServingStats.bump keys: retries, "
+            "requeues, hedges, drains, deaths, ...).",
+            labels=("endpoint", "kind"))
 
     # -- event hooks (called by batcher/server) -------------------------
     def record_queue_depth(self, depth: int) -> None:
@@ -73,26 +127,39 @@ class ServingStats:
             self.queue_depth = depth
             if depth > self.peak_queue_depth:
                 self.peak_queue_depth = depth
+        if self._obs:
+            self._m_depth.set(depth)
 
     def record_rejected(self, n: int = 1) -> None:
         with self._lock:
             self.rejected += n
+        if self._obs:
+            self._m_rejected.inc(n)
 
     def record_timeout(self, n: int = 1) -> None:
         with self._lock:
             self.timed_out += n
+        if self._obs:
+            self._m_timeout.inc(n)
 
     def bump(self, key: str, n: int = 1) -> None:
         """Increment a named fleet counter (``retries``, ``requeues``,
         ``hedges_won``, ``drains``, ``deaths``, ...)."""
         with self._lock:
             self.extras[key] = self.extras.get(key, 0) + n
+        if self._obs:
+            self._m_fleet.labels(endpoint=self.name or "default",
+                                 kind=key).inc(n)
 
     def record_batch(self, n_real: int, capacity: int) -> None:
         with self._lock:
             self.batches += 1
             self.batched_requests += n_real
             self.padded_slots += max(0, capacity - n_real)
+        if self._obs:
+            self._m_batches.inc()
+            self._m_batched.inc(n_real)
+            self._m_padded.inc(max(0, capacity - n_real))
 
     def record_completion(self, latency_us: float,
                           queue_us: float = 0.0) -> None:
@@ -105,6 +172,10 @@ class ServingStats:
             horizon = now - self._rate_window_s
             while self._done_ts and self._done_ts[0] < horizon:
                 self._done_ts.popleft()
+        if self._obs:
+            self._m_completed.inc()
+            self._m_latency.observe(latency_us / 1e6)
+            self._m_queue_wait.observe(queue_us / 1e6)
 
     # -- views ----------------------------------------------------------
     def requests_per_sec(self) -> float:
@@ -112,6 +183,12 @@ class ServingStats:
             return self._rps_locked(self._clock())
 
     def _rps_locked(self, now: float) -> float:
+        # Prune on the read path too (ISSUE 8 satellite): after an
+        # idle period the ring otherwise still holds — and counts —
+        # completions far outside the rate window.
+        horizon = now - self._rate_window_s
+        while self._done_ts and self._done_ts[0] < horizon:
+            self._done_ts.popleft()
         if not self._done_ts:
             return 0.0
         span = max(now - self._done_ts[0], 1e-6)

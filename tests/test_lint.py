@@ -302,6 +302,55 @@ def test_real_readme_table_is_current():
     assert R.readme_drift(REPO_ROOT) == []
 
 
+# --------------------------------------------------------- obs registry
+
+def test_obs_registry_naming_convention():
+    src = """
+        from mxtpu import obs
+        ok1 = obs.counter("mxtpu_req_total", "fine")
+        ok2 = obs.histogram("mxtpu_wait_seconds", "fine")
+        ok3 = obs.gauge("mxtpu_depth", "fine")
+        bad1 = obs.counter("requests_total", "no prefix")
+        bad2 = obs.counter("mxtpu_requests", "no _total")
+        bad3 = obs.histogram("mxtpu_wait", "no unit suffix")
+        bad4 = obs.gauge("mxtpu_BadName", "not snake_case")
+    """
+    found = R.ObsRegistry().check(_ctx(src))
+    assert _names(found) == ["obs-registry"] * 4
+    assert {f.line for f in found} == {6, 7, 8, 9}
+
+
+def test_obs_registry_hot_path_counters():
+    src = """
+        from mxtpu import profiler
+        _N_CALLS = 0
+        _RETRY_COUNT = 0
+        PAD = 1
+        c = profiler.Counter("batches", 0)
+    """
+    # flagged inside the serving/parallel hot paths...
+    found = R.ObsRegistry().check(
+        _ctx(src, rel="mxtpu/serving/fake.py"))
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "_N_CALLS" in msgs and "_RETRY_COUNT" in msgs
+    assert "profiler.Counter" in msgs
+    # ... but not elsewhere (profiler.py itself, examples, ...)
+    assert R.ObsRegistry().check(
+        _ctx(src, rel="mxtpu/other.py")) == []
+
+
+def test_obs_registry_suppression():
+    src = """
+        from mxtpu import profiler
+        _N_CALLS = 0  # mxlint: disable=obs-registry
+    """
+    ctx = _ctx(src, rel="mxtpu/parallel/fake.py")
+    found = [f for f in R.ObsRegistry().check(ctx)
+             if not ctx.suppressed(f.rule, f.line)]
+    assert found == []
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_fingerprint_survives_line_moves(tmp_path):

@@ -1,0 +1,495 @@
+"""mxtpu.obs — unified metrics registry, end-to-end request tracing,
+and the fleet flight recorder (ISSUE 8).
+
+Three suites:
+
+* **registry** — typed instruments, label sets, naming enforcement,
+  the Prometheus-text / JSON-snapshot round-trip, and the shared
+  no-op singletons behind ``MXTPU_OBS=0``;
+* **tracing** — profiler state-machine fixes (satellite 2), concurrent
+  recorder JSON validity, and THE acceptance scenario: a fleet request
+  surviving a scripted worker kill whose full life (submit →
+  queue-wait → steal → backoff → re-dispatch → execute) reconstructs
+  from a single trace dump via one trace id — deterministic, fake
+  clock, no sleeps;
+* **flight recorder** — bounded ring semantics, automatic dump on
+  worker death, ``MXTPU_OBS_DUMP_ON_ERROR``, and
+  ``FleetRouter.postmortem``.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mxtpu import obs, profiler
+from mxtpu.base import MXNetError
+from mxtpu.obs.metrics import (MetricsRegistry, NULL_COUNTER,
+                               NULL_GAUGE, NULL_HISTOGRAM,
+                               parse_prometheus_text,
+                               samples_from_snapshot)
+from mxtpu.obs.recorder import NULL_RECORDER, FlightRecorder
+from mxtpu.serving import CrashAt, FaultPlan, FleetRouter, FleetWorker
+from mxtpu.serving.stats import ServingStats
+
+from tests.test_fleet import (FakeClock, _payload, _router, _worker,
+                              _crank)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Each test starts from an empty registry and a stopped
+    profiler."""
+    obs.reset()
+    profiler.set_state("stop")
+    profiler.dumps(reset=True)
+    yield
+    profiler.set_state("stop")
+    profiler.dumps(reset=True)
+    obs.reset()
+
+
+# ------------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_widgets_total", "Widgets.")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5.0
+    with pytest.raises(MXNetError):
+        c.inc(-1)                      # counters only go up
+    g = r.gauge("mxtpu_depth", "Depth.")
+    g.set(7)
+    g.inc(2)
+    g.dec(4)
+    assert g.value() == 5.0
+    h = r.histogram("mxtpu_wait_seconds", "Wait.",
+                    buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 5.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(5.105)
+    assert s["mean"] == pytest.approx(5.105 / 4)
+    # get-or-create returns the same family
+    assert r.counter("mxtpu_widgets_total") is c
+    assert r.names() == ["mxtpu_depth", "mxtpu_wait_seconds",
+                         "mxtpu_widgets_total"]
+
+
+def test_registry_conflicts_and_naming():
+    r = MetricsRegistry()
+    r.counter("mxtpu_x_total", "x", labels=("a",))
+    with pytest.raises(MXNetError):
+        r.gauge("mxtpu_x_total")       # type conflict
+    with pytest.raises(MXNetError):
+        r.counter("mxtpu_x_total", labels=("b",))  # labelname conflict
+    with pytest.raises(MXNetError):
+        r.counter("widgets_total")     # missing mxtpu_ prefix
+    with pytest.raises(MXNetError):
+        r.counter("mxtpu_widgets")     # counters end _total
+    with pytest.raises(MXNetError):
+        r.histogram("mxtpu_wait")      # histograms name their unit
+    with pytest.raises(MXNetError):
+        r.gauge("mxtpu_Bad-Name")      # snake_case only
+    with pytest.raises(MXNetError):
+        r.counter("mxtpu_x_total", labels=("a",)).labels(b="?")
+
+
+def test_labeled_children_are_independent():
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_req_total", "req", labels=("ep", "code"))
+    c.labels(ep="a", code="200").inc(3)
+    c.labels(ep="a", code="500").inc()
+    c.labels(ep="b", code="200").inc(7)
+    assert c.labels(ep="a", code="200").value() == 3.0
+    assert c.labels(ep="b", code="200").value() == 7.0
+    flat = r.summary()
+    assert flat['mxtpu_req_total{code="500",ep="a"}'] == 1.0
+
+
+def test_prometheus_json_round_trip():
+    """Acceptance: the text exposition and the JSON snapshot expose
+    the SAME sample values, label escaping included."""
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_ev_total", "Events.", labels=("kind",))
+    c.labels(kind='we"ird\\na\nme').inc(2)
+    r.gauge("mxtpu_level", "Level.").set(-3.5)
+    h = r.histogram("mxtpu_lat_seconds", "Lat.", labels=("ep",),
+                    buckets=(0.001, 0.1, 2.0))
+    for v in (0.0005, 0.05, 0.05, 7.0):
+        h.labels(ep="x").observe(v)
+    text = r.prometheus_text()
+    assert "# TYPE mxtpu_ev_total counter" in text
+    assert "# TYPE mxtpu_lat_seconds histogram" in text
+    assert 'le="+Inf"' in text
+    left = parse_prometheus_text(text)
+    right = samples_from_snapshot(r.snapshot())
+    assert left == right and left            # non-empty, identical
+    # histogram buckets are cumulative in BOTH surfaces
+    key = ("mxtpu_lat_seconds_bucket",
+           (("ep", "x"), ("le", "+Inf")))
+    assert left[key] == 4.0
+    # snapshot JSON-serializes as-is
+    json.dumps(r.snapshot())
+
+
+def test_disabled_factories_return_shared_singletons():
+    assert obs.counter("mxtpu_a_total", enabled_override=False) \
+        is NULL_COUNTER
+    assert obs.gauge("mxtpu_b", enabled_override=False) is NULL_GAUGE
+    assert obs.histogram("mxtpu_c_seconds", enabled_override=False) \
+        is NULL_HISTOGRAM
+    assert obs.flight("w", enabled_override=False) is NULL_RECORDER
+    # the no-op child absorbs the full API
+    n = NULL_COUNTER.labels(anything="x")
+    assert n is NULL_COUNTER
+    n.inc()
+    NULL_GAUGE.set(5)
+    NULL_HISTOGRAM.observe(1.0)
+    assert NULL_COUNTER.value() == 0.0
+    assert NULL_RECORDER.dump() == ""
+    assert NULL_RECORDER.events() == []
+    # and nothing lands in the registry
+    assert "mxtpu_a_total" not in obs.registry().names()
+
+
+def test_obs_off_via_knob(monkeypatch):
+    monkeypatch.setenv("MXTPU_OBS", "0")
+    assert not obs.enabled()
+    assert obs.counter("mxtpu_k_total") is NULL_COUNTER
+    s = ServingStats(name="off")
+    s.record_completion(1000.0, 100.0)
+    s.bump("retries")
+    assert "mxtpu_serving_completed_total" not in obs.registry().names()
+    # local snapshot still works identically
+    assert s.snapshot()["completed"] == 1
+
+
+def test_self_check_contract():
+    info = obs.self_check(probe=True)
+    assert info["round_trip_samples"] > 0
+    assert info["flight_capacity"] == 256
+
+
+def test_serving_stats_publish_to_registry():
+    fc = FakeClock(10.0)
+    s = ServingStats(name="ep1", clock=fc)
+    for i in range(4):
+        s.record_completion(latency_us=2000.0, queue_us=500.0)
+    s.record_batch(3, 4)
+    s.record_queue_depth(6)
+    s.record_rejected(2)
+    s.record_timeout()
+    s.bump("retries", 3)
+    flat = obs.summary()
+    assert flat['mxtpu_serving_completed_total{endpoint="ep1"}'] == 4.0
+    assert flat['mxtpu_serving_rejected_total{endpoint="ep1"}'] == 2.0
+    assert flat['mxtpu_serving_timeout_total{endpoint="ep1"}'] == 1.0
+    assert flat['mxtpu_serving_batches_total{endpoint="ep1"}'] == 1.0
+    assert flat['mxtpu_serving_batched_requests_total'
+                '{endpoint="ep1"}'] == 3.0
+    assert flat['mxtpu_serving_padded_slots_total'
+                '{endpoint="ep1"}'] == 1.0
+    assert flat['mxtpu_serving_queue_depth{endpoint="ep1"}'] == 6.0
+    lat = flat['mxtpu_serving_latency_seconds{endpoint="ep1"}']
+    assert lat["count"] == 4 and lat["mean"] == pytest.approx(0.002)
+    assert flat['mxtpu_fleet_events_total'
+                '{endpoint="ep1",kind="retries"}'] == 3.0
+
+
+def test_rps_prunes_stale_completions_on_read(monkeypatch):
+    """Satellite fix: after an idle gap the rate window must empty —
+    the old read path counted completions far outside the window."""
+    fc = FakeClock(0.0)
+    s = ServingStats(name="idle", rate_window_s=30.0, clock=fc)
+    for _ in range(50):
+        fc.advance(0.01)
+        s.record_completion(1000.0)
+    assert s.requests_per_sec() > 0
+    fc.advance(120.0)               # idle far past the window
+    assert s.requests_per_sec() == 0.0
+
+
+# ------------------------------------------------------ profiler fixes
+
+def test_set_config_rejects_unknown_keys():
+    with pytest.raises(MXNetError, match="filname"):
+        profiler.set_config(filname="/tmp/x.json")
+    profiler.set_config(aggregate_stats=False)   # known key: fine
+
+
+def test_stop_clears_pause():
+    """run → pause → stop → run must collect again (the stale _PAUSED
+    bug left the profiler dead until an unpaired resume())."""
+    profiler.set_state("run")
+    profiler.pause()
+    assert not profiler.is_active()
+    profiler.set_state("stop")
+    profiler.resume()                # resume after stop: no-op
+    assert not profiler.is_active()
+    profiler.set_state("run")
+    assert profiler.is_active()
+    profiler.record_span("x", profiler._now_us(), 1.0)
+    assert len(profiler.events()) == 1
+
+
+def test_pause_resume_round_trip():
+    profiler.set_state("run")
+    profiler.record_span("a", profiler._now_us(), 1.0)
+    profiler.pause()
+    profiler.record_span("dropped", profiler._now_us(), 1.0)
+    profiler.resume()
+    profiler.record_span("b", profiler._now_us(), 1.0)
+    names = [e["name"] for e in profiler.events()]
+    assert names == ["a", "b"]
+
+
+def test_concurrent_recorders_yield_valid_json():
+    """Satellite 3: hammer record_span from several threads while a
+    reader repeatedly dumps; every dump must parse, and every event
+    must carry pid/tid and a non-negative dur."""
+    profiler.set_state("run")
+    stop = threading.Event()
+    bad = []
+
+    def writer(tid):
+        i = 0
+        while not stop.is_set():
+            t = profiler._now_us()
+            profiler.record_span(f"w{tid}/{i % 7}", t, 5.0,
+                                 cat="stress", args={"i": i})
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                json.loads(profiler.dumps())
+            except Exception as e:  # noqa: BLE001
+                bad.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(4)] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    threading.Event().wait(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not bad
+    events = json.loads(profiler.dumps())["traceEvents"]
+    assert len(events) > 100
+    for ev in events:
+        assert ev["pid"] > 0 and ev["tid"] > 0
+        assert ev["dur"] >= 0
+
+
+def test_dumps_reset_keeps_one_epoch():
+    """Events recorded after dumps(reset=True) stay on the SAME ts
+    epoch, so spans from before and after a drain remain comparable
+    in one timeline."""
+    profiler.set_state("run")
+    profiler.record_span("early", profiler._now_us(), 1.0)
+    first = json.loads(profiler.dumps(reset=True))["traceEvents"]
+    profiler.record_span("late", profiler._now_us(), 1.0)
+    second = json.loads(profiler.dumps(reset=True))["traceEvents"]
+    assert [e["name"] for e in first] == ["early"]
+    assert [e["name"] for e in second] == ["late"]
+    assert second[0]["ts"] >= first[0]["ts"]
+
+
+# --------------------------------------------- tracing: the kill test
+
+def _fleet(clk, **kw):
+    kw.setdefault("canary", False)
+    kw.setdefault("backoff_base_us", 10_000)
+    kw.setdefault("backoff_cap_us", 50_000)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("hedge_after_us", 0)
+    return _router(clk, **kw)
+
+
+def test_fleet_kill_reconstructs_from_one_dump():
+    """THE acceptance scenario: a request whose first worker dies
+    mid-flight is fully reconstructible from a single chrome-trace
+    dump — every phase span shares the request's one trace id."""
+    clk = FakeClock(100.0)
+    profiler.set_state("run")
+    router = _fleet(clk)
+    w0 = _worker(clk, "w0")
+    w1 = _worker(clk, "w1")
+    w0.faults = FaultPlan(CrashAt(0))     # dies on its first batch
+    router.add_worker(w0)
+    router.add_worker(w1)
+
+    req = router.submit(_payload(3.0), timeout_s=30.0)
+    assert req.trace_id is not None
+    _crank(router, clk, n=12, dt=0.05)
+    assert req.done()
+    np.testing.assert_allclose(np.asarray(req.result()).ravel(),
+                               [3.0, 6.0, 9.0])
+
+    # ONE dump; reconstruct offline from its parsed events
+    events = json.loads(profiler.dumps())["traceEvents"]
+    timeline = obs.trace_of(req.trace_id, events=events)
+    names = [e["name"] for e in timeline]
+    for span in (obs.SPAN_SUBMIT, obs.SPAN_QUEUE_WAIT, obs.SPAN_STEAL,
+                 obs.SPAN_BACKOFF, obs.SPAN_REDISPATCH,
+                 obs.SPAN_EXECUTE, obs.SPAN_PAD_SCATTER, obs.SPAN_RUN):
+        assert span in names, f"missing {span} in {names}"
+    # every span carries THIS trace id (direct or batch-level)
+    for e in timeline:
+        args = e["args"]
+        assert args.get("trace_id") == req.trace_id or \
+            req.trace_id in args.get("trace_ids", ())
+    # phase ordering on the fleet clock: submit, the doomed attempt's
+    # queue wait on w0, steal+backoff, re-dispatch to w1, execute there
+    fleet = [e for e in timeline if e["name"].startswith("fleet/")]
+    assert fleet == sorted(fleet, key=lambda e: e["ts"])
+    by = {e["name"]: e for e in fleet}
+    assert by[obs.SPAN_QUEUE_WAIT]["args"]["worker"] == "w0" or \
+        any(e["args"]["worker"] == "w0" for e in fleet
+            if e["name"] == obs.SPAN_QUEUE_WAIT)
+    assert by[obs.SPAN_STEAL]["args"]["worker"] == "w0"
+    assert by[obs.SPAN_REDISPATCH]["args"]["worker"] == "w1"
+    assert by[obs.SPAN_EXECUTE]["args"]["worker"] == "w1"
+    assert by[obs.SPAN_BACKOFF]["dur"] == pytest.approx(10_000.0)
+    # the live-API timeline matches the offline reconstruction
+    assert [e["name"] for e in obs.trace_of(req.trace_id)] == names
+
+
+def test_trace_ids_are_unique_and_absent_when_stopped():
+    clk = FakeClock(100.0)
+    router = _fleet(clk)
+    router.add_worker(_worker(clk, "w0"))
+    r1 = router.submit(_payload(1.0))     # profiler stopped
+    assert r1.trace_id is None
+    profiler.set_state("run")
+    r2 = router.submit(_payload(1.0))
+    r3 = router.submit(_payload(1.0))
+    assert r2.trace_id and r3.trace_id and r2.trace_id != r3.trace_id
+    _crank(router, clk)
+    assert r1.done() and r2.done() and r3.done()
+
+
+def test_trace_of_unknown_id_is_empty():
+    profiler.set_state("run")
+    profiler.record_span("x", profiler._now_us(), 1.0,
+                         args={"trace_id": "r-other"})
+    assert obs.trace_of("r-nope") == []
+
+
+def test_obs_off_results_bit_identical(monkeypatch):
+    """Zero-overhead contract end to end: the SAME fleet scenario with
+    MXTPU_OBS=0 produces bit-identical outputs and fleet counters."""
+    def run_once():
+        clk = FakeClock(100.0)
+        router = _fleet(clk)
+        w0 = _worker(clk, "w0")
+        w0.faults = FaultPlan(CrashAt(0))
+        router.add_worker(w0)
+        router.add_worker(_worker(clk, "w1"))
+        req = router.submit(_payload(2.5), timeout_s=30.0)
+        _crank(router, clk, n=12, dt=0.05)
+        snap = router.fleet_stats()
+        return np.asarray(req.result()), snap["extras"]
+
+    out_on, extras_on = run_once()
+    obs.reset()
+    monkeypatch.setenv("MXTPU_OBS", "0")
+    out_off, extras_off = run_once()
+    assert out_on.tobytes() == out_off.tobytes()
+    assert extras_on == extras_off
+    assert obs.registry().names() == []   # off: registry untouched
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    fc = FakeClock(5.0)
+    rec = FlightRecorder("fleet/w9", capacity=3, clock=fc)
+    for k in range(5):
+        fc.advance(1.0)
+        rec.record("ev", k=k)
+    evs = rec.events()
+    assert [e["k"] for e in evs] == [2, 3, 4]     # bounded ring
+    snap = rec.snapshot()
+    assert snap["dropped"] == 2 and snap["capacity"] == 3
+    text = rec.dump(reason="test", path=str(tmp_path))
+    parsed = json.loads(text)
+    assert parsed["reason"] == "test"
+    assert [e["k"] for e in parsed["events"]] == [2, 3, 4]
+    files = list(tmp_path.glob("flight_*.json"))
+    assert len(files) == 1
+    assert json.loads(files[0].read_text())["recorder"] == "fleet/w9"
+    rec.clear()
+    assert rec.events() == []
+
+
+def test_flight_capacity_knob(monkeypatch):
+    monkeypatch.setenv("MXTPU_OBS_FLIGHT_CAPACITY", "2")
+    rec = FlightRecorder("small")
+    for k in range(4):
+        rec.record("e", k=k)
+    assert len(rec.events()) == 2
+
+
+def test_worker_death_dumps_flight_recorder(tmp_path, monkeypatch):
+    """Worker dies → its ring holds the health transition, the fault,
+    and the death event, and MXTPU_OBS_DUMP_ON_ERROR writes the dump
+    as a file."""
+    monkeypatch.setenv("MXTPU_OBS_DUMP_ON_ERROR", str(tmp_path))
+    clk = FakeClock(100.0)
+    router = _fleet(clk)
+    w0 = _worker(clk, "w0")
+    w0.faults = FaultPlan(CrashAt(0))
+    router.add_worker(w0)
+    router.add_worker(_worker(clk, "w1"))
+    req = router.submit(_payload(1.0), timeout_s=30.0)
+    _crank(router, clk, n=12, dt=0.05)
+    assert req.done()
+
+    pm = router.postmortem("w0")
+    kinds = [e["kind"] for e in pm["flight"]["events"]]
+    assert kinds == ["health", "fault", "death"]
+    assert pm["health"]["state"] == "dead"
+    assert pm["flight"]["events"][1]["fault"] == "crash"
+    assert pm["flight"]["events"][2]["reason"].startswith(
+        "scripted crash")
+    # the automatic on-death dump landed on disk
+    dumps = list(tmp_path.glob("flight_fleet_w0*.json"))
+    assert dumps, list(tmp_path.iterdir())
+    on_disk = json.loads(dumps[0].read_text())
+    assert [e["kind"] for e in on_disk["events"]] == kinds
+
+
+def test_canary_verdicts_and_evictions_reach_recorder():
+    from mxtpu.serving.runner import ModelRunner  # noqa: F401
+    clk = FakeClock(100.0)
+    router = _router(clk, canary=True, canary_interval_s=1.0)
+    w0 = _worker(clk, "w0")
+    router.add_worker(w0)
+    _crank(router, clk, n=5, dt=1.0)      # several canary rounds
+    kinds = [e["kind"] for e in w0.recorder.events()]
+    assert "canary" in kinds
+    ok = [e for e in w0.recorder.events() if e["kind"] == "canary"]
+    assert all(e["ok"] for e in ok)
+
+
+def test_compile_misses_reach_flight_and_registry():
+    from mxtpu import guards
+    det = guards.ChurnDetector("probe_entry", limit=100)
+    det.note_compile("sig0")
+    det.note_compile("sig1")
+    flat = obs.summary()
+    assert flat['mxtpu_compile_cache_miss_total'
+                '{entry="probe_entry"}'] == 2.0
+
+
+def test_dump_all_collects_every_recorder(tmp_path):
+    obs.flight("fleet/a").record("x", n=1)
+    obs.flight("fleet/b").record("y", n=2)
+    dumped = obs.dump_all(reason="test", path=str(tmp_path))
+    assert sorted(dumped) == ["fleet/a", "fleet/b"]
+    assert len(list(tmp_path.glob("flight_*.json"))) == 2

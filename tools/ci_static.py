@@ -5,11 +5,14 @@ contracts.
 
 * ``python -m tools.mxlint --check``  (AST rules over the tree), then
 * ``python -m tools.hlocheck --check`` (lowered programs vs the
-  committed ``contracts/`` lockfiles),
+  committed ``contracts/`` lockfiles), then
+* ``python -m mxtpu.obs --self-check`` (the observability layer's
+  zero-overhead-when-off + exposition round-trip contract),
 
-prints one PASS/FAIL line per stage, and exits non-zero if either
+prints one PASS/FAIL line per stage, and exits non-zero if any
 failed — the single entry point a CI job or pre-push hook needs.
-Extra arguments are forwarded to BOTH tools (e.g. ``--json``).
+Extra arguments are forwarded to the lint/contract tools (e.g.
+``--json``); the obs self-check takes none.
 """
 from __future__ import annotations
 
@@ -19,17 +22,19 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# (name, argv, forward_extra_args)
 STAGES = (
-    ("mxlint", ("-m", "tools.mxlint", "--check")),
-    ("hlocheck", ("-m", "tools.hlocheck", "--check")),
+    ("mxlint", ("-m", "tools.mxlint", "--check"), True),
+    ("hlocheck", ("-m", "tools.hlocheck", "--check"), True),
+    ("obs-self-check", ("-m", "mxtpu.obs", "--self-check"), False),
 )
 
 
 def main(argv=None) -> int:
     extra = list(sys.argv[1:] if argv is None else argv)
     failed = []
-    for name, args in STAGES:
-        cmd = [sys.executable, *args, *extra]
+    for name, args, fwd in STAGES:
+        cmd = [sys.executable, *args, *(extra if fwd else ())]
         print(f"ci_static: {name}: {' '.join(cmd[1:])}", flush=True)
         rc = subprocess.call(cmd, cwd=REPO_ROOT)
         print(f"ci_static: {name}: "

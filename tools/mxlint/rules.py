@@ -525,6 +525,94 @@ class HloRawAssert(Rule):
         return out
 
 
+class ObsRegistry(Rule):
+    """Metrics go through the ``mxtpu.obs`` registry, correctly named
+    (ISSUE 8).  Three checks:
+
+    * literal instrument names in ``obs.counter/gauge/histogram``
+      calls must follow the convention — ``mxtpu_`` snake_case prefix,
+      counters end ``_total``, histograms end ``_seconds`` / ``_us``
+      / ``_bytes`` (the registry raises at runtime too; the lint
+      catches it before the code path runs);
+    * no ad-hoc module-level counters (``_N_CALLS = 0`` style) in the
+      serving/parallel hot paths — those belong on the registry or on
+      a locked instance attribute;
+    * no ``profiler.Counter`` instances in serving/parallel — the
+      chrome-trace counter is for trace dumps, not for metrics the
+      registry should own.
+
+    Suppress a deliberate exception with
+    ``# mxlint: disable=obs-registry``."""
+
+    name = "obs-registry"
+    _FACTORIES = {"counter", "gauge", "histogram"}
+    _NAME_RE = re.compile(r"^mxtpu_[a-z][a-z0-9_]*$")
+    _HIST_SUFFIXES = ("_seconds", "_us", "_bytes")
+    _COUNTERISH = re.compile(
+        r"(?:^|_)(?:n|num|count|counts|counter|total|totals|hits|"
+        r"misses|calls)(?:_|$)", re.IGNORECASE)
+    _HOT_DIRS = ("mxtpu/serving/", "mxtpu/parallel/")
+
+    def _name_findings(self, ctx: FileCtx, node: ast.Call,
+                       kind: str) -> List[Finding]:
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return []
+        name = node.args[0].value
+        bad: Optional[str] = None
+        if not self._NAME_RE.match(name):
+            bad = ("instrument name must match "
+                   "`mxtpu_[a-z][a-z0-9_]*`")
+        elif kind == "counter" and not name.endswith("_total"):
+            bad = "counter names end `_total`"
+        elif kind == "histogram" and \
+                not name.endswith(self._HIST_SUFFIXES):
+            bad = ("histogram names end `_seconds` / `_us` / "
+                   "`_bytes` (name the unit)")
+        if bad is None:
+            return []
+        return [Finding(self.name, ctx.rel, node.lineno,
+                        f"obs.{kind}({name!r}): {bad}")]
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        in_hot = ctx.rel.startswith(self._HOT_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None:
+                continue
+            head, _, last = d.rpartition(".")
+            if last in self._FACTORIES and head.endswith("obs"):
+                out.extend(self._name_findings(ctx, node, last))
+            elif in_hot and d.endswith("profiler.Counter"):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "profiler.Counter in a serving/parallel hot path "
+                    "— publish through the mxtpu.obs registry (the "
+                    "chrome-trace counter is a trace artifact, not "
+                    "the metrics surface)"))
+        if in_hot:
+            for stmt in ctx.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not (isinstance(stmt.value, ast.Constant) and
+                        isinstance(stmt.value.value, int) and
+                        not isinstance(stmt.value.value, bool)):
+                    continue
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            self._COUNTERISH.search(tgt.id):
+                        out.append(Finding(
+                            self.name, ctx.rel, stmt.lineno,
+                            f"module-level counter `{tgt.id}` in a "
+                            f"serving/parallel hot path — use an "
+                            f"obs registry counter (process-wide, "
+                            f"locked, scrapeable) instead"))
+        return out
+
+
 # ----------------------------------------------------------------------
 # repo-level checks
 # ----------------------------------------------------------------------
@@ -582,7 +670,7 @@ def file_rules() -> List[Rule]:
     return [RetraceImpureCall(), RetraceTracedBranch(),
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
-            HloRawAssert()]
+            HloRawAssert(), ObsRegistry()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:
