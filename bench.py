@@ -989,8 +989,21 @@ def main():
                      f"its lockfile; inspect `python -m "
                      f"tools.hlocheck` and either fix the drift or "
                      f"regenerate with --update before benching")
+        # same refusal for the lock-order contract: the serving-fleet
+        # rows drive the threaded stack, and a lock-graph that drifted
+        # from contracts/lockorder.json means the concurrency shape
+        # being benched is not the one that was reviewed.
+        rc = subprocess.call(
+            [sys.executable, "-m", "tools.mxrace", "--check"],
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if rc != 0:
+            sys.exit(f"bench: --contracts gate failed (mxrace "
+                     f"rc={rc}) — the lock-order graph drifted from "
+                     f"contracts/lockorder.json; inspect `python -m "
+                     f"tools.mxrace` and either fix the drift or "
+                     f"regenerate with --update before benching")
         print("bench: --contracts gate passed (programs match "
-              "contracts/)")
+              "contracts/, lock graph matches lockorder.json)")
     if "--preflight" in sys.argv[1:]:
         # Answer "will the selected sweep fit the wall budget?" without
         # touching the TPU.  Non-zero exit = the sweep as configured

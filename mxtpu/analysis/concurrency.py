@@ -1,0 +1,970 @@
+"""mxrace Pass 1 — static lock-order graph over the threaded tree.
+
+The serving/obs stack shares state across worker, watcher, and
+control-plane threads; PR 7 *documented* its lock order in a module
+docstring.  This pass turns that prose into a machine-checked fact:
+
+* find every lock definition (``self._x = threading.Lock()`` /
+  ``RLock`` / ``Condition``, plus module-level ``_LOCK = ...``);
+* find every acquisition site (``with self._lock:`` /
+  ``with _LOCK:``), resolving nesting *interprocedurally* through
+  direct calls (``self.m()``, typed attrs like ``self.batcher``,
+  annotated params, unique method names) and the ``*_locked``
+  called-with-lock-held convention;
+* emit the resulting lock-order DAG; cycles are potential deadlocks
+  (errors), and the edge set is pinned in ``contracts/lockorder.json``
+  so new nesting is growth-only drift ``--check`` flags;
+* flag unannotated shared mutable attrs: in a lock-owning class, an
+  attr written outside ``__init__`` and touched from >= 2 methods
+  (thread entry points) must carry ``# guarded-by: <lock>`` or a
+  justified ``# mxrace: disable=unguarded-attr`` pragma.
+
+Pure stdlib (``ast``/``re``/``json``) like tools/mxlint — this module
+must never import jax or the mxtpu package, so a broken tree is still
+analyzable.  It *reuses* mxlint's file model (FileCtx, pragma and
+``# guarded-by:`` parsing, discovery, Finding/baseline machinery),
+loading it by path when ``tools`` is not importable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# what mxrace scans: everything that owns a lock or a thread today
+SCOPES = ("mxtpu/serving", "mxtpu/obs", "mxtpu/parallel",
+          "mxtpu/profiler.py", "mxtpu/guards.py")
+
+DEFAULT_LOCKFILE = REPO_ROOT / "contracts" / "lockorder.json"
+
+LOCKORDER_BEGIN = "<!-- mxrace:lockorder:begin -->"
+LOCKORDER_END = "<!-- mxrace:lockorder:end -->"
+
+_RACE_SUPPRESS_RE = re.compile(
+    r"#\s*mxrace:\s*disable=([\w\-, ]+?)(?:\s*\(([^)]*)\))?\s*(?:#|$)")
+
+# threading constructors that make an attr a sync primitive, not data
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_SYNC_CTORS = _LOCK_CTORS | _COND_CTORS | {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "Thread",
+    "Timer", "local"}
+
+# calls/ctors whose result is a mutable container (in-place mutation
+# of these never shows up as an attribute Store)
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "deque",
+                  "defaultdict", "OrderedDict", "Counter"}
+
+# method names that mutate a container in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "popitem", "clear", "update", "add",
+             "remove", "discard", "setdefault", "sort", "reverse",
+             "rotate"}
+
+
+# ----------------------------------------------------------------------
+# mxlint core reuse (shared FileCtx / pragma / Finding machinery)
+# ----------------------------------------------------------------------
+def _load_lintcore():
+    try:
+        from tools.mxlint import core  # repo root on sys.path
+        return core
+    except ImportError:
+        import importlib.util
+        path = REPO_ROOT / "tools" / "mxlint" / "core.py"
+        spec = importlib.util.spec_from_file_location(
+            "_mxrace_lintcore", path)
+        mod = importlib.util.module_from_spec(spec)
+        assert spec.loader is not None
+        spec.loader.exec_module(mod)
+        return mod
+
+
+lintcore = _load_lintcore()
+Finding = lintcore.Finding
+FileCtx = lintcore.FileCtx
+dotted_name = lintcore.dotted_name
+_GUARDED_RE = lintcore._GUARDED_RE
+_ASSIGN_RE = re.compile(r"self\.(\w+)\s*(?::[^=]*)?=[^=]")
+
+
+# ----------------------------------------------------------------------
+# scan model
+# ----------------------------------------------------------------------
+class MethodRec:
+    """One function/method body plus its first-sweep summary."""
+
+    __slots__ = ("qual", "cls", "name", "node", "rel", "modname",
+                 "local_types", "direct_acquires", "direct_calls")
+
+    def __init__(self, qual: str, cls: Optional[str], name: str,
+                 node: ast.AST, rel: str, modname: str):
+        self.qual = qual
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.rel = rel
+        self.modname = modname
+        self.local_types: Dict[str, str] = {}
+        self.direct_acquires: Set[str] = set()
+        self.direct_calls: Set[str] = set()
+
+
+class ClassRec:
+    __slots__ = ("name", "rel", "modname", "line", "end_line", "bases",
+                 "methods", "lock_attrs", "alias_locks", "attr_types",
+                 "guarded", "race_supp", "init_attrs", "sync_attrs",
+                 "container_attrs", "writes", "touches",
+                 "first_write_line")
+
+    def __init__(self, name: str, rel: str, modname: str, line: int,
+                 end_line: int, bases: List[str]):
+        self.name = name
+        self.rel = rel
+        self.modname = modname
+        self.line = line
+        self.end_line = end_line
+        self.bases = bases
+        self.methods: Dict[str, MethodRec] = {}
+        # attr -> (kind, line): locks *created* here (threading ctor)
+        self.lock_attrs: Dict[str, Tuple[str, int]] = {}
+        # attrs used as `with self.x:` without a local threading ctor
+        # (lock passed in / shared — e.g. metrics child handles)
+        self.alias_locks: Dict[str, int] = {}
+        self.attr_types: Dict[str, str] = {}
+        self.guarded: Dict[str, str] = {}          # attr -> lock attr
+        self.race_supp: Dict[str, Set[str]] = {}   # attr -> rules
+        self.init_attrs: Set[str] = set()
+        self.sync_attrs: Set[str] = set()
+        self.container_attrs: Set[str] = set()
+        self.writes: Dict[str, Set[str]] = {}      # attr -> methods
+        self.touches: Dict[str, Set[str]] = {}     # attr -> methods
+        self.first_write_line: Dict[str, int] = {}
+
+    def has_locks(self) -> bool:
+        return bool(self.lock_attrs or self.alias_locks)
+
+
+class Analysis:
+    """Everything the graph/finding passes need, fully resolved."""
+
+    def __init__(self) -> None:
+        self.ctxs: List[FileCtx] = []
+        self.parse_errors: List[Finding] = []
+        self.classes: Dict[str, ClassRec] = {}
+        self.methods: Dict[str, MethodRec] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+        self.functions_by_name: Dict[str, List[str]] = {}
+        self.module_locks: Dict[str, Dict[str, Tuple[str, int, str]]] \
+            = {}  # modname -> name -> (kind, line, rel)
+        self.module_funcs: Dict[str, Set[str]] = {}
+        self.modules: Dict[str, str] = {}  # modname -> rel
+        # line-level mxrace pragma map per rel path
+        self.race_suppressions: Dict[str, Dict[int, Set[str]]] = {}
+
+
+def _modname(rel: str) -> str:
+    p = Path(rel)
+    return p.parent.name if p.stem == "__init__" else p.stem
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition'/... when value is a threading
+    constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted_name(value.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    return last if last in _SYNC_CTORS else None
+
+
+def _is_mutable_value(value: ast.AST) -> bool:
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        d = dotted_name(value.func)
+        if d and d.rsplit(".", 1)[-1] in _MUTABLE_CTORS:
+            return True
+    return False
+
+
+def _type_name(ann: Optional[ast.AST]) -> Optional[str]:
+    if ann is None:
+        return None
+    d = dotted_name(ann)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# ----------------------------------------------------------------------
+# scan: files -> Analysis
+# ----------------------------------------------------------------------
+def scan(paths: Sequence[str] = SCOPES,
+         root: Path = REPO_ROOT) -> Analysis:
+    an = Analysis()
+    files = lintcore.iter_py_files(paths, root)
+    an.ctxs, an.parse_errors = lintcore.parse_files(files, root)
+    for ctx in an.ctxs:
+        _scan_file(an, ctx)
+    _first_sweep(an)
+    return an
+
+
+def _race_supp_map(ctx: FileCtx) -> Dict[int, Set[str]]:
+    """line -> suppressed mxrace rule names; a comment-only pragma
+    line also covers the line after it (same semantics as mxlint)."""
+    supp: Dict[int, Set[str]] = {}
+    for i, ln in enumerate(ctx.lines, start=1):
+        m = _RACE_SUPPRESS_RE.search(ln)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        supp.setdefault(i, set()).update(rules)
+        if ln.lstrip().startswith("#"):
+            supp.setdefault(i + 1, set()).update(rules)
+    return supp
+
+
+def _scan_file(an: Analysis, ctx: FileCtx) -> None:
+    mod = _modname(ctx.rel)
+    an.modules[mod] = ctx.rel
+    an.module_locks.setdefault(mod, {})
+    an.module_funcs.setdefault(mod, set())
+    an.race_suppressions[ctx.rel] = _race_supp_map(ctx)
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign):
+            kind = _ctor_kind(stmt.value)
+            if kind and kind in (_LOCK_CTORS | _COND_CTORS):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        an.module_locks[mod][tgt.id] = \
+                            (kind, stmt.lineno, ctx.rel)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{mod}.{stmt.name}"
+            rec = MethodRec(qual, None, stmt.name, stmt, ctx.rel, mod)
+            an.methods[qual] = rec
+            an.module_funcs[mod].add(stmt.name)
+            an.functions_by_name.setdefault(stmt.name, []).append(qual)
+        elif isinstance(stmt, ast.ClassDef):
+            _scan_class(an, ctx, mod, stmt)
+
+
+def _scan_class(an: Analysis, ctx: FileCtx, mod: str,
+                cls: ast.ClassDef) -> None:
+    rec = ClassRec(cls.name, ctx.rel, mod, cls.lineno,
+                   cls.end_lineno or len(ctx.lines),
+                   [d.rsplit(".", 1)[-1] for d in
+                    (dotted_name(b) for b in cls.bases) if d])
+    # keep the first definition on (unlikely) duplicate class names —
+    # deterministic because files arrive sorted
+    an.classes.setdefault(cls.name, rec)
+    if an.classes[cls.name] is not rec:
+        return
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{cls.name}.{meth.name}"
+        mrec = MethodRec(qual, cls.name, meth.name, meth, ctx.rel, mod)
+        rec.methods[meth.name] = mrec
+        an.methods[qual] = mrec
+        an.methods_by_name.setdefault(meth.name, []).append(qual)
+        _scan_method_attrs(an, rec, meth)
+    _scan_annotations(ctx, rec)
+
+
+def _scan_annotations(ctx: FileCtx, rec: ClassRec) -> None:
+    """# guarded-by: and # mxrace: disable= pragmas paired with a
+    ``self.<attr> = ...`` assignment on the same or the next line
+    (same pairing LockDiscipline uses)."""
+    for i in range(rec.line, min(rec.end_line, len(ctx.lines)) + 1):
+        line = ctx.lines[i - 1] if i <= len(ctx.lines) else ""
+        gm = _GUARDED_RE.search(line)
+        sm = _RACE_SUPPRESS_RE.search(line)
+        if not gm and not sm:
+            continue
+        am = _ASSIGN_RE.search(line)
+        if am is None and i < len(ctx.lines):
+            am = _ASSIGN_RE.search(ctx.lines[i])
+        if am is None:
+            continue
+        attr = am.group(1)
+        if gm:
+            rec.guarded[attr] = gm.group(1)
+        if sm:
+            rec.race_supp.setdefault(attr, set()).update(
+                r.strip() for r in sm.group(1).split(",") if r.strip())
+
+
+def _scan_method_attrs(an: Analysis, rec: ClassRec,
+                       meth: ast.AST) -> None:
+    """Collect self.<attr> definitions, writes and touches for the
+    unguarded-attr pass, plus typed-attr and lock-attr inventories."""
+    name = meth.name
+    in_init = name == "__init__"
+    # param-annotation types feed attr_types for `self.x = x`
+    params: Dict[str, str] = {}
+    for a in list(meth.args.posonlyargs) + list(meth.args.args) + \
+            list(meth.args.kwonlyargs):
+        t = _type_name(a.annotation)
+        if t:
+            params[a.arg] = t
+
+    def note_write(attr: str, line: int) -> None:
+        rec.writes.setdefault(attr, set()).add(name)
+        rec.touches.setdefault(attr, set()).add(name)
+        if not in_init and attr not in rec.first_write_line:
+            rec.first_write_line[attr] = line
+
+    def note_value(attr: str, value: ast.AST) -> None:
+        kind = _ctor_kind(value)
+        if kind:
+            rec.sync_attrs.add(attr)
+            if kind in (_LOCK_CTORS | _COND_CTORS) and \
+                    attr not in rec.lock_attrs:
+                rec.lock_attrs[attr] = (kind, value.lineno)
+        if _is_mutable_value(value):
+            rec.container_attrs.add(attr)
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func)
+            if d:
+                rec.attr_types.setdefault(attr, d.rsplit(".", 1)[-1])
+        elif isinstance(value, ast.Name) and value.id in params:
+            rec.attr_types.setdefault(attr, params[value.id])
+
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    note_write(attr, node.lineno)
+                    if in_init:
+                        rec.init_attrs.add(attr)
+                        note_value(attr, node.value)
+                elif isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                    if attr:
+                        note_write(attr, node.lineno)
+        elif isinstance(node, ast.AnnAssign):
+            attr = _self_attr(node.target)
+            if attr and node.value is not None:
+                note_write(attr, node.lineno)
+                if in_init:
+                    rec.init_attrs.add(attr)
+                    note_value(attr, node.value)
+        elif isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr:
+                note_write(attr, node.lineno)
+            elif isinstance(node.target, ast.Subscript):
+                attr = _self_attr(node.target.value)
+                if attr:
+                    note_write(attr, node.lineno)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                attr = _self_attr(tgt) or (
+                    _self_attr(tgt.value)
+                    if isinstance(tgt, ast.Subscript) else None)
+                if attr:
+                    note_write(attr, node.lineno)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr:
+                note_write(attr, node.lineno)
+        elif isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr:
+                rec.touches.setdefault(attr, set()).add(name)
+
+
+# ----------------------------------------------------------------------
+# resolution helpers
+# ----------------------------------------------------------------------
+def _mro(an: Analysis, cls: str,
+         _seen: Optional[Set[str]] = None) -> List[str]:
+    seen = _seen if _seen is not None else set()
+    if cls in seen or cls not in an.classes:
+        return []
+    seen.add(cls)
+    out = [cls]
+    for b in an.classes[cls].bases:
+        out.extend(_mro(an, b, seen))
+    return out
+
+
+def _method_in_mro(an: Analysis, cls: str, name: str) -> Optional[str]:
+    for c in _mro(an, cls):
+        if name in an.classes[c].methods:
+            return f"{c}.{name}"
+    return None
+
+
+def _lock_owner(an: Analysis, cls: str, attr: str) -> Optional[str]:
+    for c in _mro(an, cls):
+        if attr in an.classes[c].lock_attrs:
+            return c
+    return None
+
+
+def _attr_type(an: Analysis, cls: str, attr: str) -> Optional[str]:
+    for c in _mro(an, cls):
+        t = an.classes[c].attr_types.get(attr)
+        if t and t in an.classes:
+            return t
+    return None
+
+
+def _resolve_lock(an: Analysis, expr: ast.AST,
+                  rec: MethodRec) -> Optional[Tuple[str, str]]:
+    """(node_name, kind) for a with-item that is a lock reference."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if parts[0] == "self" and len(parts) == 2 and rec.cls:
+        attr = parts[1]
+        owner = _lock_owner(an, rec.cls, attr)
+        if owner:
+            return (f"{owner}.{attr}",
+                    an.classes[owner].lock_attrs[attr][0])
+        # `with self.x:` on an attr with no local threading ctor —
+        # a lock passed in (metrics child handles share the family
+        # lock); model it as its own alias node, still DAG-checked
+        an.classes[rec.cls].alias_locks.setdefault(attr, expr.lineno)
+        return (f"{rec.cls}.{attr}", "alias")
+    if len(parts) == 1:
+        locks = an.module_locks.get(rec.modname, {})
+        if parts[0] in locks:
+            return (f"{rec.modname}.{parts[0]}", locks[parts[0]][0])
+    if len(parts) == 2 and parts[0] in an.module_locks:
+        locks = an.module_locks[parts[0]]
+        if parts[1] in locks:
+            return (f"{parts[0]}.{parts[1]}", locks[parts[1]][0])
+    return None
+
+
+def _return_type(an: Analysis, qual: str) -> Optional[str]:
+    cls, _, name = qual.partition(".")
+    if name == "__init__":
+        return cls
+    rec = an.methods.get(qual)
+    if rec is None:
+        return None
+    t = _type_name(getattr(rec.node, "returns", None))
+    return t if t in an.classes else None
+
+
+def _resolve_call(an: Analysis, func: ast.AST,
+                  rec: MethodRec) -> Tuple[str, ...]:
+    """Qualnames a call may dispatch to.  Typed resolutions (self
+    methods, ctor/param-typed attrs, annotated locals, module
+    functions, chained calls via return annotations) are exact;
+    otherwise every scanned method sharing the name is a candidate
+    (conservative, deterministic)."""
+    if isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Call):
+        # chained call: obs.flight("compile").record(...) — resolve
+        # the inner call, then its annotated return type's method
+        out: Set[str] = set()
+        inner = _resolve_call(an, func.value.func, rec)
+        for q in inner:
+            t = _return_type(an, q)
+            if t:
+                m = _method_in_mro(an, t, func.attr)
+                if m:
+                    out.add(m)
+        if out or not inner or func.attr in _MUTATORS:
+            return tuple(sorted(out))
+        # inner call known but un-annotated (obs.flight returns the
+        # recorder or its null twin): every method with this name
+        return tuple(sorted(an.methods_by_name.get(func.attr, ())))
+    d = dotted_name(func)
+    if d is None:
+        return ()
+    parts = d.split(".")
+    last = parts[-1]
+    if parts[0] == "self" and rec.cls:
+        if len(parts) == 2:
+            q = _method_in_mro(an, rec.cls, last)
+            return (q,) if q else ()
+        if len(parts) == 3:
+            t = _attr_type(an, rec.cls, parts[1])
+            if t:
+                q = _method_in_mro(an, t, last)
+                return (q,) if q else ()
+    elif len(parts) == 2:
+        t = rec.local_types.get(parts[0])
+        if t and t in an.classes:
+            q = _method_in_mro(an, t, last)
+            return (q,) if q else ()
+        if parts[0] in an.modules:
+            if last in an.module_funcs.get(parts[0], ()):
+                return (f"{parts[0]}.{last}",)
+            # fall through: `obs.span` is re-exported from trace
+    elif len(parts) == 1:
+        if last in an.module_funcs.get(rec.modname, ()):
+            return (f"{rec.modname}.{last}",)
+        if last in an.classes:  # constructor
+            q = _method_in_mro(an, last, "__init__")
+            return (q,) if q else ()
+        return ()
+    if len(parts) >= 2 and last in an.classes:  # mod.Class(...) ctor
+        q = _method_in_mro(an, last, "__init__")
+        return (q,) if q else ()
+    if last in _MUTATORS:
+        # `self._queue.clear()` must not name-resolve to an unrelated
+        # `def clear` (FlightRecorder.clear) — container mutators only
+        # resolve through a typed receiver
+        return ()
+    cands = tuple(sorted(an.methods_by_name.get(last, ())))
+    if cands:
+        return cands
+    return tuple(sorted(an.functions_by_name.get(last, ())))
+
+
+# ----------------------------------------------------------------------
+# first sweep: per-function local types, direct acquires, direct calls
+# ----------------------------------------------------------------------
+def _first_sweep(an: Analysis) -> None:
+    for rec in an.methods.values():
+        node = rec.node
+        for a in list(node.args.posonlyargs) + list(node.args.args) + \
+                list(node.args.kwonlyargs):
+            t = _type_name(a.annotation)
+            if t and t in an.classes:
+                rec.local_types[a.arg] = t
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.AnnAssign) and \
+                    isinstance(sub.target, ast.Name):
+                t = _type_name(sub.annotation)
+                if t and t in an.classes:
+                    rec.local_types[sub.target.id] = t
+    for rec in an.methods.values():
+        for sub in _walk_no_nested(rec.node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    lk = _resolve_lock(an, item.context_expr, rec)
+                    if lk:
+                        rec.direct_acquires.add(lk[0])
+            elif isinstance(sub, ast.Call):
+                rec.direct_calls.update(_resolve_call(an, sub.func, rec))
+
+
+def _walk_no_nested(func_node: ast.AST):
+    """ast.walk, but do not descend into nested def/lambda (they run
+    in a different dynamic context)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _trans_acquires(an: Analysis, qual: str,
+                    memo: Dict[str, Set[str]],
+                    stack: Set[str]) -> Set[str]:
+    if qual in memo:
+        return memo[qual]
+    if qual in stack or qual not in an.methods:
+        return set()
+    stack.add(qual)
+    rec = an.methods[qual]
+    out = set(rec.direct_acquires)
+    for callee in rec.direct_calls:
+        out |= _trans_acquires(an, callee, memo, stack)
+    stack.discard(qual)
+    memo[qual] = out
+    return out
+
+
+# ----------------------------------------------------------------------
+# graph build
+# ----------------------------------------------------------------------
+class Graph:
+    def __init__(self) -> None:
+        self.locks: Dict[str, Dict[str, Any]] = {}
+        # (a, b) -> set of (rel, line) sites where b is taken under a
+        self.edges: Dict[Tuple[str, str], Set[Tuple[str, int]]] = {}
+
+    def add_lock(self, name: str, kind: str, rel: str,
+                 line: int) -> None:
+        self.locks.setdefault(
+            name, {"kind": kind, "site": f"{rel}:{line}"})
+
+    def add_edge(self, a: str, b: str, rel: str, line: int) -> None:
+        if a == b:
+            return
+        self.edges.setdefault((a, b), set()).add((rel, line))
+
+    def adjacency(self) -> Dict[str, List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        for v in adj.values():
+            v.sort()
+        return adj
+
+
+def _primary_lock(an: Analysis, cls: str) -> Optional[str]:
+    """The lock a ``*_locked`` method of ``cls`` is called under: the
+    attr named ``_lock`` if the class (or a base) defines one, else
+    the class's only lock."""
+    owner = _lock_owner(an, cls, "_lock")
+    if owner:
+        return f"{owner}._lock"
+    for c in _mro(an, cls):
+        la = an.classes[c].lock_attrs
+        if len(la) == 1:
+            attr = next(iter(la))
+            return f"{c}.{attr}"
+        if la:
+            return None  # ambiguous
+    return None
+
+
+def build_graph(an: Analysis) -> Graph:
+    g = Graph()
+    for cname in sorted(an.classes):
+        crec = an.classes[cname]
+        for attr, (kind, line) in sorted(crec.lock_attrs.items()):
+            g.add_lock(f"{cname}.{attr}", kind, crec.rel, line)
+    for mod in sorted(an.module_locks):
+        for lname, (kind, line, rel) in \
+                sorted(an.module_locks[mod].items()):
+            g.add_lock(f"{mod}.{lname}", kind, rel, line)
+
+    memo: Dict[str, Set[str]] = {}
+    nested: List[Tuple[MethodRec, ast.AST]] = []
+
+    def visit(rec: MethodRec, node: ast.AST,
+              held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                visit(rec, item.context_expr, held)
+                lk = _resolve_lock(an, item.context_expr, rec)
+                if lk:
+                    name, kind = lk
+                    if kind == "alias":
+                        g.add_lock(name, "alias", rec.rel,
+                                   item.context_expr.lineno)
+                    for h in held:
+                        g.add_edge(h, name, rec.rel,
+                                   item.context_expr.lineno)
+                    if name not in held:
+                        acquired.append(name)
+            inner = held + tuple(acquired)
+            for stmt in node.body:
+                visit(rec, stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.append((rec, node))
+            return
+        if isinstance(node, ast.Call) and held:
+            for callee in sorted(_resolve_call(an, node.func, rec)):
+                for lock in sorted(
+                        _trans_acquires(an, callee, memo, set())):
+                    for h in held:
+                        g.add_edge(h, lock, rec.rel, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            visit(rec, child, held)
+
+    for qual in sorted(an.methods):
+        rec = an.methods[qual]
+        held: Tuple[str, ...] = ()
+        if rec.name.endswith("_locked") and rec.cls:
+            primary = _primary_lock(an, rec.cls)
+            if primary:
+                held = (primary,)
+        for stmt in _body(rec.node):
+            visit(rec, stmt, held)
+    while nested:
+        rec, node = nested.pop()
+        for stmt in _body(node):
+            visit(rec, stmt, ())
+    return g
+
+
+def _body(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Lambda):
+        return [node.body]
+    return list(getattr(node, "body", []))
+
+
+# ----------------------------------------------------------------------
+# cycles
+# ----------------------------------------------------------------------
+def find_cycles(g: Graph) -> List[List[str]]:
+    """Deterministic DFS cycle enumeration; each cycle reported once
+    in canonical (min-first) rotation."""
+    adj = g.adjacency()
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    out: List[List[str]] = []
+    color: Dict[str, int] = {}
+    path: List[str] = []
+
+    def dfs(u: str) -> None:
+        color[u] = 1
+        path.append(u)
+        for v in adj.get(u, ()):
+            if color.get(v, 0) == 1:
+                i = path.index(v)
+                cyc = path[i:]
+                k = cyc.index(min(cyc))
+                canon = tuple(cyc[k:] + cyc[:k])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(list(canon))
+            elif color.get(v, 0) == 0:
+                dfs(v)
+        path.pop()
+        color[u] = 2
+
+    for node in sorted(set(g.locks) |
+                       {a for a, _ in g.edges} |
+                       {b for _, b in g.edges}):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    return out
+
+
+def _edge_site(g: Graph, a: str, b: str) -> str:
+    sites = g.edges.get((a, b))
+    if not sites:
+        return "?"
+    rel, line = min(sites)
+    return f"{rel}:{line}"
+
+
+def cycle_findings(g: Graph) -> List[Finding]:
+    out = []
+    for cyc in find_cycles(g):
+        ring = cyc + [cyc[0]]
+        sites = "; ".join(
+            f"{ring[i]} -> {ring[i + 1]} at "
+            f"{_edge_site(g, ring[i], ring[i + 1])}"
+            for i in range(len(cyc)))
+        first = g.edges.get((ring[0], ring[1]))
+        rel, line = min(first) if first else ("contracts", 1)
+        out.append(Finding(
+            "lock-cycle", rel, line,
+            f"lock-order cycle (potential deadlock): "
+            f"{' -> '.join(ring)} [{sites}]",
+            snippet=" -> ".join(ring)))
+    return out
+
+
+# ----------------------------------------------------------------------
+# unguarded shared mutable attrs
+# ----------------------------------------------------------------------
+def unguarded_findings(an: Analysis) -> List[Finding]:
+    out: List[Finding] = []
+    for cname in sorted(an.classes):
+        rec = an.classes[cname]
+        if not rec.has_locks():
+            continue
+        guarded = dict(rec.guarded)
+        for base in _mro(an, cname)[1:]:
+            for a, lk in an.classes[base].guarded.items():
+                guarded.setdefault(a, lk)
+        for attr in sorted(rec.writes):
+            if attr in rec.sync_attrs or attr in rec.lock_attrs or \
+                    attr in rec.alias_locks:
+                continue
+            if attr in guarded:
+                continue
+            writers = rec.writes[attr] - {"__init__"}
+            if not writers:
+                continue
+            touchers = rec.touches.get(attr, set()) - {"__init__"}
+            if len(touchers) < 2:
+                continue
+            if "unguarded-attr" in rec.race_supp.get(attr, ()) or \
+                    "*" in rec.race_supp.get(attr, ()):
+                continue
+            line = rec.first_write_line.get(attr, rec.line)
+            supp = an.race_suppressions.get(rec.rel, {})
+            if "unguarded-attr" in supp.get(line, ()) or \
+                    "*" in supp.get(line, ()):
+                continue
+            out.append(Finding(
+                "unguarded-attr", rec.rel, line,
+                f"`{cname}.{attr}` is shared mutable state (written in "
+                f"{sorted(writers)}, touched from "
+                f"{len(touchers)} methods) in a lock-owning class but "
+                f"carries no `# guarded-by:` — annotate it or justify "
+                f"with `# mxrace: disable=unguarded-attr (why)`",
+                snippet=f"{cname}.{attr}"))
+    return out
+
+
+# ----------------------------------------------------------------------
+# lockfile (contracts/lockorder.json)
+# ----------------------------------------------------------------------
+def lockfile_dict(g: Graph) -> Dict[str, Any]:
+    """Structure-only pin: lock names/kinds and the edge set.  Sites
+    are deliberately excluded so unrelated line drift never dirties
+    the contract."""
+    return {
+        "comment": "mxrace lock-order DAG; regenerate with "
+                   "`python -m tools.mxrace --update`",
+        "locks": {name: info["kind"]
+                  for name, info in sorted(g.locks.items())},
+        "edges": sorted(f"{a} -> {b}" for (a, b) in g.edges),
+    }
+
+
+def save_lockfile(d: Dict[str, Any],
+                  path: Path = DEFAULT_LOCKFILE) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(d, indent=1, sort_keys=True) + "\n")
+
+
+def load_lockfile(path: Path = DEFAULT_LOCKFILE
+                  ) -> Optional[Dict[str, Any]]:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def diff_lockfile(stored: Optional[Dict[str, Any]], g: Graph,
+                  path: Path = DEFAULT_LOCKFILE
+                  ) -> Tuple[List[Finding], List[str]]:
+    """(findings, notices).  New edges are growth-only drift —
+    findings; removed edges/locks and new locks are notices (code
+    deleted or sync added without nesting is not a deadlock risk)."""
+    rel = path.relative_to(REPO_ROOT).as_posix() \
+        if path.is_relative_to(REPO_ROOT) else path.as_posix()
+    current = lockfile_dict(g)
+    if stored is None:
+        return ([Finding(
+            "lock-order-drift", rel, 1,
+            f"{rel} missing — run `python -m tools.mxrace --update`",
+            snippet="missing-lockfile")], [])
+    findings: List[Finding] = []
+    notices: List[str] = []
+    old_edges = set(stored.get("edges", []))
+    new_edges = set(current["edges"])
+    for e in sorted(new_edges - old_edges):
+        a, b = e.split(" -> ", 1)
+        findings.append(Finding(
+            "lock-order-drift", rel, 1,
+            f"new lock-order edge `{e}` (first site "
+            f"{_edge_site(g, a, b)}) not in the committed DAG — "
+            f"review the nesting, then `python -m tools.mxrace "
+            f"--update`",
+            snippet=e))
+    for e in sorted(old_edges - new_edges):
+        notices.append(f"edge `{e}` vanished (stale lockfile entry; "
+                       f"--update to prune)")
+    old_locks = set(stored.get("locks", {}))
+    new_locks = set(current["locks"])
+    for n in sorted(new_locks - old_locks):
+        notices.append(f"new lock `{n}` ({current['locks'][n]})")
+    for n in sorted(old_locks - new_locks):
+        notices.append(f"lock `{n}` vanished")
+    return findings, notices
+
+
+# ----------------------------------------------------------------------
+# README lock-order table
+# ----------------------------------------------------------------------
+def render_lockorder_table(g: Graph) -> str:
+    srcs: Dict[str, Set[str]] = {}
+    for (a, b) in g.edges:
+        srcs.setdefault(a, set()).add(b)
+    lines = [LOCKORDER_BEGIN,
+             "| holding | may acquire |",
+             "|---|---|"]
+    for a in sorted(srcs):
+        tgts = ", ".join(f"`{b}`" for b in sorted(srcs[a]))
+        lines.append(f"| `{a}` | {tgts} |")
+    leaves = sorted(set(g.locks) - set(srcs))
+    if leaves:
+        lines.append("| *(leaf — acquire nothing)* | "
+                     + ", ".join(f"`{n}`" for n in leaves) + " |")
+    lines.append("")
+    lines.append(f"*{len(g.locks)} locks, {len(g.edges)} edges; "
+                 f"pinned in `contracts/lockorder.json`, regenerate "
+                 f"with `python -m tools.mxrace --fix-readme`.*")
+    lines.append(LOCKORDER_END)
+    return "\n".join(lines)
+
+
+def readme_drift(root: Path, g: Graph) -> List[Finding]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return [Finding("lockorder-readme-drift", "README.md", 1,
+                        "README.md missing")]
+    text = readme.read_text()
+    if LOCKORDER_BEGIN not in text or LOCKORDER_END not in text:
+        return [Finding(
+            "lockorder-readme-drift", "README.md", 1,
+            "README.md lacks the mxrace:lockorder markers — run "
+            "`python -m tools.mxrace --fix-readme`")]
+    current = text.split(LOCKORDER_BEGIN, 1)[1] \
+                  .split(LOCKORDER_END, 1)[0]
+    want = render_lockorder_table(g) \
+        .split(LOCKORDER_BEGIN, 1)[1].split(LOCKORDER_END, 1)[0]
+    if current.strip() != want.strip():
+        line = text[:text.index(LOCKORDER_BEGIN)].count("\n") + 1
+        return [Finding(
+            "lockorder-readme-drift", "README.md", line,
+            "README lock-order table is stale — run "
+            "`python -m tools.mxrace --fix-readme`",
+            snippet="lockorder-table")]
+    return []
+
+
+def fix_readme(root: Path, g: Graph) -> bool:
+    readme = root / "README.md"
+    text = readme.read_text()
+    if LOCKORDER_BEGIN not in text or LOCKORDER_END not in text:
+        raise SystemExit(
+            f"README.md lacks the markers {LOCKORDER_BEGIN!r} … "
+            f"{LOCKORDER_END!r}; add them where the table should live")
+    head = text.split(LOCKORDER_BEGIN, 1)[0]
+    tail = text.split(LOCKORDER_END, 1)[1]
+    new = head + render_lockorder_table(g) + tail
+    if new != text:
+        readme.write_text(new)
+        return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# one-call driver (CLI, tests, bench --contracts gate)
+# ----------------------------------------------------------------------
+def run_check(paths: Sequence[str] = SCOPES, root: Path = REPO_ROOT,
+              lockfile: Path = DEFAULT_LOCKFILE, check_readme: bool =
+              True) -> Tuple[List[Finding], List[str], Graph]:
+    """(findings, notices, graph) for the full static pass."""
+    an = scan(paths, root)
+    g = build_graph(an)
+    findings = list(an.parse_errors)
+    findings.extend(cycle_findings(g))
+    findings.extend(unguarded_findings(an))
+    drift, notices = diff_lockfile(load_lockfile(lockfile), g, lockfile)
+    findings.extend(drift)
+    if check_readme:
+        findings.extend(readme_drift(root, g))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, notices, g

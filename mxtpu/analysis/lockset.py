@@ -1,0 +1,493 @@
+"""mxrace Pass 2 — deterministic Eraser-style lockset sanitizer.
+
+The static pass (``concurrency.py``) proves lock-order facts the AST
+can see; this pass checks the ones it can't — watcher callbacks,
+aliased locks, ``*_locked`` conventions actually honored at runtime —
+by instrumenting the clock-injected sync-mode tests:
+
+* ``threading.Lock``/``RLock`` are monkeypatched so every
+  acquire/release updates a per-thread held-set (Condition-compatible:
+  ``wait()`` correctly drops the lock while parked);
+* attribute access on instrumented classes updates per-``(object,
+  attr)`` *candidate locksets* — the intersection of locks held at
+  every access.  Lockset refinement is schedule-independent: accesses
+  under ``{A}`` then ``{B}`` intersect to ∅ no matter how threads
+  interleave, which is what makes this sanitizer deterministic enough
+  to gate CI on single-threaded sync-mode tests.
+
+Rules (each seeded-race fixture in tests/test_race.py trips exactly
+one):
+
+* ``lockset-empty``      — a tracked shared attr's candidate lockset
+  became empty; reported with BOTH access sites.
+* ``guarded-by-violation`` — an attr annotated ``# guarded-by: L``
+  was touched while ``L`` was not held (the dynamic twin of mxlint's
+  lock-discipline rule, but alias- and call-path-aware).
+* ``lock-order``         — a runtime acquisition order contradicts an
+  already-observed order (cycle ⇒ potential deadlock).
+
+Zero overhead when off: nothing here is imported unless the
+``MXTPU_RACE`` knob (or a test) activates a checker, mirroring the
+obs layer's off-is-free contract.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_THIS_FILE = str(Path(__file__).resolve())
+
+
+def _skip_frame(f) -> bool:
+    fname = f.f_code.co_filename
+    return (str(Path(fname).resolve()) == _THIS_FILE
+            or Path(fname).name == "threading.py")
+
+
+def _site_of(frame) -> str:
+    f = frame
+    while f is not None and _skip_frame(f):
+        f = f.f_back
+    if f is None:
+        return "?:0"
+    p = Path(f.f_code.co_filename)
+    try:
+        rel = p.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        rel = p.name
+    return f"{rel}:{f.f_lineno}"
+
+
+class RaceReport:
+    """One sanitizer finding."""
+
+    __slots__ = ("rule", "subject", "sites", "message")
+
+    def __init__(self, rule: str, subject: str, sites: List[str],
+                 message: str):
+        self.rule = rule
+        self.subject = subject
+        self.sites = sites
+        self.message = message
+
+    def format(self) -> str:
+        return (f"[{self.rule}] {self.subject}: {self.message} "
+                f"(sites: {', '.join(self.sites)})")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<RaceReport {self.format()}>"
+
+
+class _TracedLock:
+    """Wrapper around a real Lock/RLock that notifies the checker.
+    Exposes the private Condition protocol (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``) so ``threading.Condition``
+    built on a traced lock keeps exact wait semantics — including the
+    held-set dropping while a waiter is parked."""
+
+    def __init__(self, checker: "LocksetChecker", reentrant: bool):
+        self._raw = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._checker = checker
+        self._reentrant = reentrant
+        self.name: Optional[str] = None
+        self.seq = checker._next_seq()
+        # the checker keeps every traced lock alive: the order graph
+        # and locksets key by id(), and a GC'd lock's id being reused
+        # by a fresh one would alias stale edges onto it (a recycled
+        # request's _wlock/cond pair can otherwise read as a
+        # lock-order inversion of its predecessor's)
+        checker._all_locks.append(self)
+
+    # -- the public lock protocol ---------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._checker._on_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._checker._on_release(self)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<TracedLock {self.label()} raw={self._raw!r}>"
+
+    def label(self) -> str:
+        return self.name or f"lock#{self.seq}"
+
+    # -- Condition compatibility ----------------------------------------
+    def _release_save(self):
+        self._checker._on_release(self, full=True)
+        if hasattr(self._raw, "_release_save"):
+            return self._raw._release_save()
+        self._raw.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._raw, "_acquire_restore"):
+            self._raw._acquire_restore(state)
+        else:
+            self._raw.acquire()
+        self._checker._on_acquire(self)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._raw, "_is_owned"):
+            return self._raw._is_owned()
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover
+        if hasattr(self._raw, "_at_fork_reinit"):
+            self._raw._at_fork_reinit()
+
+
+class _Held(threading.local):
+    """Per-thread held-lock state."""
+
+    def __init__(self):
+        self.stack: List[_TracedLock] = []
+        self.counts: Dict[int, int] = {}
+
+
+def _traced_of(value: Any) -> Optional[_TracedLock]:
+    """The traced lock behind ``value`` — unwrapping a Condition to
+    the lock it synchronizes on."""
+    if isinstance(value, _TracedLock):
+        return value
+    inner = getattr(value, "_lock", None)  # threading.Condition
+    if isinstance(inner, _TracedLock):
+        return inner
+    return None
+
+
+def _lock_id_of(value: Any) -> Optional[int]:
+    lk = _traced_of(value)
+    return None if lk is None else id(lk)
+
+
+class LocksetChecker:
+    """Patch point + report sink.  Use as a context manager::
+
+        checker = LocksetChecker()
+        checker.instrument(MyClass, attrs=("count",),
+                           guarded={"items": "_lock"})
+        with checker.activate():
+            ... run the scenario ...
+        assert not checker.reports
+    """
+
+    def __init__(self) -> None:
+        self.reports: List[RaceReport] = []
+        self._active = False
+        self._held = _Held()
+        self._mu = _REAL_LOCK()      # raw: guards the shared maps
+        self._seq = 0
+        self._all_locks: List[_TracedLock] = []   # id-reuse pin
+        # (id(obj), attr) -> {"lockset": set of lock ids, "last": site}
+        self._attrs: Dict[Tuple[int, str], Dict[str, Any]] = {}
+        # runtime order edges: (id_a, id_b) -> site of first observation
+        self._edges: Dict[Tuple[int, int], str] = {}
+        self._adj: Dict[int, Set[int]] = {}
+        self._reported: Set[Tuple[str, Any]] = set()
+        # class instrumentation bookkeeping for restore
+        self._patched: List[Tuple[type, Dict[str, Any]]] = []
+        self._instrumented: List[Tuple[type, Set[str],
+                                       Dict[str, str]]] = []
+
+    # -- sequence / naming ------------------------------------------------
+    def _next_seq(self) -> int:
+        with self._mu:
+            self._seq += 1
+            return self._seq
+
+    def name_lock(self, value: Any, name: str) -> None:
+        """Give the traced lock behind ``value`` a stable report
+        name (done automatically when a lock lands on an
+        instrumented class's attribute).  The lock may predate this
+        checker — a prior activation window created it — so work on
+        the object itself, never a per-checker registry."""
+        lk = _traced_of(value)
+        if lk is not None and lk.name is None:
+            lk.name = name
+
+    # -- activation -------------------------------------------------------
+    def activate(self) -> "LocksetChecker":
+        if self._active:
+            return self
+        checker = self
+
+        def make_lock():
+            return _TracedLock(checker, reentrant=False)
+
+        def make_rlock():
+            return _TracedLock(checker, reentrant=True)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        for cls, tracked, guarded in self._instrumented:
+            self._apply_instrumentation(cls, tracked, guarded)
+        self._active = True
+        return self
+
+    def deactivate(self) -> None:
+        if not self._active:
+            return
+        self._active = False
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        for cls, saved in reversed(self._patched):
+            for name, orig in saved.items():
+                if orig is None:
+                    try:
+                        delattr(cls, name)
+                    except AttributeError:  # pragma: no cover
+                        pass
+                else:
+                    setattr(cls, name, orig)
+        self._patched.clear()
+
+    def __enter__(self) -> "LocksetChecker":
+        return self.activate()
+
+    def __exit__(self, *exc) -> bool:
+        self.deactivate()
+        return False
+
+    # -- class instrumentation -------------------------------------------
+    def instrument(self, cls: type, attrs: Iterable[str] = (),
+                   guarded: Optional[Dict[str, str]] = None) -> None:
+        """Track ``attrs`` with candidate locksets and check
+        ``guarded`` (attr -> lock-attr name) accesses dynamically.
+        Takes effect at :meth:`activate`."""
+        tracked = set(attrs)
+        gmap = dict(guarded or {})
+        self._instrumented.append((cls, tracked, gmap))
+        if self._active:
+            self._apply_instrumentation(cls, tracked, gmap)
+
+    def _apply_instrumentation(self, cls: type, tracked: Set[str],
+                               guarded: Dict[str, str]) -> None:
+        checker = self
+        watched = tracked | set(guarded)
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+        saved = {
+            "__getattribute__":
+                cls.__dict__.get("__getattribute__"),
+            "__setattr__": cls.__dict__.get("__setattr__"),
+        }
+
+        def __getattribute__(obj, name):
+            if name in watched and checker._active:
+                checker._on_access(obj, cls, name, orig_get,
+                                   guarded.get(name),
+                                   name in tracked, write=False)
+            return orig_get(obj, name)
+
+        def __setattr__(obj, name, value):
+            if name in watched and checker._active:
+                checker._on_access(obj, cls, name, orig_get,
+                                   guarded.get(name),
+                                   name in tracked, write=True)
+            orig_set(obj, name, value)
+            if checker._active and _lock_id_of(value) is not None:
+                checker.name_lock(value, f"{cls.__name__}.{name}")
+
+        cls.__getattribute__ = __getattribute__
+        cls.__setattr__ = __setattr__
+        self._patched.append((cls, saved))
+
+    # -- lock events ------------------------------------------------------
+    def _on_acquire(self, lock: _TracedLock) -> None:
+        h = self._held
+        lid = id(lock)
+        c = h.counts.get(lid, 0)
+        h.counts[lid] = c + 1
+        if c:
+            return  # reentrant re-acquire: order already recorded
+        if self._active and h.stack:
+            site = _site_of(sys._getframe(1))
+            for prev in h.stack:
+                self._order_edge(prev, lock, site)
+        h.stack.append(lock)
+
+    def _on_release(self, lock: _TracedLock,
+                    full: bool = False) -> None:
+        h = self._held
+        lid = id(lock)
+        c = h.counts.get(lid, 0)
+        if c <= 1 or full:
+            h.counts.pop(lid, None)
+            try:
+                h.stack.remove(lock)
+            except ValueError:  # released by a non-acquiring thread
+                pass
+        else:
+            h.counts[lid] = c - 1
+
+    def _order_edge(self, a: _TracedLock, b: _TracedLock,
+                    site: str) -> None:
+        ka, kb = id(a), id(b)
+        if ka == kb:
+            return
+        with self._mu:
+            if (ka, kb) in self._edges:
+                return
+            self._edges[(ka, kb)] = site
+            self._adj.setdefault(ka, set()).add(kb)
+            # does b already reach a?  then (a -> b) closes a cycle
+            hop = self._first_hop(kb, ka)
+        if hop is None:
+            return
+        key = ("lock-order", (ka, kb))
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        back_site = self._edges.get((kb, hop), "?")
+        self.reports.append(RaceReport(
+            "lock-order",
+            f"{a.label()} -> {b.label()}",
+            [site, back_site],
+            f"acquired `{b.label()}` while holding `{a.label()}`, "
+            f"but the opposite order was already observed — "
+            f"deadlock-prone inversion"))
+
+    def _first_hop(self, src: int, dst: int) -> Optional[int]:
+        """First hop of some path src -> ... -> dst, else None."""
+        seen: Set[int] = {src}
+        queue: List[Tuple[int, int]] = [
+            (v, v) for v in sorted(self._adj.get(src, ()))]
+        while queue:
+            u, hop = queue.pop(0)
+            if u == dst:
+                return hop
+            if u in seen:
+                continue
+            seen.add(u)
+            queue.extend((v, hop)
+                         for v in sorted(self._adj.get(u, ())))
+        return None
+
+    # -- attribute events -------------------------------------------------
+    def _on_access(self, obj: Any, cls: type, name: str, orig_get,
+                   guard_attr: Optional[str], tracked: bool,
+                   write: bool) -> None:
+        frame = sys._getframe(2)
+        # construction is single-threaded by definition; Eraser
+        # excludes the init window so first-publication writes do not
+        # poison the lockset
+        f = frame
+        while f is not None and _skip_frame(f):
+            f = f.f_back
+        if f is not None and f.f_code.co_name == "__init__" and \
+                f.f_locals.get("self") is obj:
+            return
+        site = _site_of(frame)
+        h = self._held
+        held_ids = frozenset(id(lk) for lk in h.stack)
+        subject = f"{cls.__name__}.{name}"
+        if guard_attr is not None:
+            try:
+                lock_val = orig_get(obj, guard_attr)
+            except AttributeError:
+                lock_val = None
+            lk = _traced_of(lock_val)
+            # only locks created under THIS checker are checkable: a
+            # raw lock (instance predates activation) or a leftover
+            # from a prior checker's window notifies someone else's
+            # held-set, so "not held" would be a false alarm
+            if lk is not None and lk._checker is self \
+                    and id(lk) not in held_ids:
+                key = ("guarded-by-violation", (id(obj), name, site))
+                if key not in self._reported:
+                    self._reported.add(key)
+                    self.reports.append(RaceReport(
+                        "guarded-by-violation", subject, [site],
+                        f"{'write' if write else 'read'} without "
+                        f"holding `{guard_attr}` (annotated "
+                        f"`# guarded-by: {guard_attr}`)"))
+            return
+        if not tracked:
+            return
+        key = (id(obj), name)
+        with self._mu:
+            st = self._attrs.get(key)
+            if st is None:
+                # "obj" pins the instance so id(obj) stays unique
+                self._attrs[key] = {"lockset": set(held_ids),
+                                    "last": site, "reported": False,
+                                    "obj": obj}
+                return
+            st["lockset"] &= held_ids
+            empty = not st["lockset"] and not st["reported"]
+            prev = st["last"]
+            st["last"] = site
+            if empty:
+                st["reported"] = True
+        if empty:
+            self.reports.append(RaceReport(
+                "lockset-empty", subject, [prev, site],
+                f"no single lock protects every access — candidate "
+                f"lockset went empty at this "
+                f"{'write' if write else 'read'}"))
+
+
+# ----------------------------------------------------------------------
+# default wiring: instrument the real serving/obs classes with the
+# guarded-by annotations the static pass extracted
+# ----------------------------------------------------------------------
+def _dotted_module(rel: str) -> str:
+    p = Path(rel)
+    parts = list(p.parts)
+    if p.stem == "__init__":
+        parts = parts[:-1]
+    else:
+        parts[-1] = p.stem
+    return ".".join(parts)
+
+
+def install_default(checker: LocksetChecker) -> List[str]:
+    """Instrument every lock-owning class the static pass knows,
+    wiring its ``# guarded-by:`` annotations into dynamic checks.
+    Returns the instrumented class names."""
+    import importlib
+
+    from . import concurrency
+
+    an = concurrency.scan()
+    done: List[str] = []
+    for cname in sorted(an.classes):
+        rec = an.classes[cname]
+        if not (rec.has_locks() and rec.guarded):
+            continue
+        try:
+            mod = importlib.import_module(_dotted_module(rec.rel))
+        except ImportError:  # pragma: no cover - broken tree
+            continue
+        cls = getattr(mod, cname, None)
+        if cls is None:
+            continue
+        guarded = {attr: lk for attr, lk in sorted(rec.guarded.items())}
+        checker.instrument(cls, attrs=(), guarded=guarded)
+        done.append(cname)
+    return done

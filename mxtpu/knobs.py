@@ -148,6 +148,17 @@ register("MXTPU_GUARDS_CHURN_LIMIT", 10, "int",
          "Compiles tolerated per guarded jit entry before the "
          "recompile-churn guard fires (ModelRunner adds its bucket-"
          "ladder size).", "guards")
+register("MXTPU_RACE", False, "bool",
+         "Rerun the test suite under the mxrace lockset sanitizer "
+         "(mxtpu/analysis/lockset.py): threading.Lock/RLock are "
+         "traced and the serving/obs classes are instrumented per "
+         "their `# guarded-by:` annotations — empty candidate "
+         "locksets, guarded-by violations, and runtime lock-order "
+         "inversions fail the test with the access sites named.  "
+         "Test-time only (`MXTPU_RACE=1 pytest tests/`); unset = "
+         "zero overhead, the sanitizer is never imported.  The "
+         "static half lives in `python -m tools.mxrace`.", "guards")
+
 register("MXTPU_HLO_AUDIT", "", "str",
          "Static HLO audit (mxtpu.analysis) of every program "
          "TrainStep / serving ModelRunner compiles: `1` warn when "

@@ -89,13 +89,20 @@ class InferenceRequest:
         self.deadline = deadline
         self.trace_id = trace_id   # obs: minted at the submit edge
         self.t_dequeue: Optional[float] = None
+        # outcome fields are event-sequenced, not lock-shared: written
+        # under _wlock strictly before _event.set(), read by callers
+        # only after _event.wait() — the Event is the happens-before
+        # edge, so no single lock covers both sides by design.
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self.t_done: Optional[float] = None
         self.requeues = 0          # times this re-entered a queue
         self._event = threading.Event()
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self._value: Any = None
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self._error: Optional[BaseException] = None
         self._wlock = threading.Lock()
-        self._watchers: List[Callable[[], None]] = []
+        self._watchers: List[Callable[[], None]] = []  # guarded-by: _wlock
 
     # -- completion (batcher/server side) -------------------------------
     def _finish(self, value: Any, error: Optional[BaseException],
@@ -208,7 +215,7 @@ class DynamicBatcher:
         self._closed = False  # guarded-by: _cond
         self._on_timeout = on_timeout
         self._on_depth = on_depth
-        self.peak_depth = 0  # guarded-by: _cond
+        self._peak_depth = 0  # guarded-by: _cond
 
     # -- submit side ----------------------------------------------------
     def submit(self, payload: Any, *, group: Any = None,
@@ -243,10 +250,17 @@ class DynamicBatcher:
         with self._cond:
             return len(self._queue)
 
+    @property
+    def peak_depth(self) -> int:
+        """Locked snapshot — the raw attr races the submit path
+        (mxrace guarded-by-violation when read bare)."""
+        with self._cond:
+            return self._peak_depth
+
     def _note_depth_locked(self) -> None:
         d = len(self._queue)
-        if d > self.peak_depth:
-            self.peak_depth = d
+        if d > self._peak_depth:
+            self._peak_depth = d
         if self._on_depth is not None:
             self._on_depth(d)
 
@@ -258,11 +272,14 @@ class DynamicBatcher:
             return
         self._queue = [r for r in self._queue if r not in expired]
         self._note_depth_locked()
+        # stat BEFORE the event-set wakes any result() waiter: a
+        # caller observing its RequestTimeout must already find the
+        # timeout counted in stats() (mxrace-exposed ordering race)
+        if self._on_timeout is not None:
+            self._on_timeout(len(expired))
         for r in expired:
             r._fail(RequestTimeout(
                 "serving: deadline expired while queued"), now)
-        if self._on_timeout is not None:
-            self._on_timeout(len(expired))
 
     def _poll_locked(self, now: float) -> Optional[Batch]:
         self._expire_locked(now)
@@ -298,7 +315,8 @@ class DynamicBatcher:
         actually requeued."""
         now = self._clock() if now is None else now
         requeued: List[InferenceRequest] = []
-        timed_out = 0
+        expired: List[InferenceRequest] = []
+        lost: List[InferenceRequest] = []
         with self._cond:
             processed = set(map(id, requests))
             self._inflight = [r for r in self._inflight
@@ -307,28 +325,33 @@ class DynamicBatcher:
                 if r.done():
                     continue
                 if r.deadline is not None and now > r.deadline:
-                    r._fail(RequestTimeout(
-                        "serving: deadline expired before the failed "
-                        "batch could requeue"), now)
-                    timed_out += 1
+                    expired.append(r)
                 elif r.requeues >= 1 or self._closed:
-                    r._fail(WorkerLost(
-                        "serving: batch execution failed "
-                        + ("again after a requeue"
-                           if r.requeues else "and the batcher is "
-                           "closed")), now)
+                    lost.append(r)
                 else:
                     r.requeues += 1
                     r.t_dequeue = None
                     requeued.append(r)
+            # stat BEFORE the event-set wakes any result() waiter —
+            # same ordering contract as _expire_locked
+            if expired and self._on_timeout is not None:
+                self._on_timeout(len(expired))
+            for r in expired:
+                r._fail(RequestTimeout(
+                    "serving: deadline expired before the failed "
+                    "batch could requeue"), now)
+            for r in lost:
+                r._fail(WorkerLost(
+                    "serving: batch execution failed "
+                    + ("again after a requeue"
+                       if r.requeues else "and the batcher is "
+                       "closed")), now)
             if requeued:
                 # back to the FRONT: they were the oldest waiters and
                 # FIFO head priority is what bounds tail latency
                 self._queue[0:0] = requeued
                 self._note_depth_locked()
                 self._cond.notify_all()
-        if timed_out and self._on_timeout is not None:
-            self._on_timeout(timed_out)
         if requeued and profiler.is_active():
             for r in requeued:
                 if r.trace_id is not None:

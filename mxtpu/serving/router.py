@@ -91,10 +91,15 @@ class FleetRequest:
         self.hedges = 0           # hedge attempts dispatched
         self.tried: List[str] = []    # worker names, dispatch order
         self.last_error: Optional[BaseException] = None
+        # outcome fields are event-sequenced like InferenceRequest's:
+        # written under _wlock before _event.set(), read after wait().
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self.t_done: Optional[float] = None
         self.won_by_hedge = False
         self._event = threading.Event()
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self._value: Any = None
+        # mxrace: disable=unguarded-attr (event-sequenced via _event)
         self._error: Optional[BaseException] = None
         self._wlock = threading.Lock()
 
@@ -193,6 +198,10 @@ class FleetWorker:
         self._stuck = False  # guarded-by: _lock
         self._batch_seq = 0  # guarded-by: _lock
         self._stop = threading.Event()
+        # control-plane lifecycle, not data-plane state: start() runs
+        # once from add_worker before the thread exists; shutdown()
+        # is idempotent and joins.  The router serializes both.
+        # mxrace: disable=unguarded-attr (control-plane: start/shutdown serialized by the router)
         self._thread: Optional[threading.Thread] = None
         self._shut = False
 
@@ -471,7 +480,7 @@ class FleetRouter:
         # outside locks and dumps flight recorders when
         # MXTPU_OBS_DUMP_ON_ERROR asks for it
         self._dump_terminal = False  # guarded-by: _lock
-        self._closed = False
+        self._closed = False          # guarded-by: _lock
         self._stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
         if threaded:

@@ -144,10 +144,10 @@ class InferenceServer:
     """
 
     def __init__(self, log_every_s: float = 10.0):
-        self._endpoints: Dict[str, Dict[int, _Endpoint]] = {}
+        self._endpoints: Dict[str, Dict[int, _Endpoint]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._log_every_s = log_every_s
-        self._closed = False
+        self._closed = False          # guarded-by: _lock
 
     # -- registry ---------------------------------------------------------
     def register(self, name: str,

@@ -351,6 +351,57 @@ def test_obs_registry_suppression():
     assert found == []
 
 
+# -------------------------------------------------------- thread-hygiene
+
+def test_thread_hygiene_flags_sleep_polling_loop():
+    src = """
+        import time
+        import threading
+
+        def worker(stop):
+            while not stop.is_set():
+                time.sleep(0.1)
+
+        def fine(stop):
+            while not stop.is_set():
+                stop.wait(0.1)
+        time.sleep(1.0)   # outside a loop: startup delay, allowed
+    """
+    found = R.ThreadHygiene().check(
+        _ctx(src, rel="mxtpu/serving/fake.py"))
+    assert _names(found) == ["thread-hygiene"]
+    assert found[0].line == 7 and "time.sleep" in found[0].message
+
+
+def test_thread_hygiene_flags_non_daemon_thread():
+    src = """
+        import threading
+        t_bad = threading.Thread(target=print)
+        t_also_bad = threading.Thread(target=print, daemon=False)
+        t_ok = threading.Thread(target=print, daemon=True)
+    """
+    found = R.ThreadHygiene().check(
+        _ctx(src, rel="mxtpu/obs/fake.py"))
+    assert _names(found) == ["thread-hygiene"] * 2
+    assert {f.line for f in found} == {3, 4}
+
+
+def test_thread_hygiene_scoped_to_serving_and_obs():
+    src = """
+        import time
+        import threading
+        t = threading.Thread(target=print)
+        def spin():
+            while True:
+                time.sleep(1)
+    """
+    # outside serving/obs the rule does not apply at all
+    assert R.ThreadHygiene().applies(
+        _ctx(src, rel="mxtpu/parallel/fake.py")) is False
+    assert R.ThreadHygiene().applies(
+        _ctx(src, rel="mxtpu/serving/fake.py")) is True
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_fingerprint_survives_line_moves(tmp_path):

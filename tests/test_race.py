@@ -1,0 +1,524 @@
+"""mxrace — lock-order graphs + deterministic lockset race detection
+(ISSUE 9).
+
+Three layers under test:
+
+* the static extractor (``mxtpu/analysis/concurrency.py``): synthetic
+  sources in, lock-order edges / cycles / unguarded-attr findings out;
+* the committed contract (``contracts/lockorder.json``): byte
+  determinism, growth-only drift, and the repo-level empty-findings
+  gate;
+* the dynamic lockset sanitizer (``mxtpu/analysis/lockset.py``):
+  seeded races — a torn counter, a guarded-by violation, a lock-order
+  inversion — must each trip EXACTLY their own rule, and the real
+  sync-mode fleet scenarios must run clean under full instrumentation.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from mxtpu.analysis import concurrency as cc
+from mxtpu.analysis import lockset
+
+REPO = Path(__file__).resolve().parents[1]
+
+try:
+    import test_fleet as tf
+except ImportError:  # collected from repo root without tests/ on path
+    from tests import test_fleet as tf
+
+
+def _scan_src(tmp_path, src, rel="mxtpu/fake.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return cc.scan([rel], root=tmp_path)
+
+
+def _edges(g):
+    return sorted(f"{a} -> {b}" for (a, b) in g.edges)
+
+
+# ---------------------------------------------------------- extractor
+
+def test_nested_with_yields_edge(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def m(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    g = cc.build_graph(an)
+    assert _edges(g) == ["C._a -> C._b"]
+    assert g.locks["C._a"]["kind"] == "Lock"
+
+
+def test_interprocedural_self_call(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inner = threading.Lock()
+            def outer(self):
+                with self._lock:
+                    self.helper()
+            def helper(self):
+                with self._inner:
+                    pass
+    """)
+    g = cc.build_graph(an)
+    assert "C._lock -> C._inner" in _edges(g)
+
+
+def test_locked_suffix_seeds_primary_lock(tmp_path):
+    # `*_locked` methods are callee-side contracts: the caller holds
+    # the class's primary lock, so nesting inside them is an edge even
+    # with no visible call site.
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux = threading.Lock()
+            def drain_locked(self):
+                with self._aux:
+                    pass
+    """)
+    g = cc.build_graph(an)
+    assert "C._lock -> C._aux" in _edges(g)
+
+
+def test_typed_attr_call_resolves_across_classes(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class Child:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Parent:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.kid = Child()
+            def run(self):
+                with self._lock:
+                    self.kid.poke()
+    """)
+    g = cc.build_graph(an)
+    assert "Parent._lock -> Child._lock" in _edges(g)
+
+
+def test_module_lock_and_condition_kind(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        _LOCK = threading.Lock()
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+            def m(self):
+                with self._cond:
+                    with _LOCK:
+                        pass
+    """)
+    g = cc.build_graph(an)
+    assert "C._cond -> fake._LOCK" in _edges(g)
+    assert g.locks["C._cond"]["kind"] == "Condition"
+
+
+def test_cycle_reported(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    g = cc.build_graph(an)
+    fs = cc.cycle_findings(g)
+    assert [f.rule for f in fs] == ["lock-cycle"]
+    assert "C._a" in fs[0].message and "C._b" in fs[0].message
+
+
+def test_unguarded_attr_flagged_and_suppressed(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.bad = 0
+                self.good = 0   # guarded-by: _lock
+                # mxrace: disable=unguarded-attr (test waiver)
+                self.waived = 0
+            def w1(self):
+                self.bad += 1
+                with self._lock:
+                    self.good += 1
+                self.waived += 1
+            def w2(self):
+                self.bad = 2
+                with self._lock:
+                    self.good = 2
+                self.waived = 2
+    """)
+    fs = cc.unguarded_findings(an)
+    assert [f.rule for f in fs] == ["unguarded-attr"]
+    assert "bad" in fs[0].message
+    assert "good" not in fs[0].message \
+        and "waived" not in fs[0].message
+
+
+def test_sync_primitive_attrs_exempt(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = threading.Event()
+            def a(self):
+                self._stop.set()
+            def b(self):
+                self._stop.clear()
+    """)
+    assert cc.unguarded_findings(an) == []
+
+
+# ----------------------------------------------------- lockfile contract
+
+def test_lockfile_roundtrip_no_drift(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def m(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    g = cc.build_graph(an)
+    lf = tmp_path / "lockorder.json"
+    cc.save_lockfile(cc.lockfile_dict(g), lf)
+    findings, notices = cc.diff_lockfile(cc.load_lockfile(lf), g, lf)
+    assert findings == [] and notices == []
+
+
+def test_lockfile_new_edge_is_drift_finding(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def m(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    g = cc.build_graph(an)
+    d = cc.lockfile_dict(g)
+    d["edges"] = []                       # stored DAG predates the edge
+    lf = tmp_path / "lockorder.json"
+    cc.save_lockfile(d, lf)
+    findings, _ = cc.diff_lockfile(cc.load_lockfile(lf), g, lf)
+    assert [f.rule for f in findings] == ["lock-order-drift"]
+    assert "C._a -> C._b" in findings[0].message
+
+
+def test_lockfile_vanished_edge_is_notice_only(tmp_path):
+    an = _scan_src(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+            def m(self):
+                with self._a:
+                    pass
+    """)
+    g = cc.build_graph(an)
+    d = cc.lockfile_dict(g)
+    d["edges"] = ["C._a -> C._gone"]
+    lf = tmp_path / "lockorder.json"
+    cc.save_lockfile(d, lf)
+    findings, notices = cc.diff_lockfile(cc.load_lockfile(lf), g, lf)
+    assert findings == []
+    assert any("vanished" in n for n in notices)
+
+
+def test_lockfile_missing_is_finding(tmp_path):
+    an = _scan_src(tmp_path, "x = 1\n")
+    g = cc.build_graph(an)
+    findings, _ = cc.diff_lockfile(None, g, tmp_path / "none.json")
+    assert [f.rule for f in findings] == ["lock-order-drift"]
+    assert "missing" in findings[0].message
+
+
+def test_lockfile_bytes_deterministic(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    for p in (a, b):
+        g = cc.build_graph(cc.scan())
+        cc.save_lockfile(cc.lockfile_dict(g), p)
+    assert a.read_bytes() == b.read_bytes()
+    # ... and matches the committed contract (update → check fixpoint)
+    assert a.read_bytes() == (REPO / "contracts" /
+                              "lockorder.json").read_bytes()
+
+
+# --------------------------------------------------------- repo gate
+
+def test_repo_static_race_check_is_clean():
+    """The committed tree carries zero mxrace findings: annotations
+    complete, DAG cycle-free and pinned, README table fresh."""
+    findings, _notices, g = cc.run_check()
+    assert findings == [], [f"{f.rule} {f.path}:{f.line} {f.message}"
+                            for f in findings]
+    assert cc.find_cycles(g) == []
+    assert len(g.locks) >= 15 and len(g.edges) >= 10
+
+
+def test_cli_check_exit_zero_and_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.mxrace", "--check", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["new"] == []
+    assert len(payload["locks"]) >= 15
+    assert len(payload["edges"]) >= 10
+
+
+# ----------------------------------------------- dynamic: seeded races
+
+class _Torn:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self.count = 0
+
+    def bump_main(self):
+        with self._lock:
+            self.count += 1
+
+    def bump_aux(self):                   # seeded race: wrong lock
+        with self._aux:
+            self.count += 1
+
+
+class _Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def bare_read(self):                  # seeded race: no lock
+        return len(self.items)
+
+
+class _Inverted:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def ab(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def ba(self):                         # seeded race: inversion
+        with self.b:
+            with self.a:
+                pass
+
+
+@pytest.mark.mxrace_off
+def test_torn_counter_trips_only_lockset_empty():
+    c = lockset.LocksetChecker()
+    c.instrument(_Torn, attrs=("count",))
+    with c.activate():
+        t = _Torn()
+        t.bump_main()
+        t.bump_aux()
+    assert [r.rule for r in c.reports] == ["lockset-empty"]
+    r = c.reports[0]
+    assert r.subject == "_Torn.count"
+    assert len(r.sites) == 2            # BOTH access sites named
+    assert all("test_race.py" in s for s in r.sites)
+    assert r.sites[0] != r.sites[1]
+
+
+@pytest.mark.mxrace_off
+def test_guarded_by_violation_trips_only_its_rule():
+    c = lockset.LocksetChecker()
+    c.instrument(_Guarded, guarded={"items": "_lock"})
+    with c.activate():
+        g = _Guarded()
+        g.add(1)
+        g.bare_read()
+    assert [r.rule for r in c.reports] == ["guarded-by-violation"]
+    assert c.reports[0].subject == "_Guarded.items"
+    assert "_lock" in c.reports[0].message
+
+
+@pytest.mark.mxrace_off
+def test_lock_order_inversion_trips_only_lock_order():
+    c = lockset.LocksetChecker()
+    c.instrument(_Inverted)               # naming only
+    with c.activate():
+        i = _Inverted()
+        i.ab()
+        i.ba()
+    assert [r.rule for r in c.reports] == ["lock-order"]
+    r = c.reports[0]
+    assert "_Inverted.a" in r.subject and "_Inverted.b" in r.subject
+    assert len(r.sites) == 2            # inversion site + prior order
+
+
+@pytest.mark.mxrace_off
+def test_clean_class_reports_nothing():
+    class Clean:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+    c = lockset.LocksetChecker()
+    c.instrument(Clean, attrs=("n",), guarded={"n": "_lock"})
+    with c.activate():
+        obj = Clean()
+        for _ in range(5):
+            obj.bump()
+        # Condition/Event/Thread built on patched locks keep exact
+        # semantics (wait drops the lock, notify wakes)
+        ev = threading.Event()
+        th = threading.Thread(target=ev.set)
+        th.start()
+        th.join()
+        assert ev.wait(1.0)
+        cond = threading.Condition()
+        with cond:
+            cond.wait(timeout=0.01)
+            cond.notify_all()
+    assert c.reports == []
+    # restore is complete: factories and class dicts untouched
+    assert threading.Lock is lockset._REAL_LOCK
+    assert threading.RLock is lockset._REAL_RLOCK
+    assert "__getattribute__" not in Clean.__dict__
+
+
+@pytest.mark.mxrace_off
+def test_torn_counter_detected_across_real_threads():
+    c = lockset.LocksetChecker()
+    c.instrument(_Torn, attrs=("count",))
+    with c.activate():
+        t = _Torn()
+        ths = [threading.Thread(target=t.bump_main),
+               threading.Thread(target=t.bump_aux)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+    assert any(r.rule == "lockset-empty" for r in c.reports)
+
+
+# ------------------------------------- acceptance: removed-lock regression
+
+@pytest.mark.mxrace_off
+def test_removed_lock_detected_with_both_sites():
+    """Revert the PR 5 torn-read fix in spirit: read ``dispatched``
+    bare (as ``stats()`` did before ``dispatch_counts()``) and the
+    sanitizer must name BOTH access sites — the locked increment in
+    server.py and the bare read here."""
+    from mxtpu.serving.server import _Endpoint
+
+    class _StubRunner:
+        max_batch_size = 4
+        seq_buckets = None
+
+    c = lockset.LocksetChecker()
+    c.instrument(_Endpoint, attrs=("dispatched",))
+    with c.activate():
+        ep = _Endpoint("m", 1, [_StubRunner(), _StubRunner()],
+                       max_queue_delay_us=1000.0, max_queue=None,
+                       log_every_s=10.0)
+        ep._next_runner()                 # locked write (server.py)
+        dict(ep.dispatched)               # the reverted bare read
+        ep.batcher.close()
+    assert [r.rule for r in c.reports] == ["lockset-empty"]
+    r = c.reports[0]
+    assert r.subject == "_Endpoint.dispatched"
+    assert any("mxtpu/serving/server.py" in s for s in r.sites)
+    assert any("test_race.py" in s for s in r.sites)
+
+
+# ------------------------------ acceptance: fleet scenarios run clean
+
+@pytest.mark.mxrace_off
+def test_fleet_recovery_scenarios_clean_under_sanitizer():
+    """Kill / steal / drain / wedge sync-mode scenarios rerun under
+    full default instrumentation with zero reports — the MXTPU_RACE=1
+    acceptance bar, in-process."""
+    c = lockset.LocksetChecker()
+    names = lockset.install_default(c)
+    assert {"FleetRouter", "FleetWorker", "DynamicBatcher",
+            "InferenceServer", "_Endpoint",
+            "MetricsRegistry"} <= set(names)
+    with c.activate():
+        tf.test_fleet_happy_path_round_robin()
+        tf.test_fleet_crash_requeues_never_drops()
+        tf.test_fleet_queue_wedge_detected_by_liveness()
+        tf.test_fleet_slow_start_recovers_via_canary()
+    assert c.reports == [], [r.format() for r in c.reports]
+
+
+# ------------------------------------------------------- thread hygiene
+
+def test_thread_leak_gate_tolerates_joined_threads():
+    done = threading.Event()
+    th = threading.Thread(target=done.set)  # non-daemon, but joined
+    th.start()
+    th.join()
+    assert done.is_set()
+
+
+@pytest.mark.thread_leak_ok
+def test_thread_leak_marker_registered(request):
+    assert request.node.get_closest_marker("thread_leak_ok")

@@ -7,7 +7,9 @@ contracts.
 * ``python -m tools.hlocheck --check`` (lowered programs vs the
   committed ``contracts/`` lockfiles), then
 * ``python -m mxtpu.obs --self-check`` (the observability layer's
-  zero-overhead-when-off + exposition round-trip contract),
+  zero-overhead-when-off + exposition round-trip contract), then
+* ``python -m tools.mxrace --check`` (lock-order graph vs the
+  committed ``contracts/lockorder.json`` + guarded-by hygiene),
 
 prints one PASS/FAIL line per stage, and exits non-zero if any
 failed — the single entry point a CI job or pre-push hook needs.
@@ -27,6 +29,7 @@ STAGES = (
     ("mxlint", ("-m", "tools.mxlint", "--check"), True),
     ("hlocheck", ("-m", "tools.hlocheck", "--check"), True),
     ("obs-self-check", ("-m", "mxtpu.obs", "--self-check"), False),
+    ("mxrace", ("-m", "tools.mxrace", "--check"), True),
 )
 
 

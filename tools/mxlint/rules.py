@@ -613,6 +613,59 @@ class ObsRegistry(Rule):
         return out
 
 
+class ThreadHygiene(Rule):
+    """Threading discipline for the serving/obs stack (mxrace
+    satellite): no bare ``time.sleep()`` polling loops — waiters must
+    be interruptible (``Event.wait(timeout)`` / ``Condition.wait``) or
+    clock-injected so shutdown and sync-mode tests don't block on wall
+    time — and every ``threading.Thread`` is ``daemon=True`` (shutdown
+    is join-with-timeout + daemon fallback; a non-daemon worker the
+    close path misses wedges interpreter exit, which is exactly what
+    the conftest thread-leak gate fails tests for)."""
+
+    name = "thread-hygiene"
+    _SCOPE = ("mxtpu/serving/", "mxtpu/obs/")
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return ctx.rel.startswith(self._SCOPE)
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        sleeps: Dict[tuple, ast.Call] = {}
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            for sub in ast.walk(loop):
+                if isinstance(sub, ast.Call) and \
+                        dotted_name(sub.func) == "time.sleep":
+                    sleeps[(sub.lineno, sub.col_offset)] = sub
+        for key in sorted(sleeps):
+            out.append(Finding(
+                self.name, ctx.rel, sleeps[key].lineno,
+                "bare time.sleep() in a loop — wait on an "
+                "Event/Condition with a timeout (or the injected "
+                "clock) so shutdown and sync-mode tests can "
+                "interrupt it"))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or \
+                    not (d == "Thread" or d.endswith("threading.Thread")):
+                continue
+            daemon = next((kw.value for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is True):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "threading.Thread without daemon=True in "
+                    "serving/obs — a worker the close path misses "
+                    "must not wedge interpreter exit; set "
+                    "daemon=True and join with a timeout"))
+        return out
+
+
 # ----------------------------------------------------------------------
 # repo-level checks
 # ----------------------------------------------------------------------
@@ -670,7 +723,7 @@ def file_rules() -> List[Rule]:
     return [RetraceImpureCall(), RetraceTracedBranch(),
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
-            HloRawAssert(), ObsRegistry()]
+            HloRawAssert(), ObsRegistry(), ThreadHygiene()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:

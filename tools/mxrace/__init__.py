@@ -1,0 +1,15 @@
+"""mxrace — lock-order graphs + lockset race detection (ISSUE 9).
+
+The third static-analysis tier next to tools/mxlint (AST source
+rules) and tools/hlocheck (compiled-program contracts):
+
+* Pass 1 (static, this CLI): ``mxtpu/analysis/concurrency.py``
+  extracts the lock-order DAG of the threaded serving/obs stack and
+  pins it in ``contracts/lockorder.json``; cycles and unannotated
+  shared mutable attrs are findings.
+* Pass 2 (dynamic): ``mxtpu/analysis/lockset.py`` is an Eraser-style
+  lockset sanitizer the test suite activates with ``MXTPU_RACE=1``.
+
+CLI mirrors mxlint: ``python -m tools.mxrace [--check|--update|
+--json|--fix-readme]``, exit 0/1/2.
+"""

@@ -1,0 +1,155 @@
+"""mxrace CLI (static Pass 1).
+
+Exit codes (same contract as tools/mxlint, pinned by
+tests/test_race.py):
+
+* 0 — no findings outside the committed baseline;
+* 1 — new findings (lock-order cycle, growth drift vs
+  ``contracts/lockorder.json``, unguarded shared attr, stale README
+  table);
+* 2 — usage / internal error.
+
+The dynamic lockset sanitizer (Pass 2) is not run here — it rides
+the test suite under ``MXTPU_RACE=1``; see mxtpu/analysis/lockset.py.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _load_concurrency():
+    """Load the analyzer by file path — importing it as
+    ``mxtpu.analysis.concurrency`` would execute ``mxtpu/__init__``
+    (and therefore jax); a lint tool must not pay a framework import
+    and must survive a broken tree."""
+    path = REPO_ROOT / "mxtpu" / "analysis" / "concurrency.py"
+    spec = importlib.util.spec_from_file_location(
+        "_mxrace_concurrency", path)
+    mod = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    conc = _load_concurrency()
+    core = conc.lintcore
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxrace",
+        description="Lock-order graph + shared-state hygiene for the "
+                    "threaded serving/obs stack (static Pass 1 of "
+                    "mxrace).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to scan (default: "
+                         f"{' '.join(conc.SCOPES)})")
+    ap.add_argument("--check", action="store_true",
+                    help="counts only; exit 1 on new findings "
+                         "(CI mode)")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite contracts/lockorder.json from the "
+                         "current tree and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings + graph as JSON")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="regenerate the README lock-order table and "
+                         "exit")
+    ap.add_argument("--lockfile", type=Path,
+                    default=conc.DEFAULT_LOCKFILE,
+                    help="lock-order contract JSON")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help="accepted-findings baseline JSON")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the "
+                         "baseline and exit 0")
+    args = ap.parse_args(argv)
+    paths = tuple(args.paths) or conc.SCOPES
+
+    t0 = time.perf_counter()
+    try:
+        if args.update:
+            an = conc.scan(paths)
+            if an.parse_errors:
+                for f in an.parse_errors:
+                    print("  " + f.format(), file=sys.stderr)
+                return 2
+            g = conc.build_graph(an)
+            cyc = conc.cycle_findings(g)
+            if cyc:  # never pin a cyclic graph as the contract
+                for f in cyc:
+                    print("  " + f.format())
+                print(f"mxrace: refusing --update: "
+                      f"{len(cyc)} lock-order cycle(s)")
+                return 1
+            conc.save_lockfile(conc.lockfile_dict(g), args.lockfile)
+            print(f"mxrace: wrote {args.lockfile} "
+                  f"({len(g.locks)} locks, {len(g.edges)} edges, "
+                  f"{time.perf_counter() - t0:.2f}s)")
+            return 0
+
+        if args.fix_readme:
+            an = conc.scan(paths)
+            g = conc.build_graph(an)
+            changed = conc.fix_readme(REPO_ROOT, g)
+            print("README.md lock-order table "
+                  + ("rewritten" if changed else "already current"))
+            return 0
+
+        findings, notices, g = conc.run_check(
+            paths, lockfile=args.lockfile)
+    except (SyntaxError, OSError, ValueError) as e:
+        print(f"mxrace: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        core.write_baseline(findings, args.baseline)
+        print(f"wrote {len({f.fingerprint for f in findings})} "
+              f"fingerprints to {args.baseline}")
+        return 0
+
+    try:
+        baseline = core.load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"mxrace: cannot read baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    new, old = core.split_by_baseline(findings, baseline)
+    dt = time.perf_counter() - t0
+
+    if args.as_json:
+        print(json.dumps(
+            {"new": [f.as_json() for f in new],
+             "baselined": [f.as_json() for f in old],
+             "notices": notices,
+             "locks": {n: i["kind"]
+                       for n, i in sorted(g.locks.items())},
+             "edges": sorted(f"{a} -> {b}" for (a, b) in g.edges),
+             "seconds": round(dt, 3)}, indent=1))
+    elif args.check:
+        print(f"mxrace: {len(new)} new, {len(old)} baselined, "
+              f"{len(g.locks)} locks, {len(g.edges)} edges "
+              f"({dt:.2f}s)")
+        for f in new:
+            print("  " + f.format())
+    else:
+        for f in new:
+            print(f.format())
+        for n in notices:
+            print(f"note: {n}")
+        if old:
+            print(f"({len(old)} baselined finding(s) suppressed; "
+                  f"see {args.baseline.name})")
+        print(f"mxrace: {len(new)} new finding(s), {len(g.locks)} "
+              f"locks, {len(g.edges)} edges in {dt:.2f}s")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
