@@ -1002,8 +1002,22 @@ def main():
                      f"contracts/lockorder.json; inspect `python -m "
                      f"tools.mxrace` and either fix the drift or "
                      f"regenerate with --update before benching")
+        # and for the precision ledgers: AMP-relevant numerics that
+        # drifted from contracts/prec/ mean the dtype story being
+        # benched (accumulation widths, cast placement) is not the
+        # one that was reviewed.
+        rc = subprocess.call(
+            [sys.executable, "-m", "tools.mxprec", "--check"],
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if rc != 0:
+            sys.exit(f"bench: --contracts gate failed (mxprec "
+                     f"rc={rc}) — the dtype flow drifted from "
+                     f"contracts/prec/; inspect `python -m "
+                     f"tools.mxprec` and either fix the drift or "
+                     f"regenerate with --update before benching")
         print("bench: --contracts gate passed (programs match "
-              "contracts/, lock graph matches lockorder.json)")
+              "contracts/, lock graph matches lockorder.json, "
+              "dtype flow matches contracts/prec/)")
     if "--preflight" in sys.argv[1:]:
         # Answer "will the selected sweep fit the wall budget?" without
         # touching the TPU.  Non-zero exit = the sweep as configured

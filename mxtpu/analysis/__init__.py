@@ -31,6 +31,10 @@ from .hlo import HloProgram, parse_hlo
 from .summary import (BRACKET_OPS, COLLECTIVE_OPS, HOST_TRANSFER_OPS,
                       audit_findings, bracket_evidence,
                       format_evidence_table, summarize)
+from . import dtypeflow
+from .dtypeflow import (cast_flows, dtype_summary, format_hazard,
+                        hazard_findings, master_weight_findings,
+                        program_ledger)
 from .contracts import (CONTRACTS_DIR, DEFAULT_TOLERANCES, Violation,
                         check_contract, contract_path, load_contract,
                         make_contract, save_contract)
@@ -43,7 +47,10 @@ __all__ = [
     "DEFAULT_TOLERANCES", "COLLECTIVE_OPS", "BRACKET_OPS",
     "HOST_TRANSFER_OPS", "mem_stats", "compiled_artifact",
     "compiled_summary", "compiled_evidence", "maybe_audit",
-    "audit_mode",
+    "audit_mode", "dtypeflow", "dtype_summary", "cast_flows",
+    "hazard_findings", "format_hazard", "master_weight_findings",
+    "program_ledger", "lowered_text", "lowered_summary",
+    "prec_audit_mode",
 ]
 
 
@@ -67,6 +74,40 @@ def compiled_artifact(fn, *args, **jit_kwargs
     return compiled.as_text(), mem_stats(compiled)
 
 
+def lowered_text(fn, *args, **jit_kwargs) -> str:
+    """PRE-optimization HLO text of ``fn`` lowered (not compiled),
+    with per-instruction ``metadata={op_name= source_file=
+    source_line=}`` — mxprec's substrate.  The pre-opt dump keeps the
+    program as written (a bf16 ``dot`` without
+    ``preferred_element_type`` is still a bf16 dot, not the f32 op +
+    round-trip converts backend float normalization rewrites it
+    into), which is the level an AMP policy must reason at."""
+    import jax
+    from jax._src.lib import xla_extension as xe
+    lowered = jax.jit(fn, **jit_kwargs).lower(*args)
+    asm = lowered.compiler_ir().operation.get_asm(
+        enable_debug_info=True)
+    try:
+        comp = xe.mlir.mlir_module_to_xla_computation(
+            asm, use_tuple_args=False, return_tuple=False)
+        opts = xe.HloPrintOptions()
+        opts.print_metadata = True
+        return comp.get_hlo_module().to_string(opts)
+    except AttributeError as e:  # jaxlib drift: report, don't crash
+        from mxtpu.base import MXNetError
+        raise MXNetError(
+            f"pre-optimization HLO conversion unavailable on this "
+            f"jaxlib ({e}) — mxprec needs "
+            f"xla_extension.mlir.mlir_module_to_xla_computation")
+
+
+def lowered_summary(fn, *args, **jit_kwargs) -> Dict:
+    """``program_ledger`` of the PRE-optimization lowering of ``fn``
+    — the sanctioned route for tests that need dtype-flow facts about
+    a program as written."""
+    return program_ledger(lowered_text(fn, *args, **jit_kwargs))
+
+
 def compiled_summary(fn, *args, **jit_kwargs) -> Dict:
     """Contract-shaped summary of ``fn`` compiled on the current
     backend."""
@@ -84,32 +125,61 @@ def compiled_evidence(fn, *args, **jit_kwargs) -> List[Dict[str, str]]:
 # ----------------------------------------------------------------------
 # runtime audit (MXTPU_HLO_AUDIT)
 # ----------------------------------------------------------------------
-def audit_mode() -> int:
-    """0 off (default), 1 warn, 2 raise."""
+def _knob_mode(name: str) -> int:
     from mxtpu import knobs
-    v = str(knobs.get("MXTPU_HLO_AUDIT")).strip().lower()
+    v = str(knobs.get(name)).strip().lower()
     if v in ("", "0", "false", "off"):
         return 0
     return 2 if v == "2" else 1
 
 
+def audit_mode() -> int:
+    """0 off (default), 1 warn, 2 raise."""
+    return _knob_mode("MXTPU_HLO_AUDIT")
+
+
+def prec_audit_mode() -> int:
+    """``MXTPU_PREC_AUDIT``: 0 off (default), 1 warn, 2 raise."""
+    return _knob_mode("MXTPU_PREC_AUDIT")
+
+
 def maybe_audit(compiled, label: str = "",
                 mem: Optional[Dict[str, int]] = None
                 ) -> Optional[Dict]:
-    """Audit one freshly compiled program if ``MXTPU_HLO_AUDIT`` asks
-    for it; returns the summary (or None when the audit is off).
-    Called at compile sites only — compiles are rare and expensive,
-    so reading the knob here keeps the off path at zero overhead."""
+    """Audit one freshly compiled program if ``MXTPU_HLO_AUDIT`` /
+    ``MXTPU_PREC_AUDIT`` ask for it; returns the summary (or None when
+    both audits are off).  Called at compile sites only — compiles are
+    rare and expensive, so reading the knobs here keeps the off path
+    at zero overhead.
+
+    The precision audit classifies dtypeflow hazards over the same
+    compiled text; post-optimization dumps lack source metadata and
+    normalize some sub-f32 math, so it catches the surviving forms
+    (f64 creep, narrowing-accumulator reduce regions, sub-f32 dots) —
+    the full pre-opt analysis lives in ``python -m tools.mxprec``."""
     mode = audit_mode()
-    if not mode:
+    pmode = prec_audit_mode()
+    if not mode and not pmode:
         return None
-    summ = summarize(compiled.as_text(),
+    program = parse_hlo(compiled.as_text())
+    summ = summarize(program,
                      mem if mem is not None else mem_stats(compiled))
-    findings = audit_findings(summ, label)
-    if findings:
-        msg = "HLO audit: " + "; ".join(findings)
-        if mode >= 2:
-            from mxtpu.base import MXNetError
-            raise MXNetError(msg + " (MXTPU_HLO_AUDIT=2)")
-        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+    if mode:
+        findings = audit_findings(summ, label)
+        if findings:
+            msg = "HLO audit: " + "; ".join(findings)
+            if mode >= 2:
+                from mxtpu.base import MXNetError
+                raise MXNetError(msg + " (MXTPU_HLO_AUDIT=2)")
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+    if pmode:
+        where = f" in {label}" if label else ""
+        hazards = hazard_findings(program)
+        if hazards:
+            msg = (f"precision audit{where}: "
+                   + "; ".join(format_hazard(h) for h in hazards))
+            if pmode >= 2:
+                from mxtpu.base import MXNetError
+                raise MXNetError(msg + " (MXTPU_PREC_AUDIT=2)")
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
     return summ

@@ -1,0 +1,373 @@
+"""Interprocedural dtype-flow analysis over lowered HLO (ISSUE 10).
+
+THE one dtype analyzer in the tree: ``dtype_summary()`` is the
+dtype-policy family hlocheck's ``summarize()`` delegates to, and the
+rest of the module is mxprec's substrate — every convert is tracked to
+its producing op and source site (``cast_flows``), and precision
+hazards are classified per instruction (``hazard_findings``):
+
+* ``bf16-accum-reduction`` — a reduce whose accumulator is a sub-f32
+  float (direct, pre-optimization form) or whose region round-trips
+  the accumulator through a narrowing float convert (the shape CPU
+  FloatNormalization leaves behind), i.e. softmax/logsumexp/norm sums
+  without fp32 accumulation;
+* ``matmul-preferred-type`` — a dot/convolution whose operands AND
+  result are sub-f32 floats: the ``preferred_element_type=f32`` the
+  MXU recipe requires was dropped;
+* ``f64-creep`` — any instruction carrying f64, named per site (the
+  coarse count lives in ``dtype_summary``; this is the ledger's
+  per-site form);
+* ``master-weight`` — not an HLO rule: ``master_weight_findings``
+  eval_shapes the optimizer's functional rule per parameter and flags
+  any sub-f32 param whose update chain carries no f32 master copy.
+
+Source sites come from HLO ``metadata={... source_file= source_line=}``
+(present in the pre-optimization dump ``analysis.lowered_text``
+produces); paths are normalized repo-relative so committed ledgers
+under ``contracts/prec/`` are byte-deterministic across machines.
+
+Pure stdlib except ``master_weight_findings`` (imports jax lazily) —
+parsing saved dumps must not pay a framework import.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple, Union
+
+from .hlo import (_FLOAT_WIDTH, Computation, HloProgram, Instruction,
+                  parse_hlo)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# ledger site lists are capped (sorted, then "+N more") so a fusion
+# explosion can't turn a lockfile into a megabyte diff
+MAX_SITES = 3
+
+_F32_WIDTH = _FLOAT_WIDTH["f32"]
+
+_MD_OP_RE = re.compile(r'op_name="([^"]*)"')
+_MD_FILE_RE = re.compile(r'source_file="([^"]*)"')
+_MD_LINE_RE = re.compile(r"source_line=(\d+)")
+
+# reduce regions whose root is one of these accumulate (sum / product);
+# min/max/and/or regions are order-insensitive and dtype-safe
+_ACCUM_ROOTS = ("add", "multiply")
+
+_MATMUL_OPS = ("dot", "convolution")
+_REDUCE_OPS = ("reduce", "reduce-window")
+
+
+def _norm_path(path: str) -> str:
+    """Deterministic source path: repo-relative when inside the repo,
+    trimmed after site/dist-packages for library frames, basename
+    otherwise — ledgers must not embed a machine's directory layout."""
+    for marker in ("site-packages/", "dist-packages/"):
+        if marker in path:
+            return path.split(marker)[-1]
+    root = str(REPO_ROOT)
+    if path.startswith(root):
+        return path[len(root):].lstrip("/")
+    return path.rsplit("/", 1)[-1]
+
+
+def instr_site(instr: Instruction) -> Tuple[str, str]:
+    """(jax op_name, "file:line") from the instruction's metadata;
+    empty strings when the dump carries none (post-optimization text
+    usually doesn't)."""
+    attrs = instr.attrs
+    om = _MD_OP_RE.search(attrs)
+    fm = _MD_FILE_RE.search(attrs)
+    lm = _MD_LINE_RE.search(attrs)
+    op_name = om.group(1) if om else ""
+    site = f"{_norm_path(fm.group(1))}:{lm.group(1)}" \
+        if fm and lm else ""
+    return op_name, site
+
+
+def _short_op_name(op_name: str) -> str:
+    return op_name.rsplit("/", 1)[-1] if op_name else ""
+
+
+def _is_sub_f32(dt: str) -> bool:
+    return dt in _FLOAT_WIDTH and _FLOAT_WIDTH[dt] < _F32_WIDTH
+
+
+def _result_dtype(instr: Instruction) -> str:
+    return instr.shapes[0][0] if instr.shapes else "?"
+
+
+# ----------------------------------------------------------------------
+# the dtype-policy family (hlocheck's summarize() delegates here)
+# ----------------------------------------------------------------------
+def is_upcast(pair: str) -> bool:
+    """True for a widening float->float convert pair like
+    ``bf16->f32``."""
+    src, _, dst = pair.partition("->")
+    return (src in _FLOAT_WIDTH and dst in _FLOAT_WIDTH and
+            _FLOAT_WIDTH[dst] > _FLOAT_WIDTH[src])
+
+
+def _convert_pair(comp: Computation, instr: Instruction) -> str:
+    src = comp.by_name.get(instr.operands[0])
+    src_dt = src.shapes[0][0] if src and src.shapes else "?"
+    return f"{src_dt}->{_result_dtype(instr)}"
+
+
+def dtype_summary(program: Union[str, HloProgram]) -> Dict:
+    """The ``dtype`` block of a contract summary — f64 op count plus
+    every convert pair (upcasts broken out).  Byte-compatible with the
+    sections committed in ``contracts/*.json``."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    converts: Dict[str, int] = {}
+    f64_ops = 0
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if any(dt == "f64" for dt in instr.dtypes()):
+                f64_ops += 1
+            if instr.opcode == "convert" and instr.operands:
+                pair = _convert_pair(comp, instr)
+                converts[pair] = converts.get(pair, 0) + 1
+    upcasts = {p: n for p, n in converts.items() if is_upcast(p)}
+    return {"f64_ops": f64_ops,
+            "upcasts": {k: upcasts[k] for k in sorted(upcasts)},
+            "converts": {k: converts[k] for k in sorted(converts)}}
+
+
+# ----------------------------------------------------------------------
+# cast provenance (the ledger's `flows` section)
+# ----------------------------------------------------------------------
+def _cap_sites(sites) -> List[str]:
+    ordered = sorted(sites)
+    if len(ordered) > MAX_SITES:
+        extra = len(ordered) - MAX_SITES
+        ordered = ordered[:MAX_SITES] + [f"+{extra} more"]
+    return ordered
+
+
+def cast_flows(program: Union[str, HloProgram]) -> Dict[str, Dict]:
+    """Every convert tracked to its producing op and source site:
+    ``{"src->dst": {"count": n, "sites": [...]}}``.  A site reads
+    ``<producer-opcode> @ <file>:<line>`` (the convert's own metadata;
+    bare producer opcode when the dump has none)."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    flows: Dict[str, Dict] = {}
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode != "convert" or not instr.operands:
+                continue
+            pair = _convert_pair(comp, instr)
+            src = comp.by_name.get(instr.operands[0])
+            producer = src.opcode if src else "?"
+            _, site = instr_site(instr)
+            desc = f"{producer} @ {site}" if site else producer
+            slot = flows.setdefault(pair, {"count": 0, "sites": set()})
+            slot["count"] += 1
+            slot["sites"].add(desc)
+    return {pair: {"count": flows[pair]["count"],
+                   "sites": _cap_sites(flows[pair]["sites"])}
+            for pair in sorted(flows)}
+
+
+def float_opcode_counts(program: Union[str, HloProgram]
+                        ) -> Dict[str, int]:
+    """Float-carrying instructions per opcode — the observation base
+    mxprec's ``contracts/amp_policy.json`` classifies (every opcode in
+    the policy was actually seen in a lowered target program)."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    out: Dict[str, int] = {}
+    for instr in program.all_instructions():
+        if any(dt in _FLOAT_WIDTH for dt in instr.dtypes()):
+            out[instr.opcode] = out.get(instr.opcode, 0) + 1
+    return {k: out[k] for k in sorted(out)}
+
+
+def float_op_counts(program: Union[str, HloProgram]) -> Dict[str, int]:
+    """Instructions carrying each float dtype (an instruction counts
+    once per distinct float dtype in its result shapes)."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    out: Dict[str, int] = {}
+    for instr in program.all_instructions():
+        for dt in sorted(set(instr.dtypes())):
+            if dt in _FLOAT_WIDTH:
+                out[dt] = out.get(dt, 0) + 1
+    return {k: out[k] for k in sorted(out)}
+
+
+# ----------------------------------------------------------------------
+# hazard rules
+# ----------------------------------------------------------------------
+def _hazard(rule: str, instr: Instruction, detail: str) -> Dict:
+    op_name, site = instr_site(instr)
+    short = _short_op_name(op_name)
+    return {"rule": rule, "op": instr.opcode,
+            "site": site or short or "?",
+            "detail": detail + (f" [{short}]" if short else "")}
+
+
+def _region_comps(program: HloProgram,
+                  instr: Instruction) -> List[Computation]:
+    return [program.computations[c] for c in instr.calls
+            if c in program.computations]
+
+
+def _region_root_opcode(comp: Computation) -> str:
+    for instr in comp.instructions:
+        if instr.root:
+            return instr.opcode
+    return comp.instructions[-1].opcode if comp.instructions else "?"
+
+
+def _region_narrowing_convert(comp: Computation) -> Optional[str]:
+    """The ``f32->bf16``-style pair of a narrowing float convert
+    inside a reduce region — the accumulator round-trip shape CPU
+    FloatNormalization rewrites a sub-f32 reduce into."""
+    for instr in comp.instructions:
+        if instr.opcode != "convert" or not instr.operands:
+            continue
+        dst = _result_dtype(instr)
+        src_i = comp.by_name.get(instr.operands[0])
+        src = src_i.shapes[0][0] if src_i and src_i.shapes else "?"
+        if (src in _FLOAT_WIDTH and dst in _FLOAT_WIDTH and
+                _FLOAT_WIDTH[dst] < _FLOAT_WIDTH[src]):
+            return f"{src}->{dst}"
+    return None
+
+
+def _reduction_hazards(program: HloProgram) -> List[Dict]:
+    out = []
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode not in _REDUCE_OPS:
+                continue
+            regions = _region_comps(program, instr)
+            accum = [r for r in regions
+                     if _region_root_opcode(r) in _ACCUM_ROOTS]
+            if not accum:
+                continue
+            res = next((dt for dt in instr.dtypes()
+                        if dt in _FLOAT_WIDTH), None)
+            if res is not None and _is_sub_f32(res):
+                out.append(_hazard(
+                    "bf16-accum-reduction", instr,
+                    f"accumulating {instr.opcode} carries a {res} "
+                    f"accumulator — sum in f32 and downcast once"))
+                continue
+            for r in accum:
+                pair = _region_narrowing_convert(r)
+                if pair:
+                    out.append(_hazard(
+                        "bf16-accum-reduction", instr,
+                        f"accumulating {instr.opcode} round-trips "
+                        f"its accumulator through {pair} every step "
+                        f"— sum in f32 and downcast once"))
+                    break
+    return out
+
+
+def _matmul_hazards(program: HloProgram) -> List[Dict]:
+    out = []
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode not in _MATMUL_OPS:
+                continue
+            res = _result_dtype(instr)
+            if not _is_sub_f32(res):
+                continue
+            op_dts = []
+            for name in instr.operands:
+                src = comp.by_name.get(name)
+                if src and src.shapes:
+                    op_dts.append(src.shapes[0][0])
+            floats = [dt for dt in op_dts if dt in _FLOAT_WIDTH]
+            if floats and all(_is_sub_f32(dt) for dt in floats):
+                out.append(_hazard(
+                    "matmul-preferred-type", instr,
+                    f"{instr.opcode} accumulates "
+                    f"{'x'.join(floats)} into {res} — pass "
+                    f"preferred_element_type=float32"))
+    return out
+
+
+def _f64_hazards(program: HloProgram) -> List[Dict]:
+    out = []
+    for instr in program.all_instructions():
+        if any(dt == "f64" for dt in instr.dtypes()):
+            out.append(_hazard(
+                "f64-creep", instr,
+                f"{instr.opcode} carries f64 — silent f32->f64 "
+                f"promotion (np scalar leak or jax_enable_x64)"))
+    return out
+
+
+def hazard_findings(program: Union[str, HloProgram]) -> List[Dict]:
+    """All HLO-level precision hazards of one program, sorted for
+    byte-deterministic ledgers."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    out = (_reduction_hazards(program) + _matmul_hazards(program)
+           + _f64_hazards(program))
+    return sorted(out, key=lambda h: (h["rule"], h["op"], h["site"],
+                                      h["detail"]))
+
+
+def format_hazard(h: Dict) -> str:
+    return f"[{h['rule']}] {h['op']} at {h['site']}: {h['detail']}"
+
+
+# ----------------------------------------------------------------------
+# the per-program ledger entry
+# ----------------------------------------------------------------------
+def program_ledger(program: Union[str, HloProgram]) -> Dict:
+    """One program's ``contracts/prec/`` entry: cast provenance,
+    float-op census, hazards.  Deterministic across lowerings of the
+    same program."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    return {"flows": cast_flows(program),
+            "float_ops": float_op_counts(program),
+            "hazards": hazard_findings(program)}
+
+
+# ----------------------------------------------------------------------
+# master weights (the optimizer's multi-precision contract)
+# ----------------------------------------------------------------------
+def master_weight_findings(optimizer, param_sigs) -> List[Dict]:
+    """Flag every sub-f32 float parameter whose optimizer update chain
+    carries no f32 master copy of the weight.  ``param_sigs`` is
+    ``[(name, shape, dtype_str), ...]``; the check eval_shapes the
+    functional rule (the one the compiled TrainStep uses), so it sees
+    exactly the state the batched/ZeRO buckets will carry — no device
+    work."""
+    import jax
+    import jax.numpy as jnp
+    from ..optimizer.functional import opt_rule
+    init, _ = opt_rule(optimizer)
+    out = []
+    for name, shape, dtype in param_sigs:
+        # NOT dt.kind — numpy classes bfloat16 (ml_dtypes) as 'V';
+        # jnp.issubdtype knows the extension float types
+        dt = jnp.dtype(dtype)
+        if not jnp.issubdtype(dt, jnp.floating) or dt.itemsize >= 4:
+            continue
+        leaves = jax.tree_util.tree_leaves(jax.eval_shape(
+            lambda s=tuple(shape), d=dt: init(jnp.zeros(s, d))))
+        has_master = any(
+            tuple(leaf.shape) == tuple(shape) and
+            jnp.issubdtype(jnp.dtype(leaf.dtype), jnp.floating) and
+            jnp.dtype(leaf.dtype).itemsize >= 4
+            for leaf in leaves)
+        if not has_master:
+            out.append({
+                "rule": "master-weight",
+                "op": type(optimizer).__name__.lower(),
+                "site": name,
+                "detail": f"{dtype} param updates with no f32 master "
+                          f"weight in the optimizer state "
+                          f"(multi_precision="
+                          f"{optimizer.multi_precision!r})"})
+    return sorted(out, key=lambda h: (h["op"], h["site"]))

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Union
 
+from .dtypeflow import dtype_summary, is_upcast as _is_upcast
 from .hlo import (DTYPE_BYTES, _FLOAT_WIDTH, Computation, HloProgram,
                   Instruction, parse_hlo, shape_elems)
 
@@ -135,16 +136,12 @@ def summarize(program: Union[str, HloProgram],
 
     collectives: Dict[str, Dict[str, int]] = {}
     custom_calls: Dict[str, Dict[str, int]] = {}
-    converts: Dict[str, int] = {}
-    f64_ops = 0
     host_ops: Dict[str, int] = {}
     fusion_count = 0
 
     for comp in program.computations.values():
         for instr in comp.instructions:
             op = instr.opcode
-            if any(dt == "f64" for dt in instr.dtypes()):
-                f64_ops += 1
             if op.endswith("-done"):
                 base = op[:-5]
                 if base in COLLECTIVE_OPS:
@@ -169,29 +166,19 @@ def summarize(program: Union[str, HloProgram],
                     host_ops[tgt] = host_ops.get(tgt, 0) + 1
             elif op in HOST_TRANSFER_OPS:
                 host_ops[op] = host_ops.get(op, 0) + 1
-            elif op == "convert" and instr.operands:
-                src = comp.by_name.get(instr.operands[0])
-                src_dt = src.shapes[0][0] if src and src.shapes \
-                    else "?"
-                dst_dt = instr.shapes[0][0] if instr.shapes else "?"
-                converts[f"{src_dt}->{dst_dt}"] = converts.get(
-                    f"{src_dt}->{dst_dt}", 0) + 1
 
     for row in bracket_evidence(program):
         slot = custom_calls.get(row["target"])
         if slot is not None:
             slot["bracketed"] += 1
 
-    upcasts = {pair: n for pair, n in converts.items()
-               if _is_upcast(pair)}
     out = {
         "collectives": {k: collectives[k] for k in sorted(collectives)},
         "custom_calls": {k: custom_calls[k]
                          for k in sorted(custom_calls)},
-        "dtype": {"f64_ops": f64_ops,
-                  "upcasts": {k: upcasts[k] for k in sorted(upcasts)},
-                  "converts": {k: converts[k]
-                               for k in sorted(converts)}},
+        # the dtype family is owned by dtypeflow (ISSUE 10: ONE dtype
+        # analyzer) — same keys/ordering the committed contracts pin
+        "dtype": dtype_summary(program),
         "budgets": {"instruction_count": program.instruction_count(),
                     "fusion_count": fusion_count},
         "host_transfers": {"count": sum(host_ops.values()),
@@ -204,12 +191,6 @@ def summarize(program: Union[str, HloProgram],
             (mem.get("temp_size_in_bytes", 0) +
              mem.get("argument_size_in_bytes", 0)))
     return out
-
-
-def _is_upcast(pair: str) -> bool:
-    src, _, dst = pair.partition("->")
-    return (src in _FLOAT_WIDTH and dst in _FLOAT_WIDTH and
-            _FLOAT_WIDTH[dst] > _FLOAT_WIDTH[src])
 
 
 def audit_findings(summary: Dict, label: str = "") -> List[str]:

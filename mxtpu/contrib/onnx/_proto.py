@@ -32,7 +32,7 @@ NP_TO_ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.uint8): UINT8,
               np.dtype(np.int16): INT16, np.dtype(np.int32): INT32,
               np.dtype(np.int64): INT64, np.dtype(np.bool_): BOOL,
               np.dtype(np.float16): FLOAT16,
-              np.dtype(np.float64): DOUBLE,
+              np.dtype(np.float64): DOUBLE,  # mxlint: disable=dtype-hygiene (wire-format table)
               np.dtype(np.uint32): UINT32, np.dtype(np.uint64): UINT64}
 ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
 

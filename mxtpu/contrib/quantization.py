@@ -114,12 +114,13 @@ def optimal_threshold(arr, num_bins: int = 2001,
                       num_quantized_bins: int = 255) -> float:
     """KL-minimizing |x| threshold for int8 quantization — the
     reference's TensorRT-style entropy calibration."""
-    a = np.abs(np.asarray(arr, np.float64).ravel())
+    # KL divergence sums tiny probabilities; f64 is the point here
+    a = np.abs(np.asarray(arr, np.float64).ravel())  # mxlint: disable=dtype-hygiene
     amax = float(a.max()) if a.size else 0.0
     if amax < 1e-12:
         return 1e-6
     hist, edges = np.histogram(a, bins=num_bins, range=(0, amax))
-    hist = hist.astype(np.float64)
+    hist = hist.astype(np.float64)  # mxlint: disable=dtype-hygiene
     best_div = np.inf
     best_t = amax
     stride = max(1, (num_bins - num_quantized_bins) // 64)

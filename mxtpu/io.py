@@ -574,7 +574,8 @@ def _read_idx_ubyte(path: str) -> np.ndarray:
         ndim = magic & 0xFF
         dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
         dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
-                  0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+                  0x0C: np.int32, 0x0D: np.float32,
+                  0x0E: np.float64}  # mxlint: disable=dtype-hygiene (IDX wire format)
         data = np.frombuffer(f.read(), dtype=np.dtype(dtypes[dtype_code])
                              .newbyteorder(">"))
         return data.reshape(dims).astype(dtypes[dtype_code])

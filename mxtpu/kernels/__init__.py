@@ -13,7 +13,24 @@ import jax
 
 from .. import knobs
 
-__all__ = ["layer_norm", "flash_attention", "pallas_enabled"]
+__all__ = ["layer_norm", "flash_attention", "pallas_enabled",
+           "precision_metadata"]
+
+
+def precision_metadata():
+    """``{kernel_name: PRECISION}`` for every Pallas kernel that
+    declares its accumulation discipline — evidence for mxprec's
+    ``contracts/amp_policy.json`` ``custom_calls`` section (custom
+    calls are opaque to the HLO dtype-flow scan)."""
+    # the kernel entry points shadow their module names in this
+    # namespace (``flash_attention`` is the function), so resolve the
+    # modules explicitly
+    import importlib
+    return {
+        name: dict(importlib.import_module(
+            f"{__name__}.{name}").PRECISION)
+        for name in ("flash_attention", "layer_norm", "batch_norm")
+    }
 
 
 def pallas_enabled() -> bool:

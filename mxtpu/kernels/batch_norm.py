@@ -54,6 +54,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .. import knobs
 
+# Declared numerics contract for ``contracts/amp_policy.json`` (see
+# flash_attention.PRECISION).
+PRECISION = {
+    "accum_dtype": "f32",
+    "safe_input_dtypes": ["bf16", "f32"],
+    "note": "the staged channel-block is cast to f32 before the "
+            "per-channel stats/sums reductions; scale/shift and the "
+            "add+relu epilogue compute in f32",
+}
+
 
 # ----------------------------------------------------------------------
 # composite oracle (plain jnp, jax-autodiff) — parity target for tests

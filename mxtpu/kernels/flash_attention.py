@@ -39,6 +39,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Declared numerics contract, aggregated by
+# ``mxtpu.kernels.precision_metadata`` into
+# ``contracts/amp_policy.json`` — custom calls are opaque to the HLO
+# dtype-flow scan, so the kernel states its accumulation discipline
+# here and the parity tests hold it to that.
+PRECISION = {
+    "accum_dtype": "f32",
+    "safe_input_dtypes": ["bf16", "f32"],
+    "note": "online softmax (m/l/acc scratch) in f32; q.kT and p.v "
+            "matmuls use preferred_element_type=float32; single "
+            "downcast to the input dtype on output",
+}
+
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Pure-lax attention — fallback path and parity oracle.

@@ -22,6 +22,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .. import knobs
 
+# Declared numerics contract for ``contracts/amp_policy.json`` (see
+# flash_attention.PRECISION).
+PRECISION = {
+    "accum_dtype": "f32",
+    "safe_input_dtypes": ["bf16", "f32"],
+    "note": "x is staged to f32 before mean/var; rstd and the "
+            "normalize epilogue stay f32; mean/rstd residuals saved "
+            "in f32 for the backward",
+}
+
 
 def layer_norm_reference(x, gamma, beta, eps=1e-5):
     """Pure-lax composite — the fallback path and parity oracle."""

@@ -168,6 +168,15 @@ register("MXTPU_HLO_AUDIT", "", "str",
          "against committed lockfiles live in `python -m "
          "tools.hlocheck`.", "guards")
 
+register("MXTPU_PREC_AUDIT", "", "str",
+         "Precision audit (mxtpu.analysis.dtypeflow) of every program "
+         "TrainStep / serving ModelRunner compiles: `1` warn when the "
+         "compiled step contains bf16 accumulating reductions, "
+         "matmuls missing preferred_element_type=f32, or f64 creep; "
+         "`2` raise; unset/`0` = off with zero overhead.  Ledger "
+         "checks against contracts/prec/ live in `python -m "
+         "tools.mxprec`.", "guards")
+
 # -- observability (mxtpu.obs) -----------------------------------------
 register("MXTPU_OBS", True, "bool",
          "Unified observability layer (mxtpu.obs): metrics registry, "

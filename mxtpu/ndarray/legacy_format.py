@@ -43,7 +43,7 @@ V2_MAGIC = 0xF993FAC9
 V3_MAGIC = 0xF993FACA
 
 # mshadow type_flag ↔ numpy (reference mshadow/base.h† TypeFlag)
-_TYPE_FLAG_TO_NP = {0: np.float32, 1: np.float64, 2: np.float16,
+_TYPE_FLAG_TO_NP = {0: np.float32, 1: np.float64, 2: np.float16,  # mxlint: disable=dtype-hygiene (mshadow table)
                     3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
 _NP_TO_TYPE_FLAG = {np.dtype(v): k for k, v in _TYPE_FLAG_TO_NP.items()}
 

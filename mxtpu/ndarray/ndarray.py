@@ -32,7 +32,8 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye"]
 
 _DTYPE_ALIASES = {
-    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "float32": jnp.float32, "float16": jnp.float16,
+    "float64": jnp.float64,  # mxlint: disable=dtype-hygiene (alias table)
     "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
     "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
     "uint32": jnp.uint32, "uint64": jnp.uint64, "int16": jnp.int16,
@@ -418,7 +419,8 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
         # python scalars / nested lists default to float32 like the
         # reference (mx.nd.array([1,2]) is float32 there)
         src = np.asarray(source)
-        if src.dtype == np.float64 or src.dtype == np.int64:
+        # detection-to-DOWNCAST, not f64 math
+        if src.dtype == np.float64 or src.dtype == np.int64:  # mxlint: disable=dtype-hygiene
             src = src.astype(env_flags.default_dtype)
     if dtype is not None:
         jd = _as_jax_dtype(dtype)

@@ -41,7 +41,50 @@ def opt_rule(optimizer):
 
     All rules are elementwise in (w, g, state) — numerically identical
     stacked or not — except LAMB, whose per-tensor trust-ratio norms
-    reduce per axis-0 slice when stacked."""
+    reduce per axis-0 slice when stacked.
+
+    Unless the optimizer opts out (``multi_precision=False``), sub-f32
+    float weights get the fp32-master-weight recipe: state leaf 0 is
+    an f32 copy of the weight, the base rule updates the master with
+    an f32 grad, and the weight is the master downcast once per step.
+    f32 weights pass through untouched, so the state structure (and
+    every committed contract/checkpoint) is unchanged for them; the
+    dtype dispatch is static under tracing, so no runtime cost
+    either way.  ``mxprec``'s ``master-weight`` rule eval_shapes this
+    exact function to flag params whose update chain drops to bf16."""
+    init, update = _base_rule(optimizer)
+    if optimizer.multi_precision is False:
+        return init, update
+    return _multi_precision_rule(init, update)
+
+
+def _needs_master(w) -> bool:
+    # NOT dt.kind — numpy classes bfloat16 (an ml_dtypes extension
+    # type) as kind 'V'; jnp.issubdtype knows better
+    dt = jnp.dtype(w.dtype)
+    return bool(jnp.issubdtype(dt, jnp.floating)) and dt.itemsize < 4
+
+
+def _multi_precision_rule(base_init, base_update):
+    def init(w, stacked=False):
+        if not _needs_master(w):
+            return base_init(w, stacked=stacked)
+        master = w.astype(jnp.float32)
+        return (master,) + tuple(base_init(master, stacked=stacked))
+
+    def update(w, g, state, lr, wd, stacked=False):
+        if not _needs_master(w):
+            return base_update(w, g, state, lr, wd, stacked=stacked)
+        master = state[0]
+        w2, st2 = base_update(master, g.astype(jnp.float32),
+                              tuple(state[1:]), lr, wd,
+                              stacked=stacked)
+        # the ONLY narrowing in the chain: master -> stored weight
+        return w2.astype(w.dtype), (w2,) + tuple(st2)
+    return init, update
+
+
+def _base_rule(optimizer):
     if isinstance(optimizer, _opt.LAMB):
         fn = get_op("lamb_update").fn
 

@@ -84,7 +84,7 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0,
-                 multi_precision=False, param_dict=None):
+                 multi_precision=None, param_dict=None):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -108,14 +108,33 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    def _wants_master(self, weight) -> bool:
+        """fp32-master-weight recipe applies: ``multi_precision`` is
+        on (None = auto, the default) and the weight is a sub-f32
+        float.  False = explicit opt-out (what mxprec's
+        ``master-weight`` rule flags for bf16/f16 params)."""
+        if self.multi_precision is False:
+            return False
+        dt = str(weight.data.dtype)
+        return dt in ("float16", "bfloat16")
+
     def create_state_multi_precision(self, index, weight):
-        return self.create_state(index, weight)
+        if not self._wants_master(weight):
+            return self.create_state(index, weight)
+        master = weight.astype("float32")
+        return (master, self.create_state(index, master))
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        self.update(index, weight, grad, state)
+        if not self._wants_master(weight):
+            self.update(index, weight, grad, state)
+            return
+        master, base = state
+        self.update(index, master, grad.astype("float32"), base)
+        # the only narrowing in the chain: master -> stored weight
+        _assign(weight, master.astype(str(weight.data.dtype)))
 
     # -- hyperparams -----------------------------------------------------
     def set_learning_rate(self, lr):

@@ -476,15 +476,29 @@ class TrainStep:
         buckets = self._zero_buckets
         opt_init = self._opt_init
 
-        def init_all():
-            return tuple(
-                opt_init(jnp.zeros(b["padded_shape"], b["dtype"]),
-                         stacked=True)
-                for b in buckets)
+        def init_all(train_vals):
+            # init from the REAL stacked+padded weights, not zeros:
+            # the multi-precision rule seeds its f32 master copies
+            # here, and a zero master would erase every bf16 param on
+            # the first step.  For f32 params every supported rule's
+            # state is zeros_like regardless of w, so this is
+            # value-identical to the old zeros-based init.
+            out = []
+            for b in buckets:
+                w_s = jnp.stack([train_vals[j] for j in b["jidx"]])
+                if b["pad"]:
+                    widths = [(0, 0)] * w_s.ndim
+                    widths[b["axis"]] = (0, b["pad"])
+                    w_s = jnp.pad(w_s, widths)
+                out.append(opt_init(w_s, stacked=True))
+            return tuple(out)
 
+        train_vals = tuple(self._params[i]._data._data
+                           for i in self._train_idx)
         # one setup-time compile per TrainStep, not a hot path
         self._opt_state = jax.jit(  # mxlint: disable=retrace-inline-jit
-            init_all, out_shardings=self._zero_state_shardings)()
+            init_all,
+            out_shardings=self._zero_state_shardings)(train_vals)
 
     def _build(self, key, x_raw, y_raw):
         params = self._params
@@ -1029,6 +1043,43 @@ class TrainStep:
         mxlint's ``hlo-raw-assert`` rule bans regexing this text in
         ``tests/``."""
         return self._compiled_for(x, y).as_text()
+
+    def lowered_hlo_text(self, x, y):
+        """PRE-optimization HLO (with source metadata) of the
+        one-step program — the dtype-flow substrate ``python -m
+        tools.mxprec`` analyzes: every cast is still where the model
+        code put it, before backend float normalization rewrites
+        sub-f32 math."""
+        from mxtpu import analysis
+        x_raw, y_raw, sig = self._prep(x, y)
+        key = _rnd._next_key(None)
+        entry = self._entry_for(x_raw, y_raw, sig, key)
+        lrs, wds = self._lrs_wds()
+        params = self._params
+        train_vals = tuple(params[i]._data._data
+                           for i in self._train_idx)
+        frozen_vals = tuple(params[i]._data._data
+                            for i in entry["frozen_idx"])
+        return analysis.lowered_text(
+            entry["raw_step"], train_vals, frozen_vals,
+            self._opt_state, jax.random.key_data(key), lrs, wds,
+            x_raw, y_raw)
+
+    def param_sigs(self, x=None, y=None):
+        """``(name, shape, dtype)`` per trainable parameter, in step
+        order — what mxprec's ``master-weight`` rule audits against
+        the optimizer's functional rule.  Pass a batch to trigger
+        collection if no step has run yet."""
+        if self._params is None:
+            if x is None:
+                raise MXNetError(
+                    "param_sigs before parameter collection — run a "
+                    "step or pass a batch")
+            self._prep(x, y if y is not None else x)
+        return [(self._params[i].name,
+                 tuple(self._params[i]._data._data.shape),
+                 str(self._params[i]._data._data.dtype))
+                for i in self._train_idx]
 
     def program_summary(self, x, y):
         """Contract-shaped static summary (``mxtpu.analysis``) of the

@@ -109,7 +109,8 @@ class Corrupt(Fault):
                host: List[np.ndarray]) -> List[np.ndarray]:
         if k < self.from_batch:
             return host
-        return [np.asarray(-(h.astype(np.float64)) + 1e6).astype(h.dtype)
+        return [np.asarray(-(h.astype(np.float64)) + 1e6)  # mxlint: disable=dtype-hygiene (fault injection wants the overflow)
+                .astype(h.dtype)
                 if np.issubdtype(h.dtype, np.number) else h
                 for h in host]
 

@@ -437,6 +437,21 @@ class ModelRunner:
         text, mem = self.program_artifact(bucket)
         return analysis.summarize(text, mem)
 
+    def lowered_program_text(self, bucket: Tuple) -> str:
+        """PRE-optimization HLO (with source metadata) of one
+        bucket's program — lowers only, never compiles, so mxprec can
+        ledger a cold ladder without paying warmup."""
+        import jax
+        from mxtpu import analysis
+        batch, seq = tuple(bucket)
+        in_structs = tuple(
+            jax.ShapeDtypeStruct(
+                self._concrete_shape(n, batch, seq),
+                self._input_dtypes[n], sharding=self._sharding)
+            for n in self._input_names)
+        return analysis.lowered_text(self._pure_fn(), in_structs,
+                                     self._param_structs)
+
     def num_compiled(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -1,3 +1,5 @@
+# mxlint: disable-file=dtype-hygiene  (f64 oracle harness on purpose:
+# finite-difference gradients and numpy references need the headroom)
 """Testing utilities — the backend-equivalence and gradient-check harness.
 
 Reference: ``python/mxnet/test_utils.py``† — ``assert_almost_equal``,

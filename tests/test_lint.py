@@ -402,6 +402,54 @@ def test_thread_hygiene_scoped_to_serving_and_obs():
         _ctx(src, rel="mxtpu/serving/fake.py")) is True
 
 
+# ------------------------------------------------------- dtype hygiene
+
+def test_dtype_hygiene_flags_f64_forms():
+    ctx = _ctx("""
+        import numpy as np
+        import jax
+
+        def widen(x):
+            jax.config.update("jax_enable_x64", True)
+            y = x.astype(np.float64)
+            return np.float64(y.sum())
+    """)
+    found = R.DtypeHygiene().check(ctx)
+    assert _names(found) == ["dtype-hygiene"] * 3
+    msgs = " ".join(f.message for f in found)
+    assert "jax_enable_x64" in msgs
+    assert ".astype(float64)" in msgs
+    assert "float64 literal" in msgs
+
+
+def test_dtype_hygiene_astype_string_and_pragma():
+    ctx = _ctx("""
+        def narrow(x):
+            a = x.astype("float64")
+            b = x.astype("float64")  # mxlint: disable=dtype-hygiene
+            return a + b
+    """)
+    found = [f for f in R.DtypeHygiene().check(ctx)
+             if not ctx.suppressed(f.rule, f.line)]
+    assert len(found) == 1
+    assert found[0].line == 3
+
+
+def test_dtype_hygiene_scoped_to_library_code():
+    src = """
+        import numpy as np
+        SEED = np.float64(0.5)
+    """
+    # tests/ and tools/ seed f64 on purpose (the f64-creep rule's
+    # fixtures live there) — only mxtpu/ is held to the policy
+    assert R.DtypeHygiene().applies(
+        _ctx(src, rel="tests/test_fake.py")) is False
+    assert R.DtypeHygiene().applies(
+        _ctx(src, rel="tools/fake.py")) is False
+    assert R.DtypeHygiene().applies(
+        _ctx(src, rel="mxtpu/fake.py")) is True
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_fingerprint_survives_line_moves(tmp_path):

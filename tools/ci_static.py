@@ -9,7 +9,10 @@ contracts.
 * ``python -m mxtpu.obs --self-check`` (the observability layer's
   zero-overhead-when-off + exposition round-trip contract), then
 * ``python -m tools.mxrace --check`` (lock-order graph vs the
-  committed ``contracts/lockorder.json`` + guarded-by hygiene),
+  committed ``contracts/lockorder.json`` + guarded-by hygiene), then
+* ``python -m tools.mxprec --check`` (pre-optimization dtype flow vs
+  the committed ``contracts/prec/`` ledgers + the derived
+  ``contracts/amp_policy.json``),
 
 prints one PASS/FAIL line per stage, and exits non-zero if any
 failed — the single entry point a CI job or pre-push hook needs.
@@ -30,6 +33,7 @@ STAGES = (
     ("hlocheck", ("-m", "tools.hlocheck", "--check"), True),
     ("obs-self-check", ("-m", "mxtpu.obs", "--self-check"), False),
     ("mxrace", ("-m", "tools.mxrace", "--check"), True),
+    ("mxprec", ("-m", "tools.mxprec", "--check"), True),
 )
 
 

@@ -85,10 +85,11 @@ def main(argv=None) -> int:
     else:
         # check everything that has a committed lockfile AND is still
         # a registered target; a contract whose target vanished is an
-        # error, not silence.  contracts/ is shared with mxrace, whose
-        # lockorder.json is checked by `python -m tools.mxrace`, not
-        # here.
-        foreign = {"lockorder"}
+        # error, not silence.  contracts/ is shared with mxrace
+        # (lockorder.json, checked by `python -m tools.mxrace`) and
+        # mxprec (amp_policy.json + prec/, checked by `python -m
+        # tools.mxprec`), not here.
+        foreign = {"lockorder", "amp_policy"}
         names = sorted(p.stem for p in directory.glob("*.json")
                        if p.stem not in foreign)
         orphans = [n for n in names if n not in T.TARGETS]

@@ -27,10 +27,26 @@ Builder = Callable[[], Dict[str, Artifact]]
 
 TARGETS: Dict[str, Builder] = {}
 
+# mxprec rides the same six targets at the PRE-optimization level:
+# each prec builder returns ``{"programs": {prog: pre_opt_hlo_text},
+# "optimizer": optimizer_or_None, "param_sigs": sigs_or_None}``.
+# Model/step construction is shared with the hlocheck builders above
+# so the two registries can never drift apart.
+PrecBuilder = Callable[[], Dict]
+
+PREC_TARGETS: Dict[str, PrecBuilder] = {}
+
 
 def register_target(name: str):
     def deco(fn: Builder) -> Builder:
         TARGETS[name] = fn
+        return fn
+    return deco
+
+
+def register_prec(name: str):
+    def deco(fn: PrecBuilder) -> PrecBuilder:
+        PREC_TARGETS[name] = fn
         return fn
     return deco
 
@@ -41,6 +57,13 @@ def build(name: str) -> Dict[str, dict]:
     artifacts = TARGETS[name]()
     return {prog: summarize(text, mem)
             for prog, (text, mem) in sorted(artifacts.items())}
+
+
+def build_prec(name: str) -> Dict:
+    """Pre-optimization dtype-flow facts for ``name`` (mxprec's
+    substrate) — lowering only, never a compile, so the sweep stays
+    cheap on CPU."""
+    return PREC_TARGETS[name]()
 
 
 # ----------------------------------------------------------------------
@@ -62,7 +85,13 @@ def _train_step_artifact(step, x, y) -> Artifact:
     return step.hlo_text(x, y), step.memory_analysis(x, y)
 
 
-def _bert_step(zero: int) -> Dict[str, Artifact]:
+def _prec_train(step, x, y) -> Dict:
+    return {"programs": {"train_step": step.lowered_hlo_text(x, y)},
+            "optimizer": step.optimizer,
+            "param_sigs": step.param_sigs(x, y)}
+
+
+def _bert_parts(zero: int):
     import jax
     from mxtpu import nd, parallel
     from mxtpu.models.transformer import BERTModel
@@ -75,27 +104,34 @@ def _bert_step(zero: int) -> Dict[str, Artifact]:
     step = parallel.build_train_step(
         net, _mlm_loss(), "adam", {"learning_rate": 1e-3},
         mesh=mesh, cast_batch=False, zero=zero)
-    return {"train_step": _train_step_artifact(step, x, x)}
+    return step, x, x
 
 
 @register_target("bert_replicated")
 def bert_replicated() -> Dict[str, Artifact]:
     """Tiny BERT, dp8 data-parallel with replicated optimizer states
     (the pre-ZeRO path: gradient all-reduce)."""
-    return _bert_step(zero=0)
+    return {"train_step": _train_step_artifact(*_bert_parts(zero=0))}
 
 
 @register_target("bert_zero")
 def bert_zero() -> Dict[str, Artifact]:
     """Tiny BERT, dp8 ZeRO-1: reduce-scatter + all-gather per bucket,
     no big all-reduce — the comm signature tests/test_zero.py pins."""
-    return _bert_step(zero=1)
+    return {"train_step": _train_step_artifact(*_bert_parts(zero=1))}
 
 
-@register_target("transformer")
-def transformer() -> Dict[str, Artifact]:
-    """Tiny encoder-decoder transformer (the bench `transformer` row's
-    shape: src|tgt concatenated on the time axis)."""
+@register_prec("bert_replicated")
+def bert_replicated_prec() -> Dict:
+    return _prec_train(*_bert_parts(zero=0))
+
+
+@register_prec("bert_zero")
+def bert_zero_prec() -> Dict:
+    return _prec_train(*_bert_parts(zero=1))
+
+
+def _transformer_parts():
     from mxtpu import nd, parallel
     from mxtpu.gluon.block import HybridBlock
     from mxtpu.models.transformer import TransformerModel
@@ -122,13 +158,22 @@ def transformer() -> Dict[str, Artifact]:
     step = parallel.build_train_step(
         net, _mlm_loss(), "adam", {"learning_rate": 1e-4},
         cast_batch=False)
-    return {"train_step": _train_step_artifact(step, x, y)}
+    return step, x, y
 
 
-@register_target("resnet18")
-def resnet18() -> Dict[str, Artifact]:
-    """resnet18 thumbnail (BN-heavy conv net — the fused-BN bracket
-    watchpoint of ROADMAP item 3)."""
+@register_target("transformer")
+def transformer() -> Dict[str, Artifact]:
+    """Tiny encoder-decoder transformer (the bench `transformer` row's
+    shape: src|tgt concatenated on the time axis)."""
+    return {"train_step": _train_step_artifact(*_transformer_parts())}
+
+
+@register_prec("transformer")
+def transformer_prec() -> Dict:
+    return _prec_train(*_transformer_parts())
+
+
+def _resnet_parts():
     from mxtpu import nd, parallel
     from mxtpu.gluon import loss as gloss
     from mxtpu.gluon.model_zoo import vision
@@ -140,14 +185,22 @@ def resnet18() -> Dict[str, Artifact]:
     step = parallel.build_train_step(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9})
-    return {"train_step": _train_step_artifact(step, x, y)}
+    return step, x, y
 
 
-@register_target("serving_bert")
-def serving_bert() -> Dict[str, Artifact]:
-    """Serving bucket ladder: tiny exported BERT through
-    ModelRunner's AOT (batch, seq) executables — every bucket gets
-    its own contract entry."""
+@register_target("resnet18")
+def resnet18() -> Dict[str, Artifact]:
+    """resnet18 thumbnail (BN-heavy conv net — the fused-BN bracket
+    watchpoint of ROADMAP item 3)."""
+    return {"train_step": _train_step_artifact(*_resnet_parts())}
+
+
+@register_prec("resnet18")
+def resnet18_prec() -> Dict:
+    return _prec_train(*_resnet_parts())
+
+
+def _serving_runner():
     import os
     import tempfile
     from mxtpu import nd
@@ -161,9 +214,17 @@ def serving_bert() -> Dict[str, Artifact]:
                  .astype(np.float32)))
     d = tempfile.mkdtemp(prefix="hlocheck_bert_")
     sym_file, param_file = net.export(os.path.join(d, "bert"))
-    runner = ModelRunner.from_export(
+    return ModelRunner.from_export(
         sym_file, param_file, input_specs={"data": (None,)},
         seq_buckets=[16, 32], max_batch_size=4)
+
+
+@register_target("serving_bert")
+def serving_bert() -> Dict[str, Artifact]:
+    """Serving bucket ladder: tiny exported BERT through
+    ModelRunner's AOT (batch, seq) executables — every bucket gets
+    its own contract entry."""
+    runner = _serving_runner()
     runner.warmup()
     out: Dict[str, Artifact] = {}
     for bucket in runner.buckets():
@@ -173,15 +234,21 @@ def serving_bert() -> Dict[str, Artifact]:
     return out
 
 
-@register_target("selftest")
-def selftest() -> Dict[str, Artifact]:
-    """A deliberately small program that exercises every summary
-    family in milliseconds: a lapack custom call (the CPU backend's
-    genuine custom-call + layout-bracket specimen), fusions, and a
-    clean f32 dtype story.  Keeps one end-to-end CLI round trip
-    cheap enough for tier-1."""
+@register_prec("serving_bert")
+def serving_bert_prec() -> Dict:
+    # lowering only — no warmup/compile, so the prec sweep stays fast
+    runner = _serving_runner()
+    programs = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        programs[f"bucket_b{batch}_s{seq}"] = \
+            runner.lowered_program_text(bucket)
+    return {"programs": programs, "optimizer": None,
+            "param_sigs": None}
+
+
+def _selftest_parts():
     import jax.numpy as jnp
-    from mxtpu.analysis import compiled_artifact
 
     def f(a, b):
         w, v = jnp.linalg.eigh(a.T @ a)
@@ -190,5 +257,25 @@ def selftest() -> Dict[str, Artifact]:
     rng = np.random.RandomState(0)
     a = jnp.asarray(rng.randn(8, 8).astype(np.float32))
     b = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    return f, a, b
+
+
+@register_target("selftest")
+def selftest() -> Dict[str, Artifact]:
+    """A deliberately small program that exercises every summary
+    family in milliseconds: a lapack custom call (the CPU backend's
+    genuine custom-call + layout-bracket specimen), fusions, and a
+    clean f32 dtype story.  Keeps one end-to-end CLI round trip
+    cheap enough for tier-1."""
+    from mxtpu.analysis import compiled_artifact
+    f, a, b = _selftest_parts()
     text, mem = compiled_artifact(f, a, b)
     return {"eigh_matmul": (text, mem)}
+
+
+@register_prec("selftest")
+def selftest_prec() -> Dict:
+    from mxtpu.analysis import lowered_text
+    f, a, b = _selftest_parts()
+    return {"programs": {"eigh_matmul": lowered_text(f, a, b)},
+            "optimizer": None, "param_sigs": None}

@@ -666,6 +666,71 @@ class ThreadHygiene(Rule):
         return out
 
 
+class DtypeHygiene(Rule):
+    """Precision discipline for library code (mxprec satellite): no
+    ad-hoc f64.  ``np.float64``/``jnp.float64`` literals,
+    ``.astype("float64")``, and ``jax.config.update("jax_enable_x64",
+    ...)`` in ``mxtpu/`` silently double memory/compute and poison the
+    bf16/f32 dtype story the precision ledgers pin — f64 is a
+    per-callsite decision that needs the pragma as a visible waiver.
+    Tests are exempt (seeding f64 to exercise the f64-creep rule is
+    their job)."""
+
+    name = "dtype-hygiene"
+    _F64_ATTRS = {"np.float64", "numpy.float64", "jnp.float64",
+                  "jax.numpy.float64"}
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return ctx.rel.startswith("mxtpu/")
+
+    def _is_f64_arg(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant) and node.value == "float64":
+            return True
+        return dotted_name(node) in self._F64_ATTRS
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        claimed: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is not None and d.endswith("config.update") and \
+                    node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "jax_enable_x64":
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "jax_enable_x64 toggled in library code — x64 is "
+                    "process-global and breaks the bf16/f32 policy "
+                    "contracts/prec/ pins; scope it to the caller "
+                    "(jax.experimental.enable_x64) or waive with a "
+                    "pragma"))
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "astype":
+                for a in node.args:
+                    if self._is_f64_arg(a):
+                        claimed.add(id(a))
+                        out.append(Finding(
+                            self.name, ctx.rel, node.lineno,
+                            ".astype(float64) in library code — f64 "
+                            "doubles memory/compute and trips "
+                            "mxprec's f64-creep rule; accumulate in "
+                            "f32 (or waive with a pragma where f64 "
+                            "is the point)"))
+        for node in ast.walk(ctx.tree):
+            if id(node) in claimed or \
+                    dotted_name(node) not in self._F64_ATTRS:
+                continue
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                "float64 literal in library code — silent f32->f64 "
+                "promotion (mxprec's f64-creep rule names the "
+                "compiled sites); use f32 or waive with a pragma"))
+        return sorted(out, key=lambda f: f.line)
+
+
 # ----------------------------------------------------------------------
 # repo-level checks
 # ----------------------------------------------------------------------
@@ -723,7 +788,8 @@ def file_rules() -> List[Rule]:
     return [RetraceImpureCall(), RetraceTracedBranch(),
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
-            HloRawAssert(), ObsRegistry(), ThreadHygiene()]
+            HloRawAssert(), ObsRegistry(), ThreadHygiene(),
+            DtypeHygiene()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:

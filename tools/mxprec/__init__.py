@@ -1,0 +1,18 @@
+"""mxprec — interprocedural dtype-flow analysis with committed
+precision ledgers (ISSUE 10: the AMP groundwork pass).
+
+Rides hlocheck's six lowering targets at the PRE-optimization level
+(``mxtpu.analysis.lowered_text``): every convert is tracked to its
+producing op and source line, precision hazards are classified
+(bf16 accumulating reductions, matmuls missing
+``preferred_element_type``, f64 creep, fp32 master-weight violations),
+and the results are pinned as lockfiles under ``contracts/prec/``
+plus the machine-derived ``contracts/amp_policy.json`` op policy the
+AMP PR consumes.
+
+``python -m tools.mxprec --check`` is the CI entry point (stage 5 of
+``tools/ci_static.py``); the analysis core lives in
+``mxtpu.analysis.dtypeflow`` — the ONE dtype analyzer in the tree,
+shared with hlocheck's dtype-policy contract family and the
+``MXTPU_PREC_AUDIT`` runtime audit.
+"""

@@ -1,0 +1,211 @@
+"""mxprec CLI.
+
+Exit codes (the contract tests/test_prec.py pins, mirroring mxlint /
+hlocheck / mxrace):
+
+* 0 — every checked ledger matches; AMP policy + README table fresh;
+* 1 — precision-ledger drift (or missing ledger in --check mode);
+* 2 — usage / internal error (unknown target, unreadable ledger,
+      orphaned ledger, empty baseline).
+
+``--update`` re-lowers the named targets (default: all) at the
+PRE-optimization level and rewrites ``contracts/prec/<target>.json``;
+a full ``--update`` additionally derives ``contracts/amp_policy.json``
+from the same lowerings.  The default mode re-lowers and checks; the
+AMP-policy and README-table drift checks run only on a full default
+check (no explicit targets), so a single-target round trip stays
+cheap for tier-1 tests.  Lowering happens on the CPU backend with the
+8-virtual-device topology the test suite uses, so ledgers are
+reproducible on any box.
+"""
+from __future__ import annotations
+
+import os
+
+# pin the lowering environment BEFORE jax (imported via mxtpu) loads:
+# precision ledgers are CPU-backend artifacts by definition
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse   # noqa: E402
+import json       # noqa: E402
+import sys        # noqa: E402
+import time       # noqa: E402
+from pathlib import Path  # noqa: E402
+
+from . import core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxprec",
+        description="Interprocedural dtype-flow analysis over the "
+                    "pre-optimization lowerings of the hlocheck "
+                    "targets, checked against committed precision "
+                    "ledgers (contracts/prec/) and the derived AMP "
+                    "op policy (contracts/amp_policy.json).")
+    ap.add_argument("targets", nargs="*",
+                    help="targets to process (default: every "
+                         "committed ledger for --check, every "
+                         "registered target for --update)")
+    ap.add_argument("--check", action="store_true",
+                    help="counts-only output; exit 1 on drift (CI "
+                         "mode — this is also the default behaviour)")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate ledgers for the named targets "
+                         "(full run also rewrites amp_policy.json) "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit results as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets and exit")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="regenerate the README precision table from "
+                         "the COMMITTED ledgers (no lowering) and "
+                         "exit")
+    ap.add_argument("--contracts-dir", type=Path, default=None,
+                    help="lockfile directory (default: contracts/)")
+    args = ap.parse_args(argv)
+
+    from mxtpu.analysis import contracts as C
+    from tools.hlocheck import targets as T
+
+    directory = args.contracts_dir or C.CONTRACTS_DIR
+
+    if args.list:
+        for name in sorted(T.PREC_TARGETS):
+            state = "ledger" if core.ledger_path(
+                name, directory).exists() else "NO LEDGER"
+            print(f"{name:20s} [{state}]")
+        return 0
+
+    if args.fix_readme:
+        ledgers = core.committed_ledgers(directory)
+        if not ledgers:
+            print(f"mxprec: no ledgers in {core.prec_dir(directory)}"
+                  f" — run --update first", file=sys.stderr)
+            return 2
+        changed = core.fix_readme(core.REPO_ROOT, ledgers)
+        print("mxprec: README precision table "
+              + ("rewritten" if changed else "already fresh"))
+        return 0
+
+    if args.targets:
+        unknown = [t for t in args.targets
+                   if t not in T.PREC_TARGETS]
+        if unknown:
+            print(f"mxprec: unknown target(s): "
+                  f"{', '.join(unknown)} (see --list)",
+                  file=sys.stderr)
+            return 2
+        names = list(args.targets)
+    elif args.update:
+        names = sorted(T.PREC_TARGETS)
+    else:
+        # check everything that has a committed ledger AND is still a
+        # registered target; a ledger whose target vanished is an
+        # error, not silence
+        names = sorted(p.stem for p in
+                       core.prec_dir(directory).glob("*.json")) \
+            if core.prec_dir(directory).is_dir() else []
+        orphans = [n for n in names if n not in T.PREC_TARGETS]
+        if orphans:
+            print(f"mxprec: ledger(s) without a registered target: "
+                  f"{', '.join(orphans)}", file=sys.stderr)
+            return 2
+        if not names:
+            print(f"mxprec: no ledgers in "
+                  f"{core.prec_dir(directory)} — run --update first",
+                  file=sys.stderr)
+            return 2
+
+    # amp-policy + README drift ride only on a FULL sweep (they are
+    # whole-tree artifacts); explicit-target runs stay cheap
+    full = not args.targets
+
+    t0 = time.perf_counter()
+    all_violations: list = []
+    results = {}
+    texts_by_target = {}
+    fresh_ledgers = {}
+    for name in names:
+        t1 = time.perf_counter()
+        ledger, texts = core.build_target(name)
+        dt = time.perf_counter() - t1
+        texts_by_target[name] = texts
+        fresh_ledgers[name] = ledger
+        if args.update:
+            path = core.save_ledger(ledger, directory)
+            results[name] = {"updated": str(path),
+                             "programs": sorted(ledger["programs"]),
+                             "seconds": round(dt, 1)}
+            if not args.as_json:
+                print(f"mxprec: wrote {path} "
+                      f"({len(ledger['programs'])} program(s), "
+                      f"{dt:.1f}s)")
+            continue
+        try:
+            committed = core.load_ledger(name, directory)
+        except FileNotFoundError:
+            all_violations.append(
+                f"{name}: no ledger "
+                f"{core.ledger_path(name, directory)} — run "
+                f"--update {name}")
+            continue
+        except (ValueError, OSError) as e:
+            print(f"mxprec: cannot read ledger for {name}: {e}",
+                  file=sys.stderr)
+            return 2
+        drift = core.compare_ledgers(committed, ledger)
+        all_violations += [f"{name}: {d}" for d in drift]
+        results[name] = {"drift": drift, "seconds": round(dt, 1)}
+        if not args.as_json and not args.check:
+            print(f"mxprec: {name}: {len(drift)} drift(s) "
+                  f"({dt:.1f}s)")
+
+    if args.update:
+        if full:
+            path = core.save_amp_policy(
+                core.build_amp_policy(texts_by_target), directory)
+            if not args.as_json:
+                print(f"mxprec: wrote {path}")
+        if args.as_json:
+            print(json.dumps(results, indent=1))
+        return 0
+
+    if full:
+        policy = core.build_amp_policy(texts_by_target)
+        ppath = core.amp_policy_path(directory)
+        if not ppath.exists():
+            all_violations.append(
+                f"amp_policy: no {ppath} — run --update")
+        else:
+            try:
+                committed_policy = json.loads(ppath.read_text())
+            except (ValueError, OSError) as e:
+                print(f"mxprec: cannot read {ppath}: {e}",
+                      file=sys.stderr)
+                return 2
+            all_violations += core.compare_policy(committed_policy,
+                                                  policy)
+        all_violations += core.readme_drift(
+            core.REPO_ROOT, core.committed_ledgers(directory))
+
+    dt = time.perf_counter() - t0
+    if args.as_json:
+        print(json.dumps({"results": results,
+                          "violations": all_violations,
+                          "seconds": round(dt, 1)}, indent=1))
+    else:
+        for v in all_violations:
+            print("  " + v)
+        print(f"mxprec: {len(names)} target(s), "
+              f"{len(all_violations)} violation(s) ({dt:.1f}s)")
+    return 1 if all_violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

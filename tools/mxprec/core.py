@@ -1,0 +1,302 @@
+"""mxprec core: ledger build/compare, the derived AMP op policy, and
+the README dtype table.
+
+Ledger = ``contracts/prec/<target>.json``: per program the cast
+provenance (``flows``), float-op census and hazard findings from
+:mod:`mxtpu.analysis.dtypeflow`, plus (train targets) the optimizer's
+multi-precision facts.  Serialization matches the repo's lockfile
+idiom (``json.dumps(..., indent=1, sort_keys=True)``) so two
+``--update`` runs are byte-identical.
+
+``contracts/amp_policy.json`` is machine-derived, not hand-curated:
+every float-carrying opcode OBSERVED across the six targets' pre-opt
+programs is classified allow / deny / fp32_force / inherit with its
+per-target evidence counts, and the Pallas kernels' declared
+accumulation contracts ride along as ``custom_calls`` — the artifact
+the AMP PR consumes.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+PREC_SUBDIR = "prec"
+
+PREC_BEGIN = "<!-- mxprec:dtypes:begin -->"
+PREC_END = "<!-- mxprec:dtypes:end -->"
+
+# ---------------------------------------------------------------------
+# opcode policy classes (classification is fixed; the OBSERVED set and
+# evidence counts are machine-derived from the lowered targets)
+# ---------------------------------------------------------------------
+_ALLOW_OPS = {"dot", "convolution"}
+_ALLOW_REASON = ("MXU-bound contraction: bf16 inputs are the point of "
+                 "AMP, but accumulation must stay f32 "
+                 "(preferred_element_type=float32)")
+
+_DENY_OPS = {"exponential", "exponential-minus-one", "log",
+             "log-plus-one", "power", "sqrt", "rsqrt", "cbrt",
+             "divide", "erf", "erf-inv", "logistic", "tanh", "sine",
+             "cosine", "tan", "atan2"}
+_DENY_REASON = ("transcendental/division: bf16's 8-bit mantissa "
+                "compounds ULP error through these — compute in f32")
+
+_FP32_FORCE_OPS = {"reduce", "reduce-window", "all-reduce",
+                   "reduce-scatter"}
+_FP32_FORCE_PREFIXES = ("batch-norm",)
+_FP32_FORCE_REASON = ("accumulating reduction (incl. cross-replica): "
+                      "sum in f32, downcast once at the edge")
+
+_INHERIT_REASON = ("elementwise / data movement / structural: follows "
+                   "its input dtype, no accumulation of its own")
+
+
+def classify_opcode(opcode: str) -> Tuple[str, str]:
+    """(section, reason) for one observed float-carrying opcode."""
+    if opcode in _ALLOW_OPS:
+        return "allow", _ALLOW_REASON
+    if opcode in _DENY_OPS:
+        return "deny", _DENY_REASON
+    if opcode in _FP32_FORCE_OPS or \
+            opcode.startswith(_FP32_FORCE_PREFIXES):
+        return "fp32_force", _FP32_FORCE_REASON
+    return "inherit", _INHERIT_REASON
+
+
+# ---------------------------------------------------------------------
+# paths + lockfile serialization (byte-deterministic)
+# ---------------------------------------------------------------------
+def prec_dir(directory: Path) -> Path:
+    return directory / PREC_SUBDIR
+
+
+def ledger_path(name: str, directory: Path) -> Path:
+    return prec_dir(directory) / f"{name}.json"
+
+
+def amp_policy_path(directory: Path) -> Path:
+    return directory / "amp_policy.json"
+
+
+def _dump(obj) -> str:
+    return json.dumps(obj, indent=1, sort_keys=True) + "\n"
+
+
+def save_ledger(ledger: Dict, directory: Path) -> Path:
+    path = ledger_path(ledger["target"], directory)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(_dump(ledger))
+    return path
+
+
+def load_ledger(name: str, directory: Path) -> Dict:
+    return json.loads(ledger_path(name, directory).read_text())
+
+
+def committed_ledgers(directory: Path) -> Dict[str, Dict]:
+    d = prec_dir(directory)
+    if not d.is_dir():
+        return {}
+    return {p.stem: json.loads(p.read_text())
+            for p in sorted(d.glob("*.json"))}
+
+
+# ---------------------------------------------------------------------
+# building
+# ---------------------------------------------------------------------
+def build_target(name: str) -> Tuple[Dict, Dict[str, str]]:
+    """(ledger, {program: pre_opt_hlo_text}) for one registered
+    target.  The texts ride back so a full sweep can derive the AMP
+    policy without lowering anything twice."""
+    from mxtpu.analysis import dtypeflow
+    from tools.hlocheck import targets as T
+
+    raw = T.build_prec(name)
+    texts: Dict[str, str] = dict(raw["programs"])
+    ledger: Dict = {
+        "comment": "mxprec precision ledger -- regenerate with "
+                   f"`python -m tools.mxprec --update {name}`",
+        "target": name,
+        "programs": {prog: dtypeflow.program_ledger(text)
+                     for prog, text in sorted(texts.items())},
+    }
+    opt, sigs = raw.get("optimizer"), raw.get("param_sigs")
+    if opt is not None and sigs is not None:
+        dtypes: Dict[str, int] = {}
+        for _, _, dt in sigs:
+            dtypes[dt] = dtypes.get(dt, 0) + 1
+        mp = opt.multi_precision
+        ledger["optimizer"] = {
+            "kind": type(opt).__name__.lower(),
+            "multi_precision": "auto" if mp is None else bool(mp),
+            "param_dtypes": {k: dtypes[k] for k in sorted(dtypes)},
+            "hazards": dtypeflow.master_weight_findings(opt, sigs),
+        }
+    return ledger, texts
+
+
+def build_amp_policy(texts_by_target: Dict[str, Dict[str, str]]
+                     ) -> Dict:
+    """Classify every float-carrying opcode observed across the
+    targets' pre-opt programs; evidence = per-target instruction
+    counts.  Pallas kernels' declared accumulation contracts ride
+    along (custom calls are opaque to the HLO scan)."""
+    from mxtpu import kernels
+    from mxtpu.analysis import dtypeflow
+
+    counts: Dict[str, Dict[str, int]] = {}
+    for target in sorted(texts_by_target):
+        for prog in sorted(texts_by_target[target]):
+            text = texts_by_target[target][prog]
+            for op, n in dtypeflow.float_opcode_counts(text).items():
+                slot = counts.setdefault(op, {})
+                slot[target] = slot.get(target, 0) + n
+
+    sections: Dict[str, Dict] = {"allow": {}, "deny": {},
+                                 "fp32_force": {}, "inherit": {}}
+    for op in sorted(counts):
+        section, reason = classify_opcode(op)
+        sections[section][op] = {"reason": reason,
+                                 "evidence": counts[op]}
+    return {
+        "comment": "machine-derived AMP op policy -- every opcode "
+                   "below was observed float-carrying in a lowered "
+                   "target program; regenerate with "
+                   "`python -m tools.mxprec --update`",
+        "targets": sorted(texts_by_target),
+        "allow": sections["allow"],
+        "deny": sections["deny"],
+        "fp32_force": sections["fp32_force"],
+        "inherit": sections["inherit"],
+        "custom_calls": kernels.precision_metadata(),
+    }
+
+
+def save_amp_policy(policy: Dict, directory: Path) -> Path:
+    path = amp_policy_path(directory)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(_dump(policy))
+    return path
+
+
+# ---------------------------------------------------------------------
+# comparison (drift -> human-readable violation strings)
+# ---------------------------------------------------------------------
+def _diff(old, new, path: str, out: List[str],
+          cap: int = 20) -> None:
+    if len(out) >= cap:
+        return
+    if type(old) is not type(new):
+        out.append(f"{path}: {_fmt(old)} -> {_fmt(new)}")
+        return
+    if isinstance(old, dict):
+        for k in sorted(set(old) | set(new)):
+            sub = f"{path}.{k}" if path else str(k)
+            if k not in old:
+                out.append(f"{sub}: missing in lockfile, now "
+                           f"{_fmt(new[k])}")
+            elif k not in new:
+                out.append(f"{sub}: {_fmt(old[k])} vanished")
+            else:
+                _diff(old[k], new[k], sub, out, cap)
+            if len(out) >= cap:
+                return
+    elif isinstance(old, list):
+        if old != new:
+            out.append(f"{path}: {_fmt(old)} -> {_fmt(new)}")
+    elif old != new:
+        out.append(f"{path}: {_fmt(old)} -> {_fmt(new)}")
+
+
+def _fmt(v) -> str:
+    s = json.dumps(v, sort_keys=True)
+    return s if len(s) <= 120 else s[:117] + "..."
+
+
+def compare_ledgers(committed: Dict, fresh: Dict) -> List[str]:
+    """Drift between a committed ledger and a fresh build — empty when
+    byte-identical under the lockfile serialization."""
+    if _dump(committed) == _dump(fresh):
+        return []
+    out: List[str] = []
+    _diff(committed, fresh, "", out)
+    return out or ["ledger drifted (serialization-level difference)"]
+
+
+def compare_policy(committed: Dict, fresh: Dict) -> List[str]:
+    if _dump(committed) == _dump(fresh):
+        return []
+    out: List[str] = []
+    _diff(committed, fresh, "amp_policy", out)
+    return out or ["amp_policy drifted"]
+
+
+# ---------------------------------------------------------------------
+# README dtype table (committed ledgers -> markdown between markers)
+# ---------------------------------------------------------------------
+def _ledger_row(name: str, ledger: Dict) -> str:
+    floats: Dict[str, int] = {}
+    casts = 0
+    hazards = 0
+    for prog in ledger.get("programs", {}).values():
+        for dt, n in prog.get("float_ops", {}).items():
+            floats[dt] = floats.get(dt, 0) + n
+        for flow in prog.get("flows", {}).values():
+            casts += flow.get("count", 0)
+        hazards += len(prog.get("hazards", []))
+    opt = ledger.get("optimizer")
+    hazards += len(opt.get("hazards", [])) if opt else 0
+    fl = " ".join(f"{dt}:{floats[dt]}" for dt in sorted(floats)) \
+        or "—"
+    return (f"| {name} | {len(ledger.get('programs', {}))} | {fl} "
+            f"| {casts} | {hazards} |")
+
+
+def render_dtype_table(ledgers: Dict[str, Dict]) -> str:
+    lines = [PREC_BEGIN,
+             "| target | programs | float ops | casts | hazards |",
+             "|---|---|---|---|---|"]
+    for name in sorted(ledgers):
+        lines.append(_ledger_row(name, ledgers[name]))
+    lines.append("")
+    lines.append(f"*Pre-optimization dtype flow over {len(ledgers)} "
+                 f"target(s); pinned in `contracts/prec/`, regenerate "
+                 f"with `python -m tools.mxprec --fix-readme`.*")
+    lines.append(PREC_END)
+    return "\n".join(lines)
+
+
+def readme_drift(root: Path, ledgers: Dict[str, Dict]) -> List[str]:
+    readme = root / "README.md"
+    if not readme.exists():
+        return ["README.md missing"]
+    text = readme.read_text()
+    if PREC_BEGIN not in text or PREC_END not in text:
+        return ["README.md lacks the mxprec:dtypes markers — run "
+                "`python -m tools.mxprec --fix-readme`"]
+    current = text.split(PREC_BEGIN, 1)[1].split(PREC_END, 1)[0]
+    want = render_dtype_table(ledgers) \
+        .split(PREC_BEGIN, 1)[1].split(PREC_END, 1)[0]
+    if current.strip() != want.strip():
+        return ["README precision table is stale — run "
+                "`python -m tools.mxprec --fix-readme`"]
+    return []
+
+
+def fix_readme(root: Path, ledgers: Dict[str, Dict]) -> bool:
+    readme = root / "README.md"
+    text = readme.read_text()
+    if PREC_BEGIN not in text or PREC_END not in text:
+        raise SystemExit(
+            f"README.md lacks the markers {PREC_BEGIN!r} … "
+            f"{PREC_END!r}; add them where the table should live")
+    head = text.split(PREC_BEGIN, 1)[0]
+    tail = text.split(PREC_END, 1)[1]
+    new = head + render_dtype_table(ledgers) + tail
+    if new != text:
+        readme.write_text(new)
+        return True
+    return False
