@@ -5,10 +5,11 @@ training throughput** (north-star #1, BASELINE.md); the BERT-Large
 (north-star #2) and LeNet numbers ride along in ``extras`` so every
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
 MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
-transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet to run a
-single workload (moe_ffn, ssd, bert_zero, serving_bert and
-serving_fleet are on-demand only — not part of the default ``all``
-sweep, which is sized to the wall budget).  Every row's ``details``
+transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet|
+serving_autoscale to run a single workload (moe_ffn, ssd, bert_zero,
+serving_bert, serving_fleet and serving_autoscale are on-demand only —
+not part of the default ``all`` sweep, which is sized to the wall
+budget).  Every row's ``details``
 carries ``hbm_peak`` — the per-device resident high-water
 (temp + argument bytes) of the compiled program, from XLA's
 memory_analysis.  ``bench.py --preflight`` prints the per-row wall
@@ -84,6 +85,7 @@ _METRIC_NAMES = {
     "bert_zero": "bert_large_zero1_train_throughput",
     "serving_bert": "serving_bert_sustained_throughput",
     "serving_fleet": "serving_fleet_soak_throughput",
+    "serving_autoscale": "serving_autoscale_burst_absorb_throughput",
     "lenet": "lenet_mnist_train_throughput",
 }
 
@@ -115,6 +117,8 @@ _TRAIN_FLOPS = {
                               # ratio is the result, not MFU
     "serving_fleet": None,    # robustness row — zero in-deadline drops
                               # through a kill/restart is the result
+    "serving_autoscale": None,  # control-plane row — absorb time / SLO
+                                # violations vs static-N are the result
     "lenet": None,            # too small for MFU to mean anything
 }
 
@@ -909,6 +913,144 @@ def bench_serving_fleet(n_workers=3, n_req=600, repeats=3):
     return stats, _METRIC_NAMES["serving_fleet"], "req/sec"
 
 
+def bench_serving_autoscale(n_burst=480, repeats=3):
+    """Fleet control-plane row (on-demand,
+    MXTPU_BENCH_MODEL=serving_autoscale): a traffic burst against
+    (a) a STATIC single-worker fleet and (b) the same fleet with an
+    :class:`Autoscaler` (min=1, max=3) driven by the router tick, both
+    with predictive admission control on.  The contract (ISSUE 11):
+    the autoscaled fleet absorbs the burst inside the SLO that the
+    static fleet provably cannot meet, sheds nothing, and every
+    replica comes up warm from the donor's compiled-ladder handoff.
+
+    Vehicle: per-batch service time is scripted through the fault
+    harness (``SlowExec(service_s, time.sleep)`` — the same injector
+    tier-1 recovery tests use) because worker replicas only buy wall
+    time when service parallelizes, and on a 1-core CPU box real
+    compute cannot.  Sleeps do.  Everything else is real: the
+    measured absorb time includes genuine scale-up reaction latency,
+    replica ladder compiles, dispatch, retry and admission decisions.
+    The primary value is the autoscaled fleet's burst absorb rate
+    (served req/sec over the time for ALL submitted requests to reach
+    a terminal state); ``details`` carries the static-N comparison —
+    absorb seconds, SLO violation rate (timeouts), admission-shed
+    counts — plus the scale-up count and warm-compile evidence."""
+    from mxtpu import symbol as sym
+    from mxtpu.serving import (Autoscaler, FaultPlan, FleetRouter,
+                               FleetWorker, ModelRunner, ServerBusy,
+                               SlowExec)
+
+    dim, max_batch = 64, 8
+    service_s = 0.02           # scripted per-batch service time
+    w = np.arange(1, dim + 1, dtype=np.float32)
+    rng = np.random.RandomState(0)
+
+    # the burst floor: a single worker needs at least this long
+    static_floor = (n_burst + max_batch - 1) // max_batch * service_s
+    slo_s = 0.6 * static_floor      # feasible only by scaling out
+    submit_window = 0.25 * static_floor   # paced, not instantaneous —
+    # later submissions see a live ETA, so admission has signal
+
+    def make_worker(name):
+        runner = ModelRunner(sym.var("data") * sym.var("w"), {"w": w},
+                             {"data": (dim,)},
+                             max_batch_size=max_batch)
+        return FleetWorker(runner, name, max_queue_delay_us=2000.0,
+                           faults=FaultPlan(
+                               SlowExec(service_s, time.sleep)))
+
+    def run(autoscale):
+        router = FleetRouter(threaded=True, tick_s=0.002, canary=None,
+                             admission=True, admission_margin=1.0)
+        shed = 0
+        with router:
+            w0 = make_worker("w0")
+            router.add_worker(w0)
+            w0.runner.warmup()
+            scaler = None
+            if autoscale:
+                scaler = Autoscaler(
+                    router, make_worker, min_workers=1, max_workers=3,
+                    up_depth=2.0 * max_batch, down_depth=0.5,
+                    breach_ticks=2, cooldown_s=0.05)
+                router.add_controller(scaler.tick)
+            interval = submit_window / n_burst
+            reqs = []
+            t0 = time.perf_counter()
+            for i in range(n_burst):
+                lag = t0 + i * interval - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                try:
+                    reqs.append(router.submit(
+                        {"data": rng.rand(dim).astype(np.float32)},
+                        timeout_s=slo_s))
+                except ServerBusy:
+                    shed += 1
+            served, violated = 0, 0
+            for r in reqs:
+                try:
+                    r.result(timeout=slo_s + 10.0)
+                    served += 1
+                except Exception:  # noqa: BLE001 — timeout = SLO miss
+                    violated += 1
+            absorb = time.perf_counter() - t0
+            members = router.members()
+            cold = sum(1 for m in members
+                       if m.runner.num_compiled()
+                       < w0.runner.num_compiled())
+            snap = router.fleet_stats()
+        ex = snap["extras"]
+        return {
+            "absorb_s": round(absorb, 3),
+            "served": served,
+            "slo_violations": violated,
+            "shed_admission": shed + ex.get("shed_admission", 0),
+            "shed_backlog": ex.get("shed_backlog", 0),
+            "n_workers_final": len(members),
+            "cold_replicas": cold,
+            "scale_ups": scaler.snapshot()["scale_ups"]
+            if scaler else 0,
+        }
+
+    vals_run, statics, autos = [], [], []
+    for _ in range(repeats):
+        statics.append(run(autoscale=False))
+        a = run(autoscale=True)
+        autos.append(a)
+        vals_run.append(a["served"] / a["absorb_s"])
+    vals_run.sort()
+    median = vals_run[len(vals_run) // 2] if len(vals_run) % 2 else \
+        0.5 * (vals_run[len(vals_run) // 2 - 1]
+               + vals_run[len(vals_run) // 2])
+    mid_s = sorted(statics, key=lambda d: d["absorb_s"])[len(statics)
+                                                        // 2]
+    mid_a = sorted(autos, key=lambda d: d["absorb_s"])[len(autos) // 2]
+    stats = {
+        "best": max(vals_run), "median": median, "n": len(vals_run),
+        "spread": round((max(vals_run) - min(vals_run)) / median, 4),
+        "runs": [round(v, 1) for v in vals_run],
+        "info": {
+            "hbm_peak": None,       # inference path; no scan program
+            "n_burst": n_burst,
+            "service_s_per_batch": service_s,
+            "slo_s": round(slo_s, 3),
+            "static_floor_s": round(static_floor, 3),
+            "static": mid_s,        # median-absorb static run
+            "autoscaled": mid_a,    # median-absorb autoscaled run
+            "absorb_speedup": round(
+                mid_s["absorb_s"] / mid_a["absorb_s"], 2),
+            "static_slo_violation_rate": round(
+                (mid_s["slo_violations"] + mid_s["shed_admission"])
+                / n_burst, 4),
+            "auto_slo_violation_rate": round(
+                (mid_a["slo_violations"] + mid_a["shed_admission"])
+                / n_burst, 4),
+        },
+    }
+    return stats, _METRIC_NAMES["serving_autoscale"], "req/sec"
+
+
 def _mfu(model, value, peak, per_unit=None):
     per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
@@ -929,7 +1071,10 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             "serving_bert": 180,
             # tiny model, but 3 soak runs x (n_workers + replacement)
             # ladder compiles + open-loop pacing
-            "serving_fleet": 120}
+            "serving_fleet": 120,
+            # 6 short burst runs (static + autoscaled x 3 repeats),
+            # each ~2 s of scripted service + replica ladder compiles
+            "serving_autoscale": 90}
 
 
 def _sweep_stale_tmpdirs():
@@ -957,14 +1102,16 @@ def main():
                  metric_key="bert_s512"),
              "transformer": bench_transformer,
              # on-demand rows (MXTPU_BENCH_MODEL=moe_ffn / ssd /
-             # bert_zero / serving_bert / serving_fleet): each fits
-             # the budget on its own but the default sweep is already
-             # near the wall, so they are not in "all"
+             # bert_zero / serving_bert / serving_fleet /
+             # serving_autoscale): each fits the budget on its own but
+             # the default sweep is already near the wall, so they are
+             # not in "all"
              "moe_ffn": bench_moe_ffn,
              "ssd": bench_ssd,
              "bert_zero": bench_bert_zero,
              "serving_bert": bench_serving_bert,
-             "serving_fleet": bench_serving_fleet}
+             "serving_fleet": bench_serving_fleet,
+             "serving_autoscale": bench_serving_autoscale}
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")

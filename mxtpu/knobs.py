@@ -263,6 +263,48 @@ register("MXTPU_FLEET_MAX_PENDING", 1024, "int",
 register("MXTPU_FLEET_TICK_S", 0.005, "float",
          "Router ticker period in threaded mode.", "fleet")
 
+# -- fleet control plane (autoscaler / admission / priority) -----------
+register("MXTPU_FLEET_AUTOSCALE_MIN", 1, "int",
+         "Autoscaler floor: never drain below this many healthy "
+         "workers.", "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_MAX", 4, "int",
+         "Autoscaler ceiling on live (non-dead) workers.",
+         "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_UP_DEPTH", 4.0, "float",
+         "Scale-up band: mean outstanding requests per healthy worker "
+         "(router backlog included) above this counts as an overload "
+         "tick.", "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_DOWN_DEPTH", 0.5, "float",
+         "Scale-down band: mean outstanding per healthy worker below "
+         "this (with an empty router backlog) counts as an underload "
+         "tick.", "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_UP_ETA_US", 0.0, "float",
+         "Additional scale-up trigger: predicted queue ETA "
+         "(ServingStats.queue_eta_us) above this many microseconds "
+         "counts as overload (0 disables the ETA signal).",
+         "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_BREACH_TICKS", 3, "int",
+         "Hysteresis: consecutive over/under-band evaluations before "
+         "the autoscaler acts (bands reset each action).",
+         "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_COOLDOWN_S", 5.0, "float",
+         "Minimum seconds between autoscaler actions (either "
+         "direction).", "controlplane")
+register("MXTPU_FLEET_ADMISSION", False, "bool",
+         "Predictive admission control: shed a deadline-carrying "
+         "request at submit with ServerBusy (+retry_after_us) when "
+         "the class-aware queue ETA says it cannot finish in time.",
+         "controlplane")
+register("MXTPU_FLEET_ADMISSION_MARGIN", 1.0, "float",
+         "Admission safety factor: shed when margin x predicted ETA "
+         "exceeds the deadline budget (>1 sheds earlier, <1 gambles).",
+         "controlplane")
+register("MXTPU_FLEET_CLASSES", "", "str",
+         "Priority/fairness classes as `name:weight[:quota],...` "
+         "(e.g. `gold:8,bulk:1:64`): weight sets the weighted-round-"
+         "robin dispatch share, quota bounds in-system requests per "
+         "class.  Unset = one `default` class.", "controlplane")
+
 # -- bench / tools -----------------------------------------------------
 register("MXTPU_BENCH_MODEL", "all", "str",
          "bench.py workload selector (lenet|resnet50|bert|transformer|"
@@ -315,6 +357,7 @@ _GROUP_TITLES = [
     ("engine", "Engine / numerics"),
     ("serving", "Serving"),
     ("fleet", "Serving fleet"),
+    ("controlplane", "Fleet control plane"),
     ("bench", "Bench & profiling tools"),
     ("launch", "Distributed launch"),
     ("test", "Test harness"),

@@ -44,7 +44,8 @@ from .metrics import (DEFAULT_BUCKETS, MetricsRegistry, NULL_COUNTER,
 from .recorder import NULL_RECORDER, FlightRecorder
 from .trace import (SPAN_BACKOFF, SPAN_EXECUTE, SPAN_HEDGE,
                     SPAN_PAD_SCATTER, SPAN_QUEUE_WAIT, SPAN_REDISPATCH,
-                    SPAN_REQUEUE, SPAN_RUN, SPAN_STEAL, SPAN_SUBMIT,
+                    SPAN_REQUEUE, SPAN_RUN, SPAN_SCALE, SPAN_SHED,
+                    SPAN_STEAL, SPAN_SUBMIT,
                     new_trace_id, span, trace_of)
 
 __all__ = [
@@ -56,7 +57,7 @@ __all__ = [
     "NULL_COUNTER", "NULL_GAUGE", "NULL_HISTOGRAM", "NULL_RECORDER",
     "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE", "SPAN_BACKOFF",
     "SPAN_STEAL", "SPAN_REDISPATCH", "SPAN_HEDGE", "SPAN_PAD_SCATTER",
-    "SPAN_RUN", "SPAN_REQUEUE",
+    "SPAN_RUN", "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE",
 ]
 
 _REGISTRY = MetricsRegistry()

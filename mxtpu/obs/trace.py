@@ -28,7 +28,7 @@ __all__ = ["new_trace_id", "span", "trace_of",
            "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE",
            "SPAN_BACKOFF", "SPAN_STEAL", "SPAN_REDISPATCH",
            "SPAN_HEDGE", "SPAN_PAD_SCATTER", "SPAN_RUN",
-           "SPAN_REQUEUE"]
+           "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE"]
 
 # Request-phase span names (the committed vocabulary; tests and the
 # README's reconstruction example key off these).
@@ -42,6 +42,10 @@ SPAN_HEDGE = "fleet/hedge"
 SPAN_PAD_SCATTER = "serving/pad_scatter"
 SPAN_RUN = "serving/execute"
 SPAN_REQUEUE = "serving/requeue"
+# control-plane verdicts (ISSUE 11): instant spans, cat="fleet" —
+# every shed and scale decision is reconstructable from one dump
+SPAN_SHED = "fleet/shed"
+SPAN_SCALE = "fleet/scale"
 
 _SEQ = itertools.count(1)
 _SEQ_LOCK = threading.Lock()

@@ -1,5 +1,6 @@
-"""mxtpu.serving — dynamic-batching TPU inference serving (ISSUE 4)
-plus the fault-tolerant serving fleet (ISSUE 7).
+"""mxtpu.serving — dynamic-batching TPU inference serving (ISSUE 4),
+the fault-tolerant serving fleet (ISSUE 7), and the fleet control
+plane (ISSUE 11: autoscaling, predictive admission, priority classes).
 
 The TPU-native equivalent of the reference's C predict API +
 ``BucketingModule`` deployment story (SURVEY.md §3), grown into a
@@ -24,8 +25,13 @@ serving layer:
   exponential backoff + hedging, preemption-safe draining with
   compiled-ladder handoff, and requeue-never-drop on worker death.
 - :mod:`faults` (faults.py): deterministic scripted fault injection
-  (hang, slow-start, crash-at-k, corruption, queue wedge) for tier-1
-  recovery-path tests.
+  (hang, slow-start, crash-at-k, corruption, queue wedge, slow-exec)
+  for tier-1 recovery-path tests.
+- :mod:`controlplane` (controlplane.py): :class:`Autoscaler` (replica
+  scaling from queue depth + ``queue_eta_us`` with hysteresis,
+  cooldown, drain-based scale-down and warm-handoff scale-up) and
+  :class:`PriorityClass` (weighted-round-robin dispatch shares +
+  per-class quotas consumed by ``FleetRouter``'s admission control).
 
 Error taxonomy: :class:`RetriableError` is the base; ``ServerBusy``
 and ``WorkerLost`` are retriable, ``RequestTimeout`` is terminal
@@ -37,8 +43,9 @@ Knobs (also README "Serving" / "Serving fleet"):
 from .batcher import (Batch, DynamicBatcher, InferenceRequest,
                       RequestTimeout, RetriableError, ServerBusy,
                       WorkerLost)
+from .controlplane import Autoscaler, PriorityClass, parse_classes
 from .faults import (CrashAt, Corrupt, Fault, FaultPlan, Hang,
-                     QueueWedge, SlowStart, SlowStartError,
+                     QueueWedge, SlowExec, SlowStart, SlowStartError,
                      WorkerCrashed)
 from .health import WorkerHealth, WorkerState
 from .router import FleetRequest, FleetRouter, FleetWorker
@@ -52,5 +59,7 @@ __all__ = ["ModelRunner", "InferenceServer", "DynamicBatcher",
            "batch_ladder",
            "FleetRouter", "FleetWorker", "FleetRequest",
            "WorkerHealth", "WorkerState",
+           "Autoscaler", "PriorityClass", "parse_classes",
            "Fault", "FaultPlan", "Hang", "SlowStart", "CrashAt",
-           "Corrupt", "QueueWedge", "WorkerCrashed", "SlowStartError"]
+           "Corrupt", "QueueWedge", "WorkerCrashed", "SlowStartError",
+           "SlowExec"]

@@ -45,8 +45,21 @@ class RetriableError(MXNetError):
 
 
 class ServerBusy(RetriableError):
-    """Backpressure: the bounded request queue is full.  Retriable —
-    back off and resubmit, or route to another worker."""
+    """Backpressure: the bounded request queue is full, a class quota
+    is exhausted, or admission control predicted a deadline miss.
+    Retriable — back off and resubmit, or route to another worker.
+
+    ``retry_after_us``, when set, is the predicted queue ETA at the
+    rejecting endpoint (``ServingStats.queue_eta_us``): the earliest
+    resubmit that could plausibly succeed.  The fleet router parks a
+    rejected dispatch for exactly this long instead of exponential
+    guessing (ISSUE 11 satellite); external callers should do the
+    same."""
+
+    def __init__(self, msg: str = "",
+                 retry_after_us: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_us = retry_after_us
 
 
 class RequestTimeout(RetriableError):

@@ -35,7 +35,7 @@ from ..base import MXNetError
 
 __all__ = ["WorkerCrashed", "SlowStartError", "HangSignal",
            "Fault", "Hang", "SlowStart", "CrashAt", "Corrupt",
-           "QueueWedge", "FaultPlan"]
+           "SlowExec", "QueueWedge", "FaultPlan"]
 
 
 class WorkerCrashed(MXNetError):
@@ -113,6 +113,29 @@ class Corrupt(Fault):
                 .astype(h.dtype)
                 if np.issubdtype(h.dtype, np.number) else h
                 for h in host]
+
+
+class SlowExec(Fault):
+    """Deterministic service time on the fake clock: each dispatch
+    from ``from_batch`` on advances the injected test clock by
+    ``service_s`` before the batch runs, so completions carry real
+    (nonzero) service-time samples.  This is how the control-plane
+    scenarios (ISSUE 11) get a meaningful latency histogram — the
+    signal ``queue_eta_us`` and the autoscaler read — without any
+    wall-clock sleeps.  ``advance`` is the test clock's ``advance``
+    callable; production clocks have no such hook, which is the point:
+    this fault is harness-only."""
+
+    def __init__(self, service_s: float,
+                 advance: Callable[[float], None],
+                 from_batch: int = 0):
+        self.service_s = float(service_s)
+        self.advance = advance
+        self.from_batch = int(from_batch)
+
+    def before_batch(self, k: int) -> None:
+        if k >= self.from_batch:
+            self.advance(self.service_s)
 
 
 class QueueWedge(Fault):

@@ -35,11 +35,23 @@ scripted :mod:`~.faults` plans.  With ``threaded=True`` (production)
 each worker runs an execution thread and the router runs a ticker
 thread; the policy code is identical.
 
+ISSUE 11 grows the control plane onto this layer: requests carry a
+:class:`~.controlplane.PriorityClass` name, the router's parked
+backlog dispatches by weighted round-robin with per-class in-system
+quotas, submit-time admission control sheds by *predicted* deadline
+feasibility (``ServingStats.queue_eta_us``, class-aware: only
+same-or-higher-priority backlog counts ahead — a brownout sheds low
+classes first), and ``add_controller`` lets an
+:class:`~.controlplane.Autoscaler` ride the tick.
+
 Lock order (must hold): ``FleetRouter._lock`` → ``DynamicBatcher
-._cond`` → leaf locks (``_evlock``, request ``_wlock``,
-``ServingStats._lock``).  Completion watchers can fire under a
-batcher lock, so they only ever touch ``_evlock`` / request / stats
-state — never the router lock.
+._cond`` → leaf locks (``_evlock``, ``_class_lock``, request
+``_wlock``, ``ServingStats._lock``).  Completion watchers can fire
+under a batcher lock, so they only ever touch ``_evlock`` /
+``_class_lock`` / request / stats state — never the router lock.
+Control-plane hooks (``add_controller``) run at the end of ``tick``
+with NO router lock held, because they call back into
+``add_worker``/``drain``.
 """
 from __future__ import annotations
 
@@ -48,7 +60,7 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -58,6 +70,7 @@ from .. import obs
 from .. import profiler
 from .batcher import (DynamicBatcher, InferenceRequest, RequestTimeout,
                       ServerBusy, WorkerLost)
+from .controlplane import PriorityClass, parse_classes
 from .faults import FaultPlan, HangSignal, WorkerCrashed
 from .health import WorkerHealth, WorkerState
 from .stats import ServingStats
@@ -74,18 +87,26 @@ class FleetRequest:
 
     __slots__ = ("payload", "group", "seq_len", "t_submit", "deadline",
                  "retries", "requeues", "hedges", "tried", "last_error",
-                 "t_done", "won_by_hedge", "trace_id", "_event",
-                 "_value", "_error", "_wlock")
+                 "t_done", "won_by_hedge", "trace_id", "priority",
+                 "_event", "_value", "_error", "_wlock", "_on_done")
 
     def __init__(self, payload: Any, group: Any, seq_len: Optional[int],
                  t_submit: float, deadline: Optional[float],
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 priority: str = "default"):
         self.payload = payload
         self.group = group
         self.seq_len = seq_len
         self.t_submit = t_submit
         self.deadline = deadline
         self.trace_id = trace_id  # obs: minted at FleetRouter.submit
+        self.priority = priority  # PriorityClass name (ISSUE 11)
+        # completion hook for the router's class accounting: set once
+        # at submit before any dispatch, invoked exactly once after
+        # the one-shot completion — no concurrent mutation by design
+        # mxrace: disable=unguarded-attr (set once at submit, before dispatch)
+        self._on_done: Optional[
+            Callable[["FleetRequest"], None]] = None
         self.retries = 0          # router-level re-dispatches
         self.requeues = 0         # of those, forced by a worker death
         self.hedges = 0           # hedge attempts dispatched
@@ -127,6 +148,18 @@ class FleetRequest:
             self.t_done = now
             self._event.set()
             return True
+
+    def _notify_done(self) -> None:
+        """Run the router's class-accounting hook.  Called by whoever
+        won the one-shot ``_complete``/``_fail``, AFTER its stats
+        accounting and outside ``_wlock`` (keeps ``_wlock`` a leaf:
+        the hook takes the router's class leaf lock)."""
+        cb = self._on_done
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:   # noqa: BLE001 — accounting must never
+                pass            # poison a completing worker
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -235,9 +268,17 @@ class FleetWorker:
                 f"({self.health.reason}) — not admitting")
         timeout_s = None if deadline is None \
             else max(0.0, deadline - now)
-        return self.batcher.submit(payload, group=group,
-                                   seq_len=seq_len, timeout_s=timeout_s,
-                                   trace_id=trace_id)
+        try:
+            return self.batcher.submit(payload, group=group,
+                                       seq_len=seq_len,
+                                       timeout_s=timeout_s,
+                                       trace_id=trace_id)
+        except ServerBusy as e:
+            # price the refusal: the caller's retry can sleep exactly
+            # the predicted drain time instead of blind backoff
+            if e.retry_after_us is None:
+                e.retry_after_us = self.stats.queue_eta_us()
+            raise
 
     # -- execution ---------------------------------------------------------
     def pump(self, now: Optional[float] = None) -> bool:
@@ -431,6 +472,9 @@ class FleetRouter:
                  hedge_after_us: Optional[int] = None,
                  max_pending: Optional[int] = None,
                  tick_s: Optional[float] = None,
+                 classes: Optional[List[PriorityClass]] = None,
+                 admission: Optional[bool] = None,
+                 admission_margin: Optional[float] = None,
                  seed: int = 0, log_every_s: float = 10.0):
         self._clock = clock
         self._threaded = threaded
@@ -473,6 +517,36 @@ class FleetRouter:
             else g("MXTPU_FLEET_MAX_PENDING")
         self._tick_s = tick_s if tick_s is not None \
             else g("MXTPU_FLEET_TICK_S")
+        # -- priority/fairness + admission control (ISSUE 11) ---------
+        cls_list = classes if classes is not None \
+            else parse_classes(g("MXTPU_FLEET_CLASSES"))
+        if not cls_list:
+            cls_list = [PriorityClass("default")]
+        self._classes: Dict[str, PriorityClass] = \
+            {c.name: c for c in cls_list}
+        if len(self._classes) != len(cls_list):
+            raise MXNetError("serving: duplicate priority class names")
+        self._default_class = "default" if "default" in self._classes \
+            else max(cls_list, key=lambda c: c.weight).name
+        # guarded-by: _lock
+        self._wrr_credit: Dict[str, float] = \
+            {n: 0.0 for n in self._classes}
+        # in-system (admitted, not completed) requests per class.
+        # Leaf lock: decrements fire from completion hooks that may
+        # run under a batcher lock (see module lock order).
+        self._class_lock = threading.Lock()
+        # guarded-by: _class_lock
+        self._class_n: Dict[str, int] = \
+            {n: 0 for n in self._classes}
+        self._admission = admission if admission is not None \
+            else g("MXTPU_FLEET_ADMISSION")
+        self._admission_margin = admission_margin \
+            if admission_margin is not None \
+            else g("MXTPU_FLEET_ADMISSION_MARGIN")
+        # control-plane hooks (e.g. Autoscaler.tick) run at the END of
+        # every tick with NO router lock held
+        self._controllers: List[Callable[[float], None]] = []  # guarded-by: _lock
+        self.recorder = obs.flight("fleet/router", clock=clock)
         self._rng = random.Random(seed)
         self.stats = ServingStats(name="fleet", clock=clock,
                                   log_every_s=log_every_s)
@@ -553,15 +627,41 @@ class FleetRouter:
             return {n: w.health.state
                     for n, w in self._workers.items()}
 
+    def members(self) -> List[FleetWorker]:
+        """Worker objects in attach order (controller read surface)."""
+        with self._lock:
+            return [self._workers[n] for n in self._order]
+
+    def pending_depth(self) -> int:
+        """Requests parked in the router backlog right now."""
+        with self._lock:
+            return len(self._pending)
+
+    def add_controller(self, fn: Callable[[float], None]) -> None:
+        """Register a control-plane hook (e.g. ``Autoscaler.tick``)
+        called at the END of every tick with ``now``, no router lock
+        held — the hook may call :meth:`add_worker` / :meth:`drain`."""
+        with self._lock:
+            self._controllers.append(fn)
+
     # -- request path ------------------------------------------------------
     def submit(self, payload: Dict[str, np.ndarray], *,
                seq_len: Optional[int] = None,
-               timeout_s: Optional[float] = None) -> FleetRequest:
+               timeout_s: Optional[float] = None,
+               priority: Optional[str] = None) -> FleetRequest:
         """Route one request into the fleet.  Returns a
-        :class:`FleetRequest` future; raises :class:`ServerBusy` only
-        when the router's own pending buffer is full (per-worker
-        backpressure is handled by retrying elsewhere)."""
+        :class:`FleetRequest` future; raises :class:`ServerBusy` when
+        the router's pending buffer is full, the class quota is
+        exhausted, or admission control predicts the deadline is
+        already infeasible (``retry_after_us`` carries the predicted
+        queue ETA in every case)."""
         now = self._clock()
+        cname = self._default_class if priority is None else priority
+        cls = self._classes.get(cname)
+        if cls is None:
+            raise MXNetError(
+                f"serving: unknown priority class {cname!r} "
+                f"(have {sorted(self._classes)})")
         with self._lock:
             if self._closed:
                 raise WorkerLost("serving: fleet router is closed")
@@ -569,22 +669,52 @@ class FleetRouter:
                 raise MXNetError("serving: fleet has no workers")
             r0 = self._workers[self._order[0]].runner
             if len(self._pending) >= self._max_pending:
-                self.stats.record_rejected()
+                self._shed_locked(cls, now, "backlog")
                 raise ServerBusy(
                     f"serving: fleet pending buffer full "
-                    f"({self._max_pending}); retry with backoff")
+                    f"({self._max_pending}); retry with backoff",
+                    retry_after_us=self._fleet_eta_locked(cls))
+            if cls.quota is not None:
+                with self._class_lock:
+                    n_cls = self._class_n.get(cls.name, 0)
+                if n_cls >= cls.quota:
+                    self._shed_locked(cls, now, "quota",
+                                      in_system=n_cls)
+                    raise ServerBusy(
+                        f"serving: class {cls.name!r} quota "
+                        f"({cls.quota}) exhausted",
+                        retry_after_us=self._fleet_eta_locked(cls))
+            if self._admission and timeout_s is not None:
+                eta_us = self._fleet_eta_locked(cls)
+                budget_us = timeout_s * 1e6
+                if eta_us is not None and \
+                        self._admission_margin * eta_us > budget_us:
+                    self._shed_locked(cls, now, "admission",
+                                      eta_us=round(eta_us, 1),
+                                      budget_us=round(budget_us, 1))
+                    raise ServerBusy(
+                        f"serving: predicted queue ETA {eta_us:.0f}us "
+                        f"exceeds the {budget_us:.0f}us deadline "
+                        f"budget for class {cls.name!r} — shed at "
+                        f"submit", retry_after_us=eta_us)
         group = r0.seq_bucket_for(seq_len)
         freq = FleetRequest(payload, group, seq_len, now,
                             None if timeout_s is None
                             else now + timeout_s,
                             trace_id=obs.new_trace_id()
-                            if profiler.is_active() else None)
+                            if profiler.is_active() else None,
+                            priority=cls.name)
+        freq._on_done = self._note_request_done
+        with self._class_lock:
+            self._class_n[cls.name] = \
+                self._class_n.get(cls.name, 0) + 1
         if freq.trace_id is not None:
             obs.span(obs.SPAN_SUBMIT, now * 1e6, 0.0,
-                     trace_id=freq.trace_id, group=str(group))
+                     trace_id=freq.trace_id, group=str(group),
+                     cls=cls.name)
         with self._lock:
             if not self._dispatch_locked(freq, now):
-                self._pending.append(_Pending(now, freq))
+                self._park_locked(freq, now, now)
         return freq
 
     def infer(self, payload: Dict[str, np.ndarray], *,
@@ -594,6 +724,52 @@ class FleetRouter:
         req = self.submit(payload, seq_len=seq_len, timeout_s=timeout_s)
         return req.result(timeout=None if timeout_s is None
                           else timeout_s + 5.0)
+
+    # -- admission control (ISSUE 11) --------------------------------------
+    def _shed_locked(self, cls: PriorityClass, now: float, kind: str,
+                     **detail: Any) -> None:
+        """Account one shed verdict (backlog / quota / admission):
+        counters, flight recorder, and a ``fleet/shed`` span."""
+        self.stats.record_rejected()
+        self.stats.bump(f"shed_{kind}")
+        self.recorder.record("shed", reason=kind, cls=cls.name,
+                             **detail)
+        if profiler.is_active():
+            obs.span(obs.SPAN_SHED, now * 1e6, 0.0, cat="fleet",
+                     kind=kind, cls=cls.name, **detail)
+
+    def _fleet_eta_locked(self, cls: PriorityClass) -> Optional[float]:
+        """Predicted queue wait for a new request of ``cls``: only
+        same-or-higher-priority in-system traffic counts as "ahead"
+        (WRR serves it first), spread over the admitting workers, each
+        priced by its own service-time histogram — the best (lowest)
+        endpoint wins, matching where dispatch would place it.  None
+        until some worker has a histogram (cold fleet admits
+        optimistically)."""
+        admitting = [self._workers[n] for n in self._order
+                     if self._workers[n].health.admits()]
+        if not admitting:
+            return None
+        with self._class_lock:
+            ahead = sum(n for c, n in self._class_n.items()
+                        if self._classes[c].weight >= cls.weight)
+        share = ahead / len(admitting)
+        best: Optional[float] = None
+        for w in admitting:
+            e = w.stats.queue_eta_us(depth=share)
+            if e is None:
+                return None     # a cold worker: no histogram — admit
+            if best is None or e < best:
+                best = e
+        return best
+
+    def _note_request_done(self, freq: FleetRequest) -> None:
+        # FleetRequest._notify_done hook — fires outside _wlock, may
+        # run under a batcher lock; touches only the class leaf lock
+        with self._class_lock:
+            n = self._class_n.get(freq.priority, 0)
+            if n > 0:
+                self._class_n[freq.priority] = n - 1
 
     # -- dispatch core -----------------------------------------------------
     def _pick_locked(self, freq: Optional[FleetRequest]
@@ -623,8 +799,11 @@ class FleetRouter:
                 attempt = worker.submit_attempt(
                     freq.payload, freq.group, freq.seq_len,
                     freq.deadline, now, trace_id=freq.trace_id)
-            except (WorkerLost, ServerBusy):
-                # this worker refused; round-robin advances, try next
+            except (WorkerLost, ServerBusy) as e:
+                # this worker refused; round-robin advances, try next.
+                # Keep the refusal: a ServerBusy's retry_after_us hint
+                # lets _park_locked price the wait.
+                freq.last_error = e
                 continue
             freq.tried.append(worker.name)
             if hedge:
@@ -659,6 +838,7 @@ class FleetRouter:
                         (attempt.queue_us or 0.0))
                     if hedge:
                         self.stats.bump("hedges_won")
+                    freq._notify_done()
             else:
                 with self._evlock:
                     self._events.append(
@@ -671,6 +851,37 @@ class FleetRouter:
                    float(self._backoff_base_us) * (2 ** (n_retry - 1)))
         return base * (1.0 + self._jitter * self._rng.random()) / 1e6
 
+    def _park_locked(self, freq: FleetRequest, now: float,
+                     due: float) -> None:
+        """Park a request that found no worker.  When the refusal
+        carried a ``retry_after_us`` ETA hint, wait exactly that long
+        (capped at the backoff ceiling) instead of retrying every
+        tick against a queue we know is full."""
+        e = freq.last_error
+        hint = getattr(e, "retry_after_us", None)
+        if hint:
+            due = max(due, now + min(float(hint),
+                                     float(self._backoff_cap_us)) / 1e6)
+        self._pending.append(_Pending(due, freq))
+
+    def _wrr_next_locked(self, active: Any) -> str:
+        """Smooth weighted round-robin over the class names in
+        ``active``: each pick adds every active class's weight to its
+        credit, serves the max, and charges it the round total —
+        interleaves ~weight-proportionally with no starvation.
+        Deterministic: sorted names, strictly-greater comparison."""
+        names = sorted(active)
+        total = 0.0
+        best = names[0]
+        for n in names:
+            w = self._classes[n].weight
+            total += w
+            self._wrr_credit[n] = self._wrr_credit.get(n, 0.0) + w
+            if self._wrr_credit[n] > self._wrr_credit[best]:
+                best = n
+        self._wrr_credit[best] -= total
+        return best
+
     def _handle_attempt_failed_locked(self, freq: FleetRequest,
                                       wname: str, error: BaseException,
                                       now: float) -> None:
@@ -679,14 +890,16 @@ class FleetRouter:
         freq.last_error = error
         retriable = bool(getattr(error, "retriable", False))
         if freq.deadline is not None and now >= freq.deadline:
-            freq._fail(RequestTimeout(
-                "serving: deadline expired before a retry could be "
-                "placed"), now)
-            self.stats.record_timeout()
+            if freq._fail(RequestTimeout(
+                    "serving: deadline expired before a retry could "
+                    "be placed"), now):
+                self.stats.record_timeout()
+                freq._notify_done()
             self._dump_terminal = True
             return
         if not retriable or freq.retries >= self._retry_max:
-            freq._fail(error, now)
+            if freq._fail(error, now):
+                freq._notify_done()
             self._dump_terminal = True
             return
         freq.retries += 1
@@ -699,7 +912,14 @@ class FleetRouter:
             if freq.trace_id is not None:
                 obs.span(obs.SPAN_STEAL, now * 1e6, 0.0,
                          trace_id=freq.trace_id, worker=wname)
-        due = now + self._backoff_s(freq.retries)
+        hint = getattr(error, "retry_after_us", None)
+        if hint:
+            # the worker priced its own queue: sleep the predicted
+            # drain time (capped), not blind exponential backoff
+            due = now + min(float(hint),
+                            float(self._backoff_cap_us)) / 1e6
+        else:
+            due = now + self._backoff_s(freq.retries)
         if freq.trace_id is not None:
             obs.span(obs.SPAN_BACKOFF, now * 1e6, (due - now) * 1e6,
                      trace_id=freq.trace_id, retry=freq.retries)
@@ -851,20 +1071,36 @@ class FleetRouter:
                             "serving: deadline expired with the "
                             "attempt still in flight"), now):
                         self.stats.record_timeout()
+                        freq._notify_done()
             pending, self._pending = self._pending, []
+            due_by_class: Dict[str, deque] = {}
             for p in pending:
                 if p.freq.done():
                     continue
                 if p.freq.deadline is not None and \
                         now > p.freq.deadline:
-                    p.freq._fail(RequestTimeout(
-                        "serving: deadline expired while waiting for "
-                        "a fleet worker"), now)
-                    self.stats.record_timeout()
+                    if p.freq._fail(RequestTimeout(
+                            "serving: deadline expired while waiting "
+                            "for a fleet worker"), now):
+                        self.stats.record_timeout()
+                        p.freq._notify_done()
                     continue
-                if p.due > now or not self._dispatch_locked(
-                        p.freq, now):
+                if p.due > now:
                     self._pending.append(p)
+                else:
+                    due_by_class.setdefault(
+                        p.freq.priority, deque()).append(p)
+            # weighted round-robin over the due backlog: classes
+            # interleave by weight (FIFO within a class), so a hot
+            # tenant cannot starve the others
+            while due_by_class:
+                cname = self._wrr_next_locked(due_by_class)
+                q = due_by_class[cname]
+                p = q.popleft()
+                if not q:
+                    del due_by_class[cname]
+                if not self._dispatch_locked(p.freq, now):
+                    self._park_locked(p.freq, now, p.due)
             # hedging: a slow single IN-FLIGHT attempt gets a second
             # chance on another worker; first completion wins.  An
             # entry whose attempt already finished (either way) is out
@@ -884,6 +1120,15 @@ class FleetRouter:
         if dump_terminal and obs.dump_on_error_path() is not None:
             obs.dump_all(reason="fleet request failed terminally",
                          path=obs.dump_on_error_path() or None)
+        # control-plane hooks (autoscaler etc.) run LAST, with no
+        # router lock held — they may call add_worker/drain freely
+        with self._lock:
+            controllers = list(self._controllers)
+        for fn in controllers:
+            try:
+                fn(now)
+            except Exception:   # noqa: BLE001 — a broken controller
+                logger.exception("fleet: controller failed")  # ≠ outage
         self.stats.maybe_log()
 
     def _tick_loop(self) -> None:
@@ -903,6 +1148,12 @@ class FleetRouter:
         with self._lock:
             workers = dict(self._workers)
             snap["pending"] = len(self._pending)
+        with self._class_lock:
+            class_n = dict(self._class_n)
+        snap["classes"] = {
+            n: {"weight": c.weight, "quota": c.quota,
+                "in_system": class_n.get(n, 0)}
+            for n, c in self._classes.items()}
         snap["workers"] = {
             n: {**w.health.snapshot(), **w.stats.snapshot()}
             for n, w in workers.items()}
@@ -941,8 +1192,9 @@ class FleetRouter:
             self._ticker.join(timeout=2.0)
         now = self._clock()
         for p in pending:
-            p.freq._fail(WorkerLost(
-                "serving: fleet router closed"), now)
+            if p.freq._fail(WorkerLost(
+                    "serving: fleet router closed"), now):
+                p.freq._notify_done()
         for w in workers:
             w.shutdown()
 

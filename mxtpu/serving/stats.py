@@ -33,6 +33,10 @@ __all__ = ["ServingStats"]
 
 logger = logging.getLogger("mxtpu.serving")
 
+# queue_eta_us sorts at most this many recent service-time samples —
+# bounds the admission-path cost independently of the stats window
+_ETA_SAMPLE = 256
+
 
 def _percentile(sorted_vals, q: float) -> float:
     """Nearest-rank percentile on a pre-sorted list."""
@@ -178,6 +182,38 @@ class ServingStats:
             self._m_queue_wait.observe(queue_us / 1e6)
 
     # -- views ----------------------------------------------------------
+    def queue_eta_us(self, depth: Optional[float] = None,
+                     percentile: float = 95.0) -> Optional[float]:
+        """Predicted wait for a request entering this endpoint's queue
+        now: histogram-derived per-batch service time × queued batches
+        ahead (depth / mean batch fill), plus the request's own batch.
+        This is the admission-control signal (ISSUE 11): unlike raw
+        queue length it is deadline-comparable, so a doomed request
+        can be shed at submit time.
+
+        ``depth`` overrides the live queue depth (the fleet router
+        passes its own class-aware backlog); ``percentile`` picks the
+        service-time rank (p95 default — admission should be
+        pessimistic about stragglers).  Returns ``None`` until at
+        least one batch has completed (a cold endpoint has no
+        histogram — callers treat that as "no prediction", not zero).
+        """
+        with self._lock:
+            if not self._lat_us or not self.batches:
+                return None
+            # service time = end-to-end latency minus queue wait, per
+            # completed request; recent window keeps the sort cheap on
+            # the admission path
+            serv = sorted(
+                max(0.0, l - q) for l, q in
+                zip(list(self._lat_us)[-_ETA_SAMPLE:],
+                    list(self._queue_us)[-_ETA_SAMPLE:]))
+            s = _percentile(serv, percentile)
+            fill = max(1.0, self.batched_requests / self.batches)
+            d = float(self.queue_depth) if depth is None \
+                else max(0.0, float(depth))
+            return s * (1.0 + d / fill)
+
     def requests_per_sec(self) -> float:
         with self._lock:
             return self._rps_locked(self._clock())

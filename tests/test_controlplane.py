@@ -1,0 +1,469 @@
+"""Fleet control plane (ISSUE 11): autoscaling, predictive admission
+control, priority/fairness scheduling.
+
+Every scenario is fully deterministic: tick-driven router
+(``threaded=False``) on a hand-stepped clock, scripted faults
+(:class:`SlowExec` advances the SAME fake clock, so service-time
+histograms are exact), and the autoscaler driven by the router's own
+tick via ``add_controller``.  Three committed scenarios:
+
+* burst-absorb — scale-up via warm handoff, ZERO cold compiles on the
+  data path (asserted via ``num_compiled`` before the replica serves);
+* scale-down-then-burst — drain-based scale-down (victim retires, no
+  request dropped), then floor-repair scale-up warmed from the LAST
+  retiree's handoff after the survivor dies;
+* brownout-shed — admission control sheds strictly low-priority
+  first; late high-priority submits still admitted.
+"""
+import numpy as np
+import pytest
+
+from mxtpu import obs
+from mxtpu import symbol as sym
+from mxtpu.base import MXNetError
+from mxtpu.serving import (Autoscaler, FleetRouter, FleetWorker,
+                           ModelRunner, PriorityClass, ServerBusy,
+                           ServingStats, SlowExec, WorkerLost,
+                           WorkerState, parse_classes)
+from mxtpu.serving.faults import FaultPlan
+from mxtpu.serving.router import FleetRequest
+
+
+class FakeClock:
+    """Hand-stepped monotonic clock (same pattern as test_fleet)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mul_runner(**kwargs):
+    data = sym.var("data")
+    w = sym.var("w")
+    return ModelRunner(data * w, {"w": np.array([1.0, 2.0, 3.0],
+                                                np.float32)},
+                       {"data": (3,)}, max_batch_size=4, **kwargs)
+
+
+def _router(clk, **kw):
+    # control-plane tests run canary-free: compile accounting and
+    # class accounting stay exactly what the test scripted
+    return FleetRouter(clock=clk, threaded=False, canary=None, **kw)
+
+
+def _worker(clk, name, **kw):
+    kw.setdefault("max_queue_delay_us", 0.0)
+    return FleetWorker(_mul_runner(), name, clock=clk, **kw)
+
+
+def _payload(v):
+    return {"data": np.full(3, float(v), np.float32)}
+
+
+def _crank(router, clk, n=8, dt=0.05):
+    for _ in range(n):
+        clk.advance(dt)
+        router.tick(clk())
+
+
+# ----------------------------------------------------- priority classes
+
+def test_parse_classes():
+    got = parse_classes("gold:8,bulk:1:64")
+    assert [(c.name, c.weight, c.quota) for c in got] == \
+        [("gold", 8.0, None), ("bulk", 1.0, 64)]
+    assert parse_classes("") == []
+    assert parse_classes("solo")[0].weight == 1.0
+    with pytest.raises(MXNetError):
+        parse_classes("bad:notanumber")
+    with pytest.raises(MXNetError):
+        parse_classes("bad:2:1.5")
+
+
+def test_priority_class_validation():
+    with pytest.raises(MXNetError):
+        PriorityClass("")
+    with pytest.raises(MXNetError):
+        PriorityClass("x", weight=0.0)
+    with pytest.raises(MXNetError):
+        PriorityClass("x", quota=0)
+
+
+def test_router_rejects_unknown_and_duplicate_classes():
+    clk = FakeClock()
+    with pytest.raises(MXNetError):
+        _router(clk, classes=[PriorityClass("a"), PriorityClass("a")])
+    r = _router(clk, classes=[PriorityClass("gold", 8.0),
+                              PriorityClass("bulk", 1.0)])
+    r.add_worker(_worker(clk, "w0"))
+    with pytest.raises(MXNetError):
+        r.submit(_payload(1), priority="platinum")
+    # no "default" class configured: highest weight is the default
+    req = r.submit(_payload(1))
+    assert req.priority == "gold"
+    r.close()
+
+
+# ------------------------------------------------ queue ETA estimator
+
+def test_queue_eta_none_until_first_batch():
+    st = ServingStats(clock=FakeClock())
+    assert st.queue_eta_us() is None
+    st.record_completion(500.0, 100.0)    # completion but no batch yet
+    assert st.queue_eta_us() is None
+
+
+def test_queue_eta_formula():
+    st = ServingStats(clock=FakeClock())
+    st.record_batch(4, 4)
+    st.record_batch(4, 4)                 # fill = 8 real / 2 batches = 4
+    for _ in range(4):
+        st.record_completion(1000.0, 200.0)   # service = 800us each
+    st.record_queue_depth(8)
+    # p95 service x (1 + depth/fill): 800 * (1 + 8/4)
+    assert st.queue_eta_us() == pytest.approx(2400.0)
+    # the depth override prices a hypothetical queue position
+    assert st.queue_eta_us(depth=0) == pytest.approx(800.0)
+    assert st.queue_eta_us(depth=4) == pytest.approx(1600.0)
+
+
+def test_queue_eta_service_time_never_negative():
+    st = ServingStats(clock=FakeClock())
+    st.record_batch(1, 1)
+    st.record_completion(100.0, 500.0)    # clock skew: queue > latency
+    assert st.queue_eta_us(depth=0) == pytest.approx(0.0)
+
+
+def test_worker_refusal_carries_eta_hint():
+    clk = FakeClock()
+    w = _worker(clk, "w0", max_queue=2)
+    w.stats.record_batch(4, 4)
+    for _ in range(4):
+        w.stats.record_completion(1000.0, 200.0)   # service 800us
+    for i in range(2):
+        w.submit_attempt(_payload(i), (1, None), None, None, clk())
+    with pytest.raises(ServerBusy) as ei:
+        w.submit_attempt(_payload(9), (1, None), None, None, clk())
+    # depth 2, fill 4: 800 * (1 + 2/4)
+    assert ei.value.retry_after_us == pytest.approx(1200.0)
+    w.shutdown()
+
+
+# ------------------------------------------- retry uses the ETA hint
+
+def test_retry_after_hint_replaces_exponential_backoff():
+    clk = FakeClock()
+    r = _router(clk, backoff_base_us=1000, backoff_cap_us=64000,
+                jitter=0.2)
+    r.add_worker(_worker(clk, "w0"))
+    now = clk()
+
+    def freq():
+        return FleetRequest(_payload(1), (1, None), None, now,
+                            now + 5.0)
+
+    def last_due():
+        with r._lock:
+            return r._pending[-1].due
+
+    # park path: the refusal's hint prices the wait exactly
+    f1 = freq()
+    f1.last_error = ServerBusy("full", retry_after_us=5000.0)
+    with r._lock:
+        r._park_locked(f1, now, now)
+    assert last_due() == pytest.approx(now + 0.005)
+    # a hint above the backoff ceiling clamps to the ceiling
+    f2 = freq()
+    f2.last_error = ServerBusy("full", retry_after_us=1e9)
+    with r._lock:
+        r._park_locked(f2, now, now)
+    assert last_due() == pytest.approx(now + 0.064)
+    # attempt-failed path: hint wins over exponential backoff
+    f3 = freq()
+    with r._lock:
+        r._handle_attempt_failed_locked(
+            f3, "w0", ServerBusy("x", retry_after_us=2000.0), now)
+    assert f3.retries == 1
+    assert last_due() == pytest.approx(now + 0.002)
+    # no hint: exponential backoff (base 1000us, jitter <= 20%)
+    f4 = freq()
+    with r._lock:
+        r._handle_attempt_failed_locked(f4, "w0", ServerBusy("x"), now)
+    assert now + 0.001 <= last_due() <= now + 0.00121
+    r.close()
+
+
+# --------------------------------------------------- per-class quotas
+
+def test_quota_sheds_and_frees_on_completion():
+    clk = FakeClock()
+    r = _router(clk, classes=[PriorityClass("gold", 8.0),
+                              PriorityClass("bulk", 1.0, quota=2)])
+    r.add_worker(_worker(clk, "w0"))
+    b1 = r.submit(_payload(1), priority="bulk")
+    b2 = r.submit(_payload(2), priority="bulk")
+    with pytest.raises(ServerBusy):
+        r.submit(_payload(3), priority="bulk")   # quota exhausted
+    g = r.submit(_payload(4), priority="gold")   # gold unaffected
+    snap = r.fleet_stats()
+    assert snap["extras"]["shed_quota"] == 1
+    assert snap["classes"]["bulk"]["in_system"] == 2
+    _crank(r, clk, n=2)
+    for req in (b1, b2, g):
+        assert req.done() and req.result(timeout=0) is not None
+    # completions freed the quota (in-system decremented)
+    assert r.fleet_stats()["classes"]["bulk"]["in_system"] == 0
+    b3 = r.submit(_payload(5), priority="bulk")
+    _crank(r, clk, n=2)
+    assert b3.done()
+    r.close()
+
+
+# ------------------------------------------------ scenario: burst-absorb
+
+def test_burst_absorb_scales_up_with_zero_cold_compiles():
+    obs.reset()
+    clk = FakeClock()
+    r = _router(clk)
+    w0 = FleetWorker(_mul_runner(), "w0", clock=clk,
+                     max_queue_delay_us=0.0, max_queue=4)
+    r.add_worker(w0)
+    w0.runner.warmup()                    # donor holds the full ladder
+    nbuckets = w0.runner.num_compiled()
+    made = []
+
+    def make_worker(name):
+        w = FleetWorker(_mul_runner(), name, clock=clk,
+                        max_queue_delay_us=0.0, max_queue=4)
+        made.append(w)
+        return w
+
+    scaler = Autoscaler(r, make_worker, min_workers=1, max_workers=3,
+                        up_depth=3.0, down_depth=0.5, breach_ticks=2,
+                        cooldown_s=0.2)
+    r.add_controller(scaler.tick)
+    reqs = [r.submit(_payload(i), timeout_s=30.0) for i in range(24)]
+    # crank until the first scale-up fires; the controller runs at the
+    # END of the tick, so the replica has not served a single request
+    for _ in range(20):
+        clk.advance(0.05)
+        r.tick(clk())
+        if made:
+            break
+    assert made, "burst never triggered a scale-up"
+    # warm handoff: the full donor ladder compiled BEFORE any traffic
+    assert made[0].runner.num_compiled() == nbuckets
+    _crank(r, clk, n=20)
+    for i, req in enumerate(reqs):
+        got = req.result(timeout=0)
+        np.testing.assert_allclose(
+            got[0], np.full(3, float(i)) * np.array([1.0, 2.0, 3.0]),
+            rtol=1e-5)
+    # zero cold compiles on the data path: no worker compiled anything
+    # beyond the warmed ladder while absorbing the burst
+    for w in [w0] + made:
+        assert w.runner.num_compiled() == nbuckets
+    snap = scaler.snapshot()
+    assert snap["scale_ups"] == len(made) >= 1
+    ups = [e for e in scaler.recorder.events()
+           if e["kind"] == "scale_up"]
+    assert len(ups) == len(made) and ups[0]["donor"] == "w0"
+    assert r.fleet_stats()["extras"]["scale_ups"] == len(made)
+    r.close()
+
+
+# ------------------------------------- scenario: scale-down-then-burst
+
+def test_scale_down_drains_then_burst_rewarms_from_last_handoff():
+    obs.reset()
+    clk = FakeClock()
+    r = _router(clk)
+    w0 = _worker(clk, "w0")
+    w1 = _worker(clk, "w1")
+    for w in (w0, w1):
+        r.add_worker(w)
+        w.runner.warmup()
+    nbuckets = w0.runner.num_compiled()
+    made = []
+
+    def make_worker(name):
+        w = _worker(clk, name)
+        made.append(w)
+        return w
+
+    scaler = Autoscaler(r, make_worker, min_workers=1, max_workers=2,
+                        up_depth=3.0, down_depth=0.5, breach_ticks=2,
+                        cooldown_s=0.1)
+    r.add_controller(scaler.tick)
+    # phase 1: some traffic completes, then the fleet idles and the
+    # autoscaler retires one worker by DRAINING it (never killing)
+    reqs = [r.submit(_payload(i), timeout_s=10.0) for i in range(4)]
+    for _ in range(20):
+        clk.advance(0.05)
+        r.tick(clk())
+        if scaler.snapshot()["scale_downs"] == 1:
+            break
+    assert scaler.snapshot()["scale_downs"] == 1
+    _crank(r, clk, n=2)                   # drain completes
+    retired = [w for w in (w0, w1) if w.health.retired]
+    assert len(retired) == 1
+    assert retired[0].health.state == WorkerState.DEAD
+    assert retired[0].outstanding() == 0
+    snap = r.fleet_stats()
+    assert snap["extras"]["drains_completed"] == 1
+    # zero dropped in-flight: everything completed, nothing was stolen
+    assert all(q.done() for q in reqs)
+    assert snap["extras"].get("requeues", 0) == 0
+    assert snap["timed_out"] == 0
+    # phase 2: the survivor dies; floor repair scales up warmed from
+    # the LAST retiree's handoff (no live donor exists)
+    survivor = w0 if retired[0] is w1 else w1
+    r.kill(survivor.name)
+    for _ in range(20):
+        clk.advance(0.05)
+        r.tick(clk())
+        if made:
+            break
+    assert made and made[0].runner.num_compiled() == nbuckets
+    ups = [e for e in scaler.recorder.events()
+           if e["kind"] == "scale_up"]
+    assert ups and ups[0]["donor"] == "last_handoff"
+    reqs2 = [r.submit(_payload(10 + i), timeout_s=10.0)
+             for i in range(6)]
+    _crank(r, clk, n=6)
+    for i, req in enumerate(reqs2):
+        got = req.result(timeout=0)
+        np.testing.assert_allclose(
+            got[0],
+            np.full(3, float(10 + i)) * np.array([1.0, 2.0, 3.0]),
+            rtol=1e-5)
+    assert made[0].runner.num_compiled() == nbuckets
+    r.close()
+
+
+# ----------------------------------------------- scenario: brownout-shed
+
+def test_brownout_sheds_strictly_low_priority_first():
+    obs.reset()
+    clk = FakeClock()
+    r = _router(clk,
+                classes=[PriorityClass("gold", 8.0),
+                         PriorityClass("bulk", 1.0)],
+                admission=True, admission_margin=3.0)
+    w = FleetWorker(_mul_runner(), "w0", clock=clk,
+                    max_queue_delay_us=0.0,
+                    faults=FaultPlan(SlowExec(0.1, clk.advance)))
+    r.add_worker(w)
+    # prime the service-time histogram: one full batch at 0.1s/batch
+    prime = [r.submit(_payload(i)) for i in range(4)]
+    _crank(r, clk, n=1, dt=0.01)
+    assert all(p.done() for p in prime)
+    assert w.stats.queue_eta_us(depth=0) == pytest.approx(1e5)
+    # the brownout: interleaved gold/bulk burst against a 1.2s budget.
+    # Admission predicts eta = 100000us * (1 + ahead/4) counting only
+    # same-or-higher-priority in-system traffic, sheds when
+    # margin(3) * eta > 1.2s — i.e. when ahead > 12.
+    golds, bulks, shed = [], [], []
+    for i in range(16):
+        cls = "gold" if i % 2 == 0 else "bulk"
+        try:
+            req = r.submit(_payload(i), timeout_s=1.2, priority=cls)
+            (golds if cls == "gold" else bulks).append((i, req))
+        except ServerBusy as e:
+            shed.append((i, cls, e))
+    # strict priority order: every shed is bulk, and late golds were
+    # still admitted AFTER bulk started shedding
+    assert [(i, c) for i, c, _ in shed] == [(13, "bulk"), (15, "bulk")]
+    assert len(golds) == 8 and golds[-1][0] == 14 > shed[0][0]
+    for _, _, e in shed:
+        assert e.retry_after_us is not None and e.retry_after_us > 0
+    # every admitted request completes correctly within its deadline
+    _crank(r, clk, n=4, dt=0.01)
+    for i, req in golds + bulks:
+        got = req.result(timeout=0)
+        np.testing.assert_allclose(
+            got[0], np.full(3, float(i)) * np.array([1.0, 2.0, 3.0]),
+            rtol=1e-5)
+        assert req.t_done <= req.deadline
+    snap = r.fleet_stats()
+    assert snap["extras"]["shed_admission"] == 2
+    assert snap["timed_out"] == 0
+    sheds = [e for e in r.recorder.events() if e["kind"] == "shed"]
+    assert len(sheds) == 2
+    assert all(e["reason"] == "admission" and e["cls"] == "bulk"
+               and e["eta_us"] > 0 for e in sheds)
+    r.close()
+
+
+# --------------------------------------------- starvation regression
+
+def test_wrr_prevents_starvation_of_low_rate_tenant():
+    clk = FakeClock()
+    r = _router(clk, classes=[PriorityClass("hot", 1.0),
+                              PriorityClass("lo", 1.0)])
+    r.add_worker(FleetWorker(_mul_runner(), "w0", clock=clk,
+                             max_queue_delay_us=0.0, max_queue=4))
+    # a hot tenant floods 20 requests BEFORE the low-rate tenant's 2
+    # arrive: FIFO would serve all 20 first; equal-weight WRR
+    # interleaves the classes 1:1 out of the router backlog
+    hot = [r.submit(_payload(i), timeout_s=30.0, priority="hot")
+           for i in range(20)]
+    lo = [r.submit(_payload(100 + i), timeout_s=30.0, priority="lo")
+          for i in range(2)]
+    _crank(r, clk, n=10)
+    assert all(q.done() for q in hot + lo)
+    lo_done = max(q.t_done for q in lo)
+    # both low-rate requests finished ahead of most of the flood
+    assert sum(1 for q in hot if q.t_done > lo_done) >= 12
+    assert r.fleet_stats()["timed_out"] == 0
+    r.close()
+
+
+# ------------------------------------------------- autoscaler plumbing
+
+def test_autoscaler_validates_bounds():
+    clk = FakeClock()
+    r = _router(clk)
+    r.add_worker(_worker(clk, "w0"))
+    with pytest.raises(MXNetError):
+        Autoscaler(r, lambda n: None, min_workers=0, max_workers=2)
+    with pytest.raises(MXNetError):
+        Autoscaler(r, lambda n: None, min_workers=3, max_workers=2)
+    r.close()
+
+
+def test_autoscaler_respects_cooldown_and_max():
+    clk = FakeClock()
+    r = _router(clk)
+    r.add_worker(FleetWorker(_mul_runner(), "w0", clock=clk,
+                             max_queue_delay_us=0.0, max_queue=4))
+    made = []
+
+    def make_worker(name):
+        w = FleetWorker(_mul_runner(), name, clock=clk,
+                        max_queue_delay_us=0.0, max_queue=4)
+        made.append(w)
+        return w
+
+    scaler = Autoscaler(r, make_worker, min_workers=1, max_workers=2,
+                        up_depth=1.0, breach_ticks=1, cooldown_s=10.0)
+    # sustained overload, but cooldown + max_workers cap the response
+    reqs = [r.submit(_payload(i), timeout_s=60.0) for i in range(30)]
+    for _ in range(6):
+        clk.advance(0.05)
+        r.tick(clk())
+        scaler.tick(clk())               # driven directly, no hook
+    assert len(made) == 1                # cooldown blocked the rest
+    assert scaler.snapshot()["scale_ups"] == 1
+    clk.advance(11.0)
+    r.tick(clk())
+    scaler.tick(clk())
+    assert len(made) <= 2 <= 1 + scaler.max_workers
+    _crank(r, clk, n=12)
+    assert all(q.done() for q in reqs)
+    r.close()
