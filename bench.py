@@ -86,6 +86,7 @@ _METRIC_NAMES = {
     "serving_bert": "serving_bert_sustained_throughput",
     "serving_fleet": "serving_fleet_soak_throughput",
     "serving_autoscale": "serving_autoscale_burst_absorb_throughput",
+    "serving_coldstart": "serving_coldstart_disk_warm_speedup",
     "lenet": "lenet_mnist_train_throughput",
 }
 
@@ -119,6 +120,8 @@ _TRAIN_FLOPS = {
                               # through a kill/restart is the result
     "serving_autoscale": None,  # control-plane row — absorb time / SLO
                                 # violations vs static-N are the result
+    "serving_coldstart": None,  # robustness row — the cold vs
+                                # disk-warmed warmup split is the result
     "lenet": None,            # too small for MFU to mean anything
 }
 
@@ -1051,6 +1054,101 @@ def bench_serving_autoscale(n_burst=480, repeats=3):
     return stats, _METRIC_NAMES["serving_autoscale"], "req/sec"
 
 
+def bench_serving_coldstart(seq_len=64, max_batch=8, repeats=2):
+    """Persistent compile-cache row (on-demand,
+    MXTPU_BENCH_MODEL=serving_coldstart): the cold vs disk-warmed
+    cold-start split (ISSUE 13).  A small exported BERT's full bucket
+    ladder is warmed twice — once against an empty cache root (every
+    bucket is an XLA compile + a store) and once as a fresh runner
+    against the now-populated root (every bucket is a verified disk
+    load, ``num_compiled`` asserted zero-compile) — plus the
+    operator-facing number: time-to-first-served-request for a fresh
+    process in each mode.
+
+    The primary value is the full-ladder warmup speedup (cold seconds
+    / disk-warmed seconds, best of ``repeats``); ``details`` carries
+    the four raw timings BASELINE.md splits out."""
+    import shutil
+    import tempfile
+
+    from mxtpu import nd
+    from mxtpu.cache import ExecutableCache
+    from mxtpu.models.transformer import BERTModel
+    from mxtpu.serving import ModelRunner
+
+    V = 8192
+    net = BERTModel(V, 128, 512, 2, 2, max_length=seq_len,
+                    dropout=0.0)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    net(nd.array(rng.randint(0, V, (1, seq_len))
+                 .astype(np.float32)))          # materialize params
+    d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_coldstart_")
+    sym_file, param_file = net.export(os.path.join(d, "bert"))
+
+    def make_runner(root):
+        return ModelRunner.from_export(
+            sym_file, param_file, input_specs={"data": (None,)},
+            seq_buckets=[seq_len], max_batch_size=max_batch,
+            cache=ExecutableCache(root))
+
+    req = [{"data": rng.randint(0, V, (seq_len,))
+            .astype(np.float32)}]
+
+    def first_request_s(runner):
+        bucket = runner.bucket_for(1, seq_len)
+        vals = runner._pad_stack(req, bucket)
+        t0 = time.perf_counter()
+        np.asarray(runner.run_raw(vals, bucket)[0])
+        return time.perf_counter() - t0
+
+    runs = []
+    for _ in range(repeats):
+        root = os.path.join(d, f"cache{len(runs)}")
+        cold = make_runner(root)
+        t0 = time.perf_counter()
+        cold.warmup()
+        cold_warmup_s = time.perf_counter() - t0
+        nbuckets = len(cold.buckets())
+        assert cold.num_compiled() == nbuckets
+
+        # a second fresh "process" against the populated root: the
+        # whole ladder must come off disk with zero XLA compiles
+        warm = make_runner(root)
+        t0 = time.perf_counter()
+        warm.warmup()
+        warm_warmup_s = time.perf_counter() - t0
+        assert warm.num_compiled() == nbuckets
+        assert warm._cache.stats()["hit"] == nbuckets, \
+            warm._cache.stats()
+
+        # operator number: first served request, fresh runner each
+        cold_first = make_runner(os.path.join(d, f"cachef{len(runs)}"))
+        cold_first_s = first_request_s(cold_first)
+        warm_first = make_runner(root)
+        warm_first_s = first_request_s(warm_first)
+        runs.append({"cold_warmup_s": round(cold_warmup_s, 3),
+                     "warm_warmup_s": round(warm_warmup_s, 3),
+                     "cold_first_req_s": round(cold_first_s, 3),
+                     "warm_first_req_s": round(warm_first_s, 3),
+                     "buckets": nbuckets})
+    shutil.rmtree(d, ignore_errors=True)
+    vals = sorted(r["cold_warmup_s"] / r["warm_warmup_s"]
+                  for r in runs)
+    median = vals[len(vals) // 2] if len(vals) % 2 else \
+        0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2])
+    best_run = max(runs, key=lambda r: r["cold_warmup_s"]
+                   / r["warm_warmup_s"])
+    stats = {
+        "best": max(vals), "median": median, "n": len(vals),
+        "spread": round((max(vals) - min(vals)) / median, 4),
+        "runs": [round(v, 2) for v in vals],
+        "info": {"hbm_peak": None,  # inference path; no scan program
+                 "best_run": best_run, "all_runs": runs},
+    }
+    return stats, _METRIC_NAMES["serving_coldstart"], "x"
+
+
 def _mfu(model, value, peak, per_unit=None):
     per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
@@ -1074,7 +1172,10 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             "serving_fleet": 120,
             # 6 short burst runs (static + autoscaled x 3 repeats),
             # each ~2 s of scripted service + replica ladder compiles
-            "serving_autoscale": 90}
+            "serving_autoscale": 90,
+            # 2 repeats x (cold ladder compile + disk-warmed reload +
+            # two first-request probes) of a 2-layer BERT
+            "serving_coldstart": 120}
 
 
 def _sweep_stale_tmpdirs():
@@ -1111,7 +1212,8 @@ def main():
              "bert_zero": bench_bert_zero,
              "serving_bert": bench_serving_bert,
              "serving_fleet": bench_serving_fleet,
-             "serving_autoscale": bench_serving_autoscale}
+             "serving_autoscale": bench_serving_autoscale,
+             "serving_coldstart": bench_serving_coldstart}
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")

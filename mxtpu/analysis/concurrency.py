@@ -37,7 +37,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 # what mxrace scans: everything that owns a lock or a thread today
 SCOPES = ("mxtpu/serving", "mxtpu/obs", "mxtpu/parallel",
-          "mxtpu/profiler.py", "mxtpu/guards.py")
+          "mxtpu/profiler.py", "mxtpu/guards.py", "mxtpu/cache.py")
 
 DEFAULT_LOCKFILE = REPO_ROOT / "contracts" / "lockorder.json"
 

@@ -45,7 +45,7 @@ def _proc_worker_init(dataset_blob: bytes) -> None:
     global _WORKER_DATASET
     # never let a child spin up a TPU client
     os.environ["JAX_PLATFORMS"] = "cpu"
-    _WORKER_DATASET = pickle.loads(dataset_blob)
+    _WORKER_DATASET = pickle.loads(dataset_blob)  # mxlint: disable=raw-deserialize (in-process IPC: bytes this parent just pickled, never touch disk)
 
 
 def _np_batchify(samples):

@@ -126,7 +126,7 @@ class CIFAR10(_DownloadedDataset):
         data, labels = [], []
         for name in self._batches():
             with open(os.path.join(base, name), "rb") as f:
-                batch = pickle.load(f, encoding="latin1")
+                batch = pickle.load(f, encoding="latin1")  # mxlint: disable=raw-deserialize (upstream CIFAR archive format is pickle; file came from the pinned download)
             data.append(batch["data"].reshape(-1, 3, 32, 32)
                         .transpose(0, 2, 3, 1))
             labels.extend(batch["labels"])
@@ -156,7 +156,7 @@ class CIFAR100(CIFAR10):
         data, labels = [], []
         for name in self._batches():
             with open(os.path.join(base, name), "rb") as f:
-                batch = pickle.load(f, encoding="latin1")
+                batch = pickle.load(f, encoding="latin1")  # mxlint: disable=raw-deserialize (upstream CIFAR archive format is pickle; file came from the pinned download)
             data.append(batch["data"].reshape(-1, 3, 32, 32)
                         .transpose(0, 2, 3, 1))
             key = "fine_labels" if self._fine else "coarse_labels"

@@ -305,6 +305,21 @@ register("MXTPU_FLEET_CLASSES", "", "str",
          "robin dispatch share, quota bounds in-system requests per "
          "class.  Unset = one `default` class.", "controlplane")
 
+# -- persistent compile cache (mxtpu/cache.py) -------------------------
+register("MXTPU_CACHE", True, "bool",
+         "Master switch for the persistent AOT executable cache: "
+         "`0` = always compile, never touch disk.  The disk layer is "
+         "also inert while MXTPU_CACHE_DIR is unset.", "cache")
+register("MXTPU_CACHE_DIR", "", "str",
+         "Root directory of the on-disk compiled-executable cache "
+         "(crash-safe writes, checksum-verified loads).  ModelRunner "
+         "buckets and AOT TrainStep programs load-or-compile through "
+         "it; unset disables persistence.", "cache")
+register("MXTPU_CACHE_SALT", "", "str",
+         "Extra cache-key component: bump it to invalidate every "
+         "cached executable (rollout epoch, config generation).",
+         "cache")
+
 # -- bench / tools -----------------------------------------------------
 register("MXTPU_BENCH_MODEL", "all", "str",
          "bench.py workload selector (lenet|resnet50|bert|transformer|"
@@ -358,6 +373,7 @@ _GROUP_TITLES = [
     ("serving", "Serving"),
     ("fleet", "Serving fleet"),
     ("controlplane", "Fleet control plane"),
+    ("cache", "Persistent compile cache"),
     ("bench", "Bench & profiling tools"),
     ("launch", "Distributed launch"),
     ("test", "Test harness"),

@@ -209,6 +209,17 @@ def self_check(probe: bool = False) -> Dict[str, Any]:
                       buckets=(0.001, 0.1, 2.0))
     for v in (0.0005, 0.05, 0.05, 5.0):
         h.observe(v)
+    # The compile-cache naming shapes (ISSUE 13): a source-labeled
+    # compile-seconds histogram (source=cold|disk) and a hit counter
+    # beside the churn guard's miss counter — asserted here so the
+    # exposition surfaces keep agreeing on multi-label histograms too.
+    hc = reg.histogram("mxtpu_selfcheck_compile_seconds", "probe",
+                       labels=("entry", "source"),
+                       buckets=(0.1, 1.0, 10.0))
+    hc.labels(entry="(8, 16)", source="cold").observe(2.0)
+    hc.labels(entry="(8, 16)", source="disk").observe(0.01)
+    reg.counter("mxtpu_selfcheck_cache_hit_total", "probe",
+                labels=("entry",)).labels(entry="(8, 16)").inc()
     text_samples = parse_prometheus_text(reg.prometheus_text())
     snap_samples = samples_from_snapshot(reg.snapshot())
     if text_samples != snap_samples:

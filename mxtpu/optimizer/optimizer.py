@@ -663,7 +663,7 @@ class Updater:
         return pickle.dumps(states)
 
     def set_states(self, states_bytes):
-        data = pickle.loads(states_bytes)
+        data = pickle.loads(states_bytes)  # mxlint: disable=raw-deserialize (MXNet get_states/set_states contract: caller-supplied state blob, not a cache artifact)
         if isinstance(data, tuple) and len(data) == 2 and \
                 isinstance(data[1], Optimizer):
             states, self.optimizer = data

@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .. import cache as cache_mod
 from .. import guards
 from .. import knobs
 from .. import obs
@@ -314,7 +315,8 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  dp_axis: str = "dp", batch_axis: int = 0,
                  param_spec_fn: Optional[Callable] = None, donate=True,
-                 compute_dtype=None, cast_batch=True, zero=None):
+                 compute_dtype=None, cast_batch=True, zero=None,
+                 cache: Any = "auto"):
         from ..gluon.block import _traced_forward
         self._traced_forward = _traced_forward
         self.net = net
@@ -366,6 +368,25 @@ class TrainStep:
             "mxtpu_train_flops_per_step",
             "XLA cost_analysis FLOPs of the one-step program "
             "(MFU numerator; 0 until cost_analysis runs).",
+            labels=("entry",)).labels(entry=_entry)
+        # ISSUE 13: persistent executable cache — the AOT build
+        # becomes load-or-compile, with the cold-vs-disk split
+        # labeled on the build-time histogram and disk hits counted
+        # next to ChurnDetector's miss counter.
+        self._cache = cache_mod.default_cache() if cache == "auto" \
+            else cache
+        _h = obs.histogram(
+            "mxtpu_train_compile_seconds",
+            "AOT TrainStep build wall time (source=cold: XLA "
+            "compile; source=disk: verified load from the persistent "
+            "cache).", labels=("entry", "source"))
+        self._m_compile_s = {
+            src: _h.labels(entry=_entry, source=src)
+            for src in ("cold", "disk")}
+        self._m_cache_hit = obs.counter(
+            "mxtpu_compile_cache_hit_total",
+            "In-process compile-cache misses served from the "
+            "persistent disk cache instead of XLA.",
             labels=("entry",)).labels(entry=_entry)
 
     def _decide_zero(self, zero) -> bool:
@@ -638,14 +659,39 @@ class TrainStep:
             # sharding that differs from the placement the program was
             # lowered with, and AOT executables reject input shardings
             # that drift between steps.
-            fn = fitted.lower(
-                train_vals, frozen_vals, self._opt_state,
-                jax.random.key_data(key), zeros, zeros, x_raw,
-                y_raw).compile()
+            # ISSUE 13: load-or-compile through the persistent cache.
+            # A verified disk hit skips the XLA compile; the aux
+            # structure the lowering trace would have discovered
+            # comes from eval_shape instead (tracing only, no device
+            # work, no compile).
+            lower_args = (train_vals, frozen_vals, self._opt_state,
+                          jax.random.key_data(key), zeros, zeros,
+                          x_raw, y_raw)
+            t0 = _prof._now_us()
+            source, ckey, loaded = "cold", None, None
+            if self._cache is not None:
+                ckey = self._train_cache_key(train_vals, frozen_vals,
+                                             x_raw, y_raw)
+                loaded = self._cache.load(ckey)
+            if loaded is not None:
+                source = "disk"
+                fn = loaded
+                jax.eval_shape(step, *lower_args)   # aux discovery
+            else:
+                fn = fitted.lower(*lower_args).compile()
+                if ckey is not None:
+                    self._cache.store(ckey, fn)
             mem = _mem_stats(fn)
             self._last_mem = mem
-            from mxtpu import analysis
-            analysis.maybe_audit(fn, label="TrainStep", mem=mem)
+            if self._obs:
+                if source == "disk":
+                    self._m_cache_hit.inc()
+                self._m_compile_s[source].observe(
+                    (_prof._now_us() - t0) / 1e6)
+            if source == "cold":
+                # disk hits reload a program audited at its cold birth
+                from mxtpu import analysis
+                analysis.maybe_audit(fn, label="TrainStep", mem=mem)
         else:
             # learn the aux structure without device work
             jax.eval_shape(step, train_vals, frozen_vals,
@@ -794,6 +840,43 @@ class TrainStep:
         sig = (x_raw.shape, str(x_raw.dtype), y_raw.shape,
                str(y_raw.dtype))
         return x_raw, y_raw, sig
+
+    def _train_cache_key(self, train_vals, frozen_vals, x_raw, y_raw):
+        """Persistent-cache key of the AOT one-step program (ISSUE
+        13): a fingerprint of WHAT the step compiles — net class +
+        param signatures, optimizer rule + scalar hyperparams, loss,
+        precision/donation flags, ZeRO mode — crossed with the batch
+        signature and the mesh topology.  Weight/optimizer VALUES are
+        runtime arguments and deliberately excluded; the environment
+        components (jax version, backend, contract hash, salt) are
+        added by ``ExecutableCache.key``."""
+        import hashlib
+        import json as _json
+        opt = self.optimizer
+        opt_desc = {k: v for k, v in sorted(vars(opt).items())
+                    if isinstance(v, (bool, int, float, str))}
+        blob = _json.dumps({
+            "net": type(self.net).__name__,
+            "train": [[list(v.shape), str(v.dtype)]
+                      for v in train_vals],
+            "frozen": [[list(v.shape), str(v.dtype)]
+                       for v in frozen_vals],
+            "optimizer": [type(opt).__name__, opt_desc],
+            "loss": getattr(self.loss_fn, "__qualname__",
+                            type(self.loss_fn).__name__),
+            "compute_dtype": str(self.compute_dtype),
+            "cast_batch": self.cast_batch, "donate": self.donate,
+            "zero": self.zero, "batch_axis": self.batch_axis,
+            "dp_axis": self.dp_axis,
+        }, sort_keys=True)
+        mesh = "none" if self.mesh is None else \
+            str(sorted(self.mesh.shape.items()))
+        shape = str(((tuple(x_raw.shape), str(x_raw.dtype)),
+                     (tuple(y_raw.shape), str(y_raw.dtype))))
+        return self._cache.key(
+            model=hashlib.sha256(blob.encode()).hexdigest()[:24],
+            shape=shape, mesh=mesh,
+            device=getattr(jax.devices()[0], "device_kind", "unknown"))
 
     def _entry_for(self, x_raw, y_raw, sig, key):
         entry = self._compiled.get(sig)
@@ -1190,7 +1273,7 @@ class TrainStep:
         ZeRO-written file unchanged."""
         import pickle
         with open(fname, "rb") as f:
-            data = pickle.load(f)
+            data = pickle.load(f)  # mxlint: disable=raw-deserialize (optimizer-state checkpoint: own save_states framing, arrays not executables)
         if self._params is None:
             if x_example is None:
                 raise MXNetError(
@@ -1251,7 +1334,8 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      mesh: Optional[Mesh] = None, dp_axis: str = "dp",
                      batch_axis: int = 0, param_spec_fn=None,
                      donate: bool = True, compute_dtype=None,
-                     cast_batch: bool = True, zero=None) -> TrainStep:
+                     cast_batch: bool = True, zero=None,
+                     cache: Any = "auto") -> TrainStep:
     """Compile net+loss+optimizer into a single SPMD train step.
 
     ``mesh=None`` → single-device executable (still one fused program).
@@ -1267,7 +1351,7 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
     return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
                      donate=donate, compute_dtype=compute_dtype,
-                     cast_batch=cast_batch, zero=zero)
+                     cast_batch=cast_batch, zero=zero, cache=cache)
 
 
 from .pipeline import (spmd_pipeline, stack_stage_params,  # noqa: E402

@@ -460,7 +460,7 @@ class PipelineTrainStep:
             raise MXNetError("load_states requires a built step: run "
                              "one step (or call _setup) first")
         with open(fname, "rb") as f:
-            data = pickle.load(f)
+            data = pickle.load(f)  # mxlint: disable=raw-deserialize (optimizer-state checkpoint: own save_states framing, arrays not executables)
         self._t = data["t"]
         repl = NamedSharding(self.mesh, P())
         self._opt_state = jax.device_put(

@@ -44,9 +44,10 @@ from .batcher import (Batch, DynamicBatcher, InferenceRequest,
                       RequestTimeout, RetriableError, ServerBusy,
                       WorkerLost)
 from .controlplane import Autoscaler, PriorityClass, parse_classes
-from .faults import (CrashAt, Corrupt, Fault, FaultPlan, Hang,
-                     QueueWedge, SlowExec, SlowStart, SlowStartError,
-                     WorkerCrashed)
+from .faults import (CorruptEntry, CrashAt, Corrupt, Fault, FaultPlan,
+                     Hang, QueueWedge, ReadOnlyDir, SlowExec,
+                     SlowStart, SlowStartError, StaleKey,
+                     TruncateEntry, WorkerCrashed)
 from .health import WorkerHealth, WorkerState
 from .router import FleetRequest, FleetRouter, FleetWorker
 from .runner import ModelRunner, batch_ladder
@@ -62,4 +63,5 @@ __all__ = ["ModelRunner", "InferenceServer", "DynamicBatcher",
            "Autoscaler", "PriorityClass", "parse_classes",
            "Fault", "FaultPlan", "Hang", "SlowStart", "CrashAt",
            "Corrupt", "QueueWedge", "WorkerCrashed", "SlowStartError",
-           "SlowExec"]
+           "SlowExec", "CorruptEntry", "TruncateEntry", "StaleKey",
+           "ReadOnlyDir"]

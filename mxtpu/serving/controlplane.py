@@ -25,7 +25,12 @@ latency histograms.  This module closes the loop:
   pre-compiles the donor's bucket working set before taking traffic —
   zero cold compiles on the data path).  The handoff of the most
   recently drained worker is kept, so a scale-up with no live donor
-  (burst after scale-to-floor) still warms from the last retiree.
+  (burst after scale-to-floor) still warms from the last retiree;
+  with no donor AND no cached handoff the replica warms from the
+  persistent compile cache when it holds ladder entries (ISSUE 13,
+  ``mxtpu/cache.py``) — the ``scale_up`` flight-recorder event's
+  ``donor`` field says which path fired (a worker name,
+  ``"last_handoff"``, ``"disk_cache"``, or ``None`` for cold).
 
 Determinism: the autoscaler is tick-driven on the injected clock —
 ``router.add_controller(scaler.tick)`` makes the router's own tick
@@ -244,12 +249,22 @@ class Autoscaler:
             with self._lock:
                 meta = self._last_handoff
         worker = self._make_worker(f"{self.name_prefix}{seq}")
+        # ``add_worker`` warms from the donor metadata when present,
+        # else from the persistent compile cache (ISSUE 13) when that
+        # holds ladder entries; record which path fired so operators
+        # can tell a disk-warmed scale-up from a cold one.
+        if donor is not None:
+            warm_src = donor.name
+        elif meta is not None:
+            warm_src = "last_handoff"
+        elif worker.runner.cached_buckets():
+            warm_src = "disk_cache"
+        else:
+            warm_src = None
         self._router.add_worker(worker, warm_from=meta)
         self._router.stats.bump("scale_ups")
         self.recorder.record(
-            "scale_up", worker=worker.name,
-            donor=donor.name if donor is not None else
-            ("last_handoff" if meta is not None else None),
+            "scale_up", worker=worker.name, donor=warm_src,
             depth_per=round(depth_per, 2),
             eta_us=round(eta_us, 1), pending=pending)
         if profiler.is_active():

@@ -24,6 +24,25 @@ tier-1 tests instead of only showing up in a soak:
 * :class:`QueueWedge` — from dispatch ``k`` on, the worker stops
   pulling from its queue while still accepting submissions.  Detected
   by the queued-request liveness age.
+
+The persistent compile cache (ISSUE 13, ``mxtpu/cache.py``) extends
+the harness with *cache faults*, keyed on the cache's own store
+counter ``k`` (the k-th entry that cache ever committed) and consulted
+by :class:`~mxtpu.cache.ExecutableCache` at its write seams — same
+determinism, so every recovery path is reproducible in tier-1 in both
+the threaded and the sync fleet modes:
+
+* :class:`CorruptEntry` — flip a payload byte of stored entry ``k``
+  (bit-rot / bad DMA).  Caught by the load-time checksum; the entry
+  is quarantined and the caller recompiles.
+* :class:`TruncateEntry` — cut stored entry ``k`` in half (crash
+  mid-copy).  Caught structurally; quarantine + recompile.
+* :class:`StaleKey` — rewrite a key component of stored entry ``k``
+  keeping the checksum VALID (an entry from an old jax / old
+  contracts).  Caught by key revalidation; quarantine + recompile.
+* :class:`ReadOnlyDir` — stores from ``k`` on fail with
+  ``PermissionError`` (read-only cache root / EROFS).  Degrades to
+  plain compile with a flight-recorder event; never an error.
 """
 from __future__ import annotations
 
@@ -35,7 +54,8 @@ from ..base import MXNetError
 
 __all__ = ["WorkerCrashed", "SlowStartError", "HangSignal",
            "Fault", "Hang", "SlowStart", "CrashAt", "Corrupt",
-           "SlowExec", "QueueWedge", "FaultPlan"]
+           "SlowExec", "QueueWedge", "CorruptEntry", "TruncateEntry",
+           "StaleKey", "ReadOnlyDir", "FaultPlan"]
 
 
 class WorkerCrashed(MXNetError):
@@ -67,6 +87,16 @@ class Fault:
                host: List[np.ndarray]) -> List[np.ndarray]:
         """Transform the host outputs of dispatch ``k`` (corruption)."""
         return host
+
+    # -- compile-cache seams (mxtpu/cache.py; ``k`` is the cache's
+    #    store counter, not the dispatch counter) ----------------------
+    def before_cache_write(self, k: int) -> None:
+        """Raise (OSError family) to deny committing entry ``k``."""
+
+    def on_entry_written(self, k: int, path) -> bool:
+        """Mutate the just-committed entry file ``k`` on disk; return
+        True if this fault touched it (recorded in ``fired``)."""
+        return False
 
 
 class Hang(Fault):
@@ -149,6 +179,71 @@ class QueueWedge(Fault):
         return k >= self.after_batches
 
 
+class CorruptEntry(Fault):
+    """Flip a payload byte of the ``at_store``-th committed cache
+    entry — structurally intact, the load-time checksum must catch
+    it (quarantine + recompile, never executed)."""
+
+    def __init__(self, at_store: int = 0):
+        self.at_store = int(at_store)
+
+    def on_entry_written(self, k: int, path) -> bool:
+        if k != self.at_store:
+            return False
+        from mxtpu import cache
+        cache.poison_corrupt(path)
+        return True
+
+
+class TruncateEntry(Fault):
+    """Cut the ``at_store``-th committed cache entry in half (crash
+    mid-copy / partial write on a non-atomic filesystem)."""
+
+    def __init__(self, at_store: int = 0):
+        self.at_store = int(at_store)
+
+    def on_entry_written(self, k: int, path) -> bool:
+        if k != self.at_store:
+            return False
+        from mxtpu import cache
+        cache.poison_truncate(path)
+        return True
+
+
+class StaleKey(Fault):
+    """Rewrite one key component of the ``at_store``-th committed
+    entry keeping its checksum valid — what an entry from an old jax
+    or old contracts looks like; key revalidation must catch it."""
+
+    def __init__(self, at_store: int = 0, component: str = "jax",
+                 value: str = "0.0.0-stale"):
+        self.at_store = int(at_store)
+        self.component = component
+        self.value = value
+
+    def on_entry_written(self, k: int, path) -> bool:
+        if k != self.at_store:
+            return False
+        from mxtpu import cache
+        cache.poison_stale(path, self.component, self.value)
+        return True
+
+
+class ReadOnlyDir(Fault):
+    """Cache stores from ``from_store`` on fail with
+    ``PermissionError`` — a read-only cache root (EROFS container
+    mount), scripted rather than chmod'd because uid-0 test runners
+    ignore mode bits.  The cache must degrade to plain compile."""
+
+    def __init__(self, from_store: int = 0):
+        self.from_store = int(from_store)
+
+    def before_cache_write(self, k: int) -> None:
+        if k >= self.from_store:
+            raise PermissionError(
+                f"scripted read-only cache dir at store {k}")
+
+
 class FaultPlan:
     """A deterministic script: the union of its faults, consulted by
     the worker at each dispatch.  ``fired`` records what actually
@@ -173,6 +268,23 @@ class FaultPlan:
             except Exception:
                 self.fired.append(f"{type(f).__name__.lower()}@{k}")
                 raise
+
+    def before_cache_write(self, k: int) -> None:
+        """Cache write seam (ExecutableCache.store): a fault raising
+        here denies committing entry ``k``."""
+        for f in self.faults:
+            try:
+                f.before_cache_write(k)
+            except Exception:
+                self.fired.append(f"{type(f).__name__.lower()}@{k}")
+                raise
+
+    def entry_written(self, k: int, path) -> None:
+        """Post-commit seam: faults mutate the entry file in place
+        (the next verified load must quarantine it)."""
+        for f in self.faults:
+            if f.on_entry_written(k, path):
+                self.fired.append(f"{type(f).__name__.lower()}@{k}")
 
     def mutator(self, k: int) -> Optional[
             Callable[[List[np.ndarray]], List[np.ndarray]]]:

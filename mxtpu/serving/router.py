@@ -568,10 +568,16 @@ class FleetRouter:
                    warm_from: Optional[Dict[str, Any]] = None) -> None:
         """Attach a worker.  ``warm_from`` is a donor's
         :meth:`FleetWorker.handoff` — the replacement pre-compiles the
-        donor's bucket working set before its first canary.  All
-        workers must share the bucket ladder (same batching groups)."""
+        donor's bucket working set before its first canary.  With no
+        donor metadata, any ladder buckets present in the persistent
+        compile cache (ISSUE 13) are warmed from disk instead, so a
+        replacement after preemption still serves its first request
+        with zero data-path compiles.  All workers must share the
+        bucket ladder (same batching groups)."""
         if warm_from is not None:
             worker.runner.warm_from(warm_from)
+        elif worker.runner.cached_buckets():
+            worker.runner.warm_from_disk()
         with self._lock:
             if self._closed:
                 raise WorkerLost("serving: fleet router is closed")

@@ -74,6 +74,15 @@ class ModelRunner:
         one runner per replica for data-parallel serving and let
         ``InferenceServer`` round-robin across them.
     pad_value : scalar used for sequence padding (default 0).
+    cache : "auto" | None | mxtpu.cache.ExecutableCache
+        The persistent executable cache (ISSUE 13).  "auto" (default)
+        uses the knob-configured process cache (inert unless
+        ``MXTPU_CACHE_DIR`` is set); None opts this runner out; an
+        explicit :class:`~mxtpu.cache.ExecutableCache` pins one (fleet
+        tests share a tmpdir cache this way).  Every bucket compile
+        becomes load-or-compile: a verified disk hit skips tracing AND
+        compilation, a miss compiles and serializes for the next
+        process.
     """
 
     def __init__(self, symbol, params: Dict[str, Any],
@@ -82,7 +91,7 @@ class ModelRunner:
                  seq_buckets: Optional[Sequence[int]] = None,
                  max_batch_size: Optional[int] = None,
                  device=None, pad_value: float = 0,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None, cache: Any = "auto"):
         import jax
 
         self._symbol = symbol
@@ -153,10 +162,36 @@ class ModelRunner:
             "mxtpu_serving_compile_total",
             "Bucket executables compiled (jit cache misses).",
             labels=("entry",)).labels(entry=self._entry_label)
-        self._m_compile_s = obs.histogram(
+        # source=cold|disk makes the cold-vs-warm split machine-
+        # readable (ISSUE 13 satellite): "cold" paid XLA, "disk"
+        # paid a verified deserialize off the persistent cache.
+        _h = obs.histogram(
             "mxtpu_serving_compile_seconds",
-            "Per-bucket AOT compile wall time.",
+            "Per-bucket entry build wall time (source=cold: XLA "
+            "compile; source=disk: verified load from the persistent "
+            "cache).", labels=("entry", "source"))
+        self._m_compile_s = {
+            src: _h.labels(entry=self._entry_label, source=src)
+            for src in ("cold", "disk")}
+        # the disk-hit counter next to ChurnDetector's
+        # mxtpu_compile_cache_miss_total: of the in-process misses,
+        # how many the persistent cache absorbed.
+        self._m_cache_hit = obs.counter(
+            "mxtpu_compile_cache_hit_total",
+            "In-process compile-cache misses served from the "
+            "persistent disk cache instead of XLA.",
             labels=("entry",)).labels(entry=self._entry_label)
+
+        # ISSUE 13: the persistent executable cache + this runner's
+        # model fingerprint (what was compiled: graph, input/param
+        # signatures, donation — weights are runtime inputs, so one
+        # entry serves every checkpoint of the same architecture).
+        from .. import cache as cache_mod
+        self._cache = cache_mod.default_cache() if cache == "auto" \
+            else cache
+        self._fingerprint = ""
+        if self._cache is not None:
+            self._fingerprint = self._model_fingerprint()
 
     @staticmethod
     def _as_np(v):
@@ -224,6 +259,70 @@ class ModelRunner:
         return (batch,) + tuple(seq if d is None else int(d)
                                 for d in self._input_specs[name])
 
+    # -- persistent cache keys (ISSUE 13) --------------------------------
+    def _model_fingerprint(self) -> str:
+        """sha256 over everything that shapes the compiled program
+        EXCEPT the bucket: graph json, input specs/dtypes, param
+        signatures, donation, pad semantics.  Weight VALUES are
+        excluded on purpose — they are runtime arguments, so the same
+        entry warms every checkpoint of this architecture."""
+        import hashlib
+        import json as _json
+        # canonicalize gensym'd op-node names ("broadcast_mul7" — a
+        # process-global counter) so two independently constructed
+        # copies of the same graph fingerprint identically; edges and
+        # heads are index-based, so op names are cosmetic.  Input
+        # ("null") nodes keep their real names — they ARE semantics.
+        graph = _json.loads(self._symbol.tojson())
+        for i, node in enumerate(graph.get("nodes", ())):
+            if node.get("op") not in (None, "null"):
+                node["name"] = f"_op{i}"
+        blob = _json.dumps({
+            "symbol": graph,
+            "inputs": {n: [list(self._input_specs[n]),
+                           str(self._input_dtypes[n])]
+                       for n in self._input_names},
+            "params": [[n, list(v.shape), str(v.dtype)]
+                       for n, v in zip(self._param_names,
+                                       self._param_vals)],
+            "donate": self._donate, "pad_value": self._pad_value,
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+    def _cache_key(self, bucket: Tuple):
+        """The persistent-cache key of one bucket executable: model
+        fingerprint x concrete bucket shape x single-device topology
+        (+ the environment components ExecutableCache.key adds — jax
+        version, backend, contract hash, salt)."""
+        batch, seq = bucket
+        shapes = {n: list(self._concrete_shape(n, batch, seq))
+                  for n in self._input_names}
+        return self._cache.key(
+            model=self._fingerprint, shape=str(sorted(shapes.items())),
+            mesh="1dev", device=getattr(self._device, "device_kind",
+                                        "unknown"))
+
+    def cached_buckets(self) -> List[Tuple]:
+        """The subset of this runner's ladder present in the
+        persistent cache right now (existence probe only; loads are
+        verified later) — what the fleet consults before deciding a
+        donor-less replacement can warm from disk."""
+        if self._cache is None:
+            return []
+        return [b for b in self.buckets()
+                if self._cache.contains(self._cache_key(b))]
+
+    def warm_from_disk(self) -> Dict[Tuple, float]:
+        """Warm every ladder bucket the persistent cache holds (a
+        poisoned/stale entry quarantines and recompiles inside
+        ``_entry`` — still off the data path).  Returns per-bucket
+        build seconds; empty dict when there is no cache or no
+        entries."""
+        hits = self.cached_buckets()
+        if not hits:
+            return {}
+        return self.warmup(hits)
+
     # -- AOT compile ------------------------------------------------------
     def _pure_fn(self):
         """Pure (traceable) interpretation of the symbol: (input_vals,
@@ -273,30 +372,50 @@ class ModelRunner:
                                      sharding=self._sharding)
                 for n in self._input_names)
             t0 = time.perf_counter()
-            with profiler.Task(f"serving:compile:b{batch}"
-                               f"{'' if seq is None else f's{seq}'}"):
-                jitted = jax.jit(
-                    self._pure_fn(),
-                    donate_argnums=(0,) if self._donate else ())
-                compiled = jitted.lower(in_structs,
-                                        self._param_structs).compile()
+            # ISSUE 13: load-or-compile through the persistent cache.
+            # A verified disk hit skips tracing AND compilation; any
+            # corrupt/truncated/stale entry quarantines inside
+            # load() and we fall through to the cold path.
+            compiled, source, ckey = None, "cold", None
+            if self._cache is not None:
+                ckey = self._cache_key(bucket)
+                compiled = self._cache.load(ckey)  # mxlint: sync-point — disk, pre-serving
+                if compiled is not None:
+                    source = "disk"
+            if compiled is None:
+                with profiler.Task(f"serving:compile:b{batch}"
+                                   f"{'' if seq is None else f's{seq}'}"):
+                    jitted = jax.jit(
+                        self._pure_fn(),
+                        donate_argnums=(0,) if self._donate else ())
+                    compiled = jitted.lower(in_structs,
+                                            self._param_structs).compile()
+                if ckey is not None:
+                    # serialize for the next process; failures degrade
+                    # to a flight-recorder event inside store()
+                    self._cache.store(ckey, compiled)
             self.compile_seconds[bucket] = time.perf_counter() - t0
             entry = {"compiled": compiled, "in_structs": in_structs}
             self._entries[bucket] = entry
             if self._obs:
                 self._m_compile.inc()
-                self._m_compile_s.observe(self.compile_seconds[bucket])
+                if source == "disk":
+                    self._m_cache_hit.inc()
+                self._m_compile_s[source].observe(
+                    self.compile_seconds[bucket])
                 obs.flight("compile").record(
                     "compile_miss", entry=self._entry_label,
-                    bucket=str(bucket),
+                    bucket=str(bucket), source=source,
                     seconds=round(self.compile_seconds[bucket], 4))
-            # MXTPU_HLO_AUDIT: static hygiene pass over every bucket
-            # executable as it is born (warmup() therefore audits the
-            # whole ladder) — no host transfers, no f64 creep, no
-            # layout-bracketed custom calls
-            from mxtpu import analysis
-            analysis.maybe_audit(compiled,
-                                 label=f"ModelRunner{bucket}")
+            if source == "cold":
+                # MXTPU_HLO_AUDIT: static hygiene pass over every
+                # bucket executable as it is born (warmup() therefore
+                # audits the whole ladder) — no host transfers, no f64
+                # creep, no layout-bracketed custom calls.  Disk hits
+                # reload a program that was audited at its cold birth.
+                from mxtpu import analysis
+                analysis.maybe_audit(compiled,
+                                     label=f"ModelRunner{bucket}")
             return entry
 
     def warmup(self, buckets: Optional[Sequence[Tuple]] = None

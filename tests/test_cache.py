@@ -1,0 +1,462 @@
+"""mxtpu.cache — persistent AOT executable cache (ISSUE 13).
+
+Three layers of coverage:
+
+* the cache core — key composition (flip ANY component and the entry
+  misses; identical keys hit across processes), crash-safe concurrent
+  writes, and the verify-or-quarantine loader against every scripted
+  poisoning (corrupt byte, truncation, stale key, read-only root) —
+  a wrong executable is NEVER returned;
+* the serving integration — a fresh ``ModelRunner`` warms its full
+  ladder from disk with zero XLA compiles, and the fleet's
+  replacement path (``add_worker`` with no donor handoff) serves its
+  first request with ``num_compiled`` == the warmed ladder in both
+  the deterministic and the threaded router modes, recompiling (not
+  executing!) poisoned entries;
+* the training integration — a second ``TrainStep`` build loads from
+  disk and steps bit-identically to the cold build.
+
+Everything is deterministic: scripted cache faults keyed on the
+cache's own store counter, hand-stepped clocks for the fleet, no
+sleeps.
+"""
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mxtpu import obs
+from mxtpu import symbol as sym
+from mxtpu.cache import (CacheKey, ExecutableCache, default_cache,
+                         poison_corrupt, poison_stale, poison_truncate,
+                         self_check)
+from mxtpu.serving import (Autoscaler, CorruptEntry, FaultPlan,
+                           FleetRouter, FleetWorker, ModelRunner,
+                           ReadOnlyDir, StaleKey, TruncateEntry)
+
+
+class FakeClock:
+    """Hand-stepped monotonic clock (same pattern as test_fleet)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mul_runner(**kwargs):
+    data = sym.var("data")
+    w = sym.var("w")
+    return ModelRunner(data * w, {"w": np.array([1.0, 2.0, 3.0],
+                                                np.float32)},
+                       {"data": (3,)}, max_batch_size=4, **kwargs)
+
+
+def _router(clk, **kw):
+    return FleetRouter(clock=clk, threaded=False, canary=None, **kw)
+
+
+def _payload(v):
+    return {"data": np.full(3, float(v), np.float32)}
+
+
+def _crank(router, clk, n=8, dt=0.05):
+    for _ in range(n):
+        clk.advance(dt)
+        router.tick(clk())
+
+
+def _tiny_compiled():
+    import jax
+    import jax.numpy as jnp
+    x = jnp.arange(8, dtype=jnp.float32)
+    return jax.jit(lambda v: v * 2 + 1).lower(x).compile(), x  # mxlint: disable=hlo-raw-assert (building a Compiled to cache, not inspecting HLO)
+
+
+# ----------------------------------------------------- key composition
+
+def test_cache_key_digest_is_order_independent_and_flip_sensitive():
+    a = CacheKey({"model": "m", "shape": "(4,)", "mesh": "1dev"})
+    b = CacheKey({"mesh": "1dev", "shape": "(4,)", "model": "m"})
+    assert a.digest == b.digest and a.filename() == b.filename()
+    for comp, val in (("model", "m2"), ("shape", "(8,)"),
+                      ("mesh", "2dev")):
+        assert a.replace(**{comp: val}).digest != a.digest
+
+
+def test_flipping_any_key_component_misses_on_disk(tmp_path):
+    cache = ExecutableCache(tmp_path)
+    compiled, x = _tiny_compiled()
+    key = cache.key(model="fp0", shape="(8,)f32", mesh="1dev")
+    assert cache.store(key, compiled)
+    assert cache.load(key) is not None
+    # contract hash, mesh shape, jax version, bucket shape, model —
+    # each flip must miss (and must NOT quarantine the good entry)
+    for comp, val in (("contract", "feedfeedfeedfeed"),
+                      ("mesh", "4dev"), ("jax", "0.0.0"),
+                      ("shape", "(16,)f32"), ("model", "fp1"),
+                      ("salt", "rolled")):
+        assert cache.load(key.replace(**{comp: val})) is None
+    st = cache.stats()
+    assert st["quarantined"] == 0 and st["miss"] == 6
+    assert cache.load(key) is not None       # original still intact
+
+
+def test_round_trip_executes_identically(tmp_path):
+    cache = ExecutableCache(tmp_path)
+    compiled, x = _tiny_compiled()
+    want = np.asarray(compiled(x))
+    key = cache.key(model="rt", shape="(8,)f32")
+    exe, source = cache.load_or_compile(key, lambda: compiled)
+    assert source == "cold" and cache.entries() == 1
+    exe2, source2 = cache.load_or_compile(
+        key, lambda: pytest.fail("hit path must not compile"))
+    assert source2 == "disk"
+    np.testing.assert_array_equal(np.asarray(exe2(x)), want)
+
+
+def test_identical_keys_across_two_processes_hit(tmp_path):
+    """A second process composes the same key (same model fp, shape,
+    mesh, jax, backend, contracts) and its entry hits here — the
+    rollout/restart story in one assertion."""
+    child = f"""
+import sys
+sys.path.insert(0, {str(Path(__file__).resolve().parents[1])!r})
+from mxtpu.cache import ExecutableCache
+import jax, jax.numpy as jnp
+cache = ExecutableCache({str(tmp_path)!r})
+x = jnp.arange(8, dtype=jnp.float32)
+compiled = jax.jit(lambda v: v * 2 + 1).lower(x).compile()
+key = cache.key(model="xproc", shape="(8,)f32", mesh="1dev")
+assert cache.store(key, compiled), "child store failed"
+print(key.digest)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", child], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    child_digest = out.stdout.strip().splitlines()[-1]
+    cache = ExecutableCache(tmp_path)
+    key = cache.key(model="xproc", shape="(8,)f32", mesh="1dev")
+    assert key.digest == child_digest        # same key composition
+    loaded = cache.load(key)
+    assert loaded is not None                # verified cross-process hit
+    _, x = _tiny_compiled()
+    np.testing.assert_array_equal(np.asarray(loaded(x)),
+                                  np.arange(8, dtype=np.float32) * 2 + 1)
+
+
+def test_concurrent_writers_race_cleanly(tmp_path):
+    """N writers hammer the SAME key (separate cache instances — the
+    multi-process shape, minus the fork) while a reader polls: the
+    reader only ever sees nothing or a valid entry, never a torn one,
+    and the survivor loads clean."""
+    compiled, x = _tiny_compiled()
+    want = np.asarray(compiled(x))
+    caches = [ExecutableCache(tmp_path) for _ in range(4)]
+    key = caches[0].key(model="race", shape="(8,)f32")
+    start = threading.Barrier(5)
+    failures = []
+
+    def writer(c):
+        start.wait()
+        for _ in range(5):
+            if not c.store(key, compiled):
+                failures.append("store refused")
+
+    def reader():
+        rc = ExecutableCache(tmp_path)
+        start.wait()
+        for _ in range(20):
+            got = rc.load(key)
+            if got is not None:
+                if not np.array_equal(np.asarray(got(x)), want):
+                    failures.append("torn/wrong entry served")
+        if rc.stats()["quarantined"]:
+            failures.append("reader quarantined a mid-write entry")
+
+    threads = [threading.Thread(target=writer, args=(c,), daemon=True)
+               for c in caches] + [threading.Thread(target=reader,
+                                                    daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not failures, failures
+    assert not any(t.is_alive() for t in threads)
+    final = ExecutableCache(tmp_path).load(key)
+    assert final is not None
+    np.testing.assert_array_equal(np.asarray(final(x)), want)
+    # temp files all consumed by the atomic renames
+    assert not list(Path(tmp_path).glob("*.tmp"))
+
+
+# ----------------------------------------------- scripted cache faults
+
+@pytest.mark.parametrize("fault_cls,reason", [
+    (CorruptEntry, "checksum"),
+    (TruncateEntry, "truncated"),
+    (StaleKey, "stale_key"),
+])
+def test_poisoned_entry_quarantines_never_executes(tmp_path, fault_cls,
+                                                   reason):
+    plan = FaultPlan(fault_cls(at_store=0))
+    cache = ExecutableCache(tmp_path, faults=plan)
+    compiled, x = _tiny_compiled()
+    want = np.asarray(compiled(x))
+    key = cache.key(model="poison", shape="(8,)f32")
+    assert cache.store(key, compiled)        # fault poisons post-commit
+    assert plan.fired == [f"{fault_cls.__name__.lower()}@0"]
+    assert cache.load(key) is None           # never a wrong executable
+    st = cache.stats()
+    assert st["quarantined"] == 1 and st["hit"] == 0
+    qfiles = list((Path(tmp_path) / "quarantine").iterdir())
+    assert len(qfiles) == 1 and f".{reason}." in qfiles[0].name
+    # the recovery: load_or_compile recompiles and the NEXT load hits
+    exe, source = cache.load_or_compile(key, lambda: compiled)
+    assert source == "cold"
+    exe2, source2 = cache.load_or_compile(
+        key, lambda: pytest.fail("recovered entry must hit"))
+    assert source2 == "disk"
+    np.testing.assert_array_equal(np.asarray(exe2(x)), want)
+
+
+def test_read_only_dir_falls_back_without_error(tmp_path):
+    plan = FaultPlan(ReadOnlyDir(from_store=0))
+    cache = ExecutableCache(tmp_path, faults=plan)
+    compiled, x = _tiny_compiled()
+    key = cache.key(model="ro", shape="(8,)f32")
+    exe, source = cache.load_or_compile(key, lambda: compiled)
+    assert source == "cold" and exe is compiled   # plain compile, no raise
+    assert plan.fired == ["readonlydir@0"]
+    assert not cache.writable()              # latched off, no respam
+    st = cache.stats()
+    assert st["fallback"] == 1 and cache.entries() == 0
+    if obs.enabled():
+        kinds = [e["kind"] for e in cache.recorder.events()]
+        assert "fallback" in kinds           # flight-recorder evidence
+    # latched: the next store is refused silently (no second fire)
+    assert not cache.store(key, compiled)
+    assert plan.fired == ["readonlydir@0"]
+
+
+def test_cache_self_check_passes(tmp_path):
+    info = self_check(root=str(tmp_path / "sc"))
+    assert info["serialize_supported"] and info["round_trip"]
+    assert info["poisons"] == 3 and info["read_only_fallback"]
+
+
+def test_default_cache_is_knob_driven(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXTPU_CACHE_DIR", raising=False)
+    assert default_cache() is None           # no root, no persistence
+    monkeypatch.setenv("MXTPU_CACHE_DIR", str(tmp_path))
+    c1 = default_cache()
+    assert c1 is not None and c1.root == Path(tmp_path)
+    assert default_cache() is c1             # per-root singleton
+    monkeypatch.setenv("MXTPU_CACHE_SALT", "v2")
+    c2 = default_cache()
+    assert c2 is not c1 and c2.salt == "v2"  # salt roll = new cache
+    monkeypatch.setenv("MXTPU_CACHE", "0")
+    assert default_cache() is None           # master kill switch
+
+
+# ------------------------------------------------- serving integration
+
+def test_runner_warms_full_ladder_from_disk_zero_compiles(tmp_path):
+    cache = ExecutableCache(tmp_path)
+    donor = _mul_runner(cache=cache)
+    donor.warmup()
+    nbuckets = donor.num_compiled()
+    assert nbuckets == len(donor.buckets()) >= 2
+    assert cache.stats()["store"] == nbuckets
+    x = _payload(3)
+    bucket = donor.bucket_for(1)
+    want = np.asarray(donor.run_raw(donor._pad_stack([x], bucket),
+                                    bucket)[0])
+
+    fresh = ExecutableCache(tmp_path)        # "new process" instance
+    runner = _mul_runner(cache=fresh)
+    assert sorted(runner.cached_buckets()) == sorted(runner.buckets())
+    runner.warm_from_disk()
+    st = fresh.stats()
+    assert st["hit"] == nbuckets and st["store"] == 0  # zero compiles
+    assert runner.num_compiled() == nbuckets
+    got = np.asarray(runner.run_raw(runner._pad_stack([x], bucket),
+                                    bucket)[0])
+    np.testing.assert_array_equal(got, want)
+    assert runner.num_compiled() == nbuckets  # serving added nothing
+
+
+def test_fleet_kill_then_disk_warmed_replacement(tmp_path):
+    """The acceptance scenario: a worker dies (preemption), no donor
+    handoff exists, yet the replacement serves its FIRST request with
+    zero data-path compiles — its whole ladder came off disk via
+    ``add_worker``'s donor-less warm path."""
+    clk = FakeClock()
+    seed = ExecutableCache(tmp_path)
+    with _router(clk) as router:
+        w0 = FleetWorker(_mul_runner(cache=seed), "w0", clock=clk,
+                         max_queue_delay_us=0.0)
+        router.add_worker(w0)
+        w0.runner.warmup()                   # populates the disk cache
+        nbuckets = w0.runner.num_compiled()
+        router.kill("w0")                    # hard preemption, no drain
+
+        fresh = ExecutableCache(tmp_path)
+        w1 = FleetWorker(_mul_runner(cache=fresh), "w1", clock=clk,
+                         max_queue_delay_us=0.0)
+        router.add_worker(w1)                # NO warm_from metadata
+        # the ladder is compiled BEFORE the first request, all off disk
+        assert w1.runner.num_compiled() == nbuckets
+        assert fresh.stats()["hit"] == nbuckets
+        assert fresh.stats()["store"] == 0   # zero data-path compiles
+        reqs = [router.submit(_payload(i), timeout_s=10.0)
+                for i in range(6)]
+        _crank(router, clk, n=4)
+        for i, r in enumerate(reqs):
+            np.testing.assert_allclose(r.result(timeout=0)[0],
+                                       [i, 2.0 * i, 3.0 * i])
+        assert w1.runner.num_compiled() == nbuckets  # still zero
+
+
+def test_fleet_replacement_with_poisoned_cache_recompiles(tmp_path):
+    """Kill → replace where every disk entry was corrupted in the
+    meantime: the replacement quarantines each entry and recompiles —
+    the poisoned executables are NEVER executed, results stay exact."""
+    clk = FakeClock()
+    seed = ExecutableCache(tmp_path)
+    with _router(clk) as router:
+        w0 = FleetWorker(_mul_runner(cache=seed), "w0", clock=clk,
+                         max_queue_delay_us=0.0)
+        router.add_worker(w0)
+        w0.runner.warmup()
+        nbuckets = w0.runner.num_compiled()
+        router.kill("w0")
+        for entry in Path(tmp_path).glob("*.mxc"):
+            poison_corrupt(entry)            # bit-rot while it was down
+
+        fresh = ExecutableCache(tmp_path)
+        w1 = FleetWorker(_mul_runner(cache=fresh), "w1", clock=clk,
+                         max_queue_delay_us=0.0)
+        router.add_worker(w1)
+        st = fresh.stats()
+        assert st["quarantined"] == nbuckets  # every entry caught
+        assert st["hit"] == 0                 # nothing poisoned served
+        assert st["store"] == nbuckets        # recompiled + re-stored
+        assert w1.runner.num_compiled() == nbuckets
+        qdir = Path(tmp_path) / "quarantine"
+        assert len(list(qdir.iterdir())) == nbuckets
+        req = router.submit(_payload(5), timeout_s=10.0)
+        _crank(router, clk, n=2)
+        np.testing.assert_allclose(req.result(timeout=0)[0],
+                                   [5.0, 10.0, 15.0])
+
+
+def test_fleet_threaded_disk_warmed_replacement(tmp_path):
+    """Same replacement story through the threaded router (real
+    threads, real clock): outcome-asserted, not latency-asserted."""
+    seed = ExecutableCache(tmp_path)
+    donor = _mul_runner(cache=seed)
+    donor.warmup()
+    nbuckets = donor.num_compiled()
+    router = FleetRouter(threaded=True, tick_s=0.002, canary=None)
+    with router:
+        fresh = ExecutableCache(tmp_path)
+        w = FleetWorker(_mul_runner(cache=fresh), "w0",
+                        max_queue_delay_us=500.0)
+        router.add_worker(w)
+        assert w.runner.num_compiled() == nbuckets
+        assert fresh.stats() == {"hit": nbuckets, "miss": 0,
+                                 "store": 0, "fallback": 0,
+                                 "quarantined": 0}
+        reqs = [router.submit(_payload(i % 5), timeout_s=10.0)
+                for i in range(8)]
+        for i, r in enumerate(reqs):
+            v = i % 5
+            np.testing.assert_allclose(r.result(timeout=10.0)[0],
+                                       [v, 2.0 * v, 3.0 * v])
+        assert w.runner.num_compiled() == nbuckets
+
+
+def test_autoscaler_scale_up_warms_from_disk_cache(tmp_path):
+    """No live donor, no cached handoff — the scale-up replica warms
+    from the persistent cache and the ``scale_up`` flight event says
+    so (``donor="disk_cache"``)."""
+    obs.reset()
+    clk = FakeClock()
+    seed = ExecutableCache(tmp_path)
+    r = _router(clk)
+    w0 = FleetWorker(_mul_runner(cache=seed), "w0", clock=clk,
+                     max_queue_delay_us=0.0)
+    r.add_worker(w0)
+    w0.runner.warmup()                       # disk holds the ladder
+    nbuckets = w0.runner.num_compiled()
+    made = []
+
+    def make_worker(name):
+        w = FleetWorker(_mul_runner(cache=ExecutableCache(tmp_path)),
+                        name, clock=clk, max_queue_delay_us=0.0)
+        made.append(w)
+        return w
+
+    scaler = Autoscaler(r, make_worker, min_workers=1, max_workers=2,
+                        up_depth=3.0, down_depth=0.5, breach_ticks=2,
+                        cooldown_s=0.1)
+    r.add_controller(scaler.tick)
+    r.kill("w0")                             # preempted; NO handoff
+    reqs = [r.submit(_payload(i), timeout_s=30.0) for i in range(6)]
+    for _ in range(20):
+        clk.advance(0.05)
+        r.tick(clk())
+        if made:
+            break
+    assert made, "floor repair never fired"
+    assert made[0].runner.num_compiled() == nbuckets  # warm, off disk
+    ups = [e for e in scaler.recorder.events()
+           if e["kind"] == "scale_up"]
+    assert ups and ups[0]["donor"] == "disk_cache"
+    _crank(r, clk, n=6)
+    for i, req in enumerate(reqs):
+        np.testing.assert_allclose(req.result(timeout=0)[0],
+                                   [i, 2.0 * i, 3.0 * i])
+    assert made[0].runner.num_compiled() == nbuckets
+    r.close()
+
+
+# ------------------------------------------------ training integration
+
+def test_train_step_second_build_hits_disk_bit_identical(tmp_path):
+    import mxtpu as mx
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import loss as gloss, nn
+
+    cache = ExecutableCache(tmp_path)
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+        net.initialize(init="xavier")
+        return parallel.build_train_step(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.2, "momentum": 0.9}, cache=cache)
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(32, 2).astype("float32")
+    y = (rng.rand(32) > 0.5).astype("int64")
+    losses_cold = build().run_steps(nd.array(X), nd.array(y),
+                                    steps=4).asnumpy()
+    assert cache.stats()["store"] == 1
+    losses_warm = build().run_steps(nd.array(X), nd.array(y),
+                                    steps=4).asnumpy()
+    st = cache.stats()
+    assert st["hit"] == 1 and st["store"] == 1  # second build off disk
+    np.testing.assert_array_equal(losses_cold, losses_warm)

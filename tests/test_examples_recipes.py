@@ -92,11 +92,13 @@ def test_train_imagenet_recipe(caplog):
     _run("train_imagenet.py",
          ["--network", "resnet18_v1", "--image-shape", "3,32,32",
           "--num-classes", "4", "--num-examples", "512",
-          "--num-epochs", "3", "--batch-size", "64",
+          "--num-epochs", "2", "--batch-size", "64",
           "--lr", "0.02"])
     msgs = [r.message for r in caplog.records]
+    # epoch 1 reaches 1.0 train accuracy on this synthetic set (epoch 0
+    # is ~0.75); two epochs keep the convergence signal at half the cost
     accs = [float(m.split("=")[1]) for m in msgs
-            if m.startswith("Epoch[2] Train-accuracy")]
+            if m.startswith("Epoch[1] Train-accuracy")]
     assert accs and accs[-1] > 0.5, msgs[-6:]
 
 

@@ -450,6 +450,60 @@ def test_dtype_hygiene_scoped_to_library_code():
         _ctx(src, rel="mxtpu/fake.py")) is True
 
 
+# ----------------------------------------------------- raw-deserialize
+
+def test_raw_deserialize_flags_pickle_and_executable_load():
+    ctx = _ctx("""
+        import pickle, marshal
+        from jax.experimental import serialize_executable
+
+        def load(path):
+            with open(path, "rb") as f:
+                a = pickle.load(f)
+            b = pickle.loads(open(path, "rb").read())
+            c = marshal.loads(open(path, "rb").read())
+            d = serialize_executable.deserialize_and_load(a, b, c)
+            return d
+    """)
+    found = R.RawDeserialize().check(ctx)
+    assert _names(found) == ["raw-deserialize"] * 4
+    msgs = " ".join(f.message for f in found)
+    assert "pickle.load" in msgs
+    assert "deserialize_and_load" in msgs
+    assert "WRONG program" in msgs
+
+
+def test_raw_deserialize_pragma_waives():
+    ctx = _ctx("""
+        import pickle
+
+        def load(blob):
+            return pickle.loads(blob)  # mxlint: disable=raw-deserialize (in-process bytes)
+    """)
+    found = [f for f in R.RawDeserialize().check(ctx)
+             if not ctx.suppressed(f.rule, f.line)]
+    assert found == []
+
+
+def test_raw_deserialize_cache_module_is_the_sanctioned_door():
+    src = """
+        import pickle
+        def load(blob):
+            return pickle.loads(blob)
+    """
+    # the checksum-verified loader in mxtpu/cache.py is THE one place
+    # allowed to revive disk bytes; tests stay exempt like the other
+    # source-hygiene rules
+    assert R.RawDeserialize().applies(
+        _ctx(src, rel="mxtpu/cache.py")) is False
+    assert R.RawDeserialize().applies(
+        _ctx(src, rel="tests/test_fake.py")) is False
+    assert R.RawDeserialize().applies(
+        _ctx(src, rel="mxtpu/serving/runner.py")) is True
+    assert R.RawDeserialize().applies(
+        _ctx(src, rel="tools/fake.py")) is True
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_fingerprint_survives_line_moves(tmp_path):

@@ -8,6 +8,9 @@ contracts.
   committed ``contracts/`` lockfiles), then
 * ``python -m mxtpu.obs --self-check`` (the observability layer's
   zero-overhead-when-off + exposition round-trip contract), then
+* ``python -m mxtpu.cache --self-check`` (the persistent compile
+  cache's round-trip, key-miss, poison-quarantine and read-only
+  fallback probes on a throwaway root), then
 * ``python -m tools.mxrace --check`` (lock-order graph vs the
   committed ``contracts/lockorder.json`` + guarded-by hygiene), then
 * ``python -m tools.mxprec --check`` (pre-optimization dtype flow vs
@@ -32,6 +35,7 @@ STAGES = (
     ("mxlint", ("-m", "tools.mxlint", "--check"), True),
     ("hlocheck", ("-m", "tools.hlocheck", "--check"), True),
     ("obs-self-check", ("-m", "mxtpu.obs", "--self-check"), False),
+    ("cache-self-check", ("-m", "mxtpu.cache", "--self-check"), False),
     ("mxrace", ("-m", "tools.mxrace", "--check"), True),
     ("mxprec", ("-m", "tools.mxprec", "--check"), True),
 )

@@ -731,6 +731,51 @@ class DtypeHygiene(Rule):
         return sorted(out, key=lambda f: f.line)
 
 
+class RawDeserialize(Rule):
+    """Disk artifacts reach the process through ONE verified door
+    (ISSUE 13): ``mxtpu/cache.py``'s loader checksums and
+    key-revalidates every entry before ``pickle.loads`` /
+    ``deserialize_and_load`` touch the bytes.  Raw
+    ``pickle.load(s)`` / ``marshal.load(s)`` /
+    ``serialize_executable.deserialize_and_load`` anywhere else in the
+    shipped tree is a silent wrong-executable / arbitrary-code hazard
+    the cache module exists to fence.  Waive a deliberate site (an
+    in-process round-trip of bytes this process just produced, a
+    checkpoint format with its own framing) with
+    ``# mxlint: disable=raw-deserialize`` and say why."""
+
+    name = "raw-deserialize"
+    _LOADERS = {"pickle.load", "pickle.loads", "cPickle.load",
+                "cPickle.loads", "marshal.load", "marshal.loads"}
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return super().applies(ctx) and ctx.rel != "mxtpu/cache.py"
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None:
+                continue
+            if d in self._LOADERS:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"raw `{d}` on disk bytes outside mxtpu/cache.py "
+                    f"— route persisted artifacts through the cache's "
+                    f"checksum-verified loader, or waive with a "
+                    f"pragma stating why this site is safe"))
+            elif d.endswith("deserialize_and_load"):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "`deserialize_and_load` outside mxtpu/cache.py — "
+                    "loading an unverified executable can silently "
+                    "run the WRONG program; only the cache's "
+                    "verified loader may revive compiled payloads"))
+        return out
+
+
 # ----------------------------------------------------------------------
 # repo-level checks
 # ----------------------------------------------------------------------
@@ -789,7 +834,7 @@ def file_rules() -> List[Rule]:
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
             HloRawAssert(), ObsRegistry(), ThreadHygiene(),
-            DtypeHygiene()]
+            DtypeHygiene(), RawDeserialize()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:
