@@ -108,8 +108,11 @@ def main():
             t.join()
         wall = time.perf_counter() - t0
 
-        snap = server.stats("bert")
+        # close BEFORE the snapshot: workers account a batch after
+        # delivering its results, so joining them first makes the
+        # final tally exact (completed == every delivered request)
         server.close()
+        snap = server.stats("bert")
         total = args.clients * args.requests
         print(f"\n{total} requests from {args.clients} concurrent "
               f"clients in {wall:.2f}s "

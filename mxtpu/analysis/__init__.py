@@ -50,7 +50,7 @@ __all__ = [
     "audit_mode", "dtypeflow", "dtype_summary", "cast_flows",
     "hazard_findings", "format_hazard", "master_weight_findings",
     "program_ledger", "lowered_text", "lowered_summary",
-    "prec_audit_mode",
+    "prec_audit_mode", "audit_stamp", "needs_reaudit",
 ]
 
 
@@ -141,6 +141,25 @@ def audit_mode() -> int:
 def prec_audit_mode() -> int:
     """``MXTPU_PREC_AUDIT``: 0 off (default), 1 warn, 2 raise."""
     return _knob_mode("MXTPU_PREC_AUDIT")
+
+
+def audit_stamp() -> Dict[str, int]:
+    """This process's audit modes as the persistent-cache entry meta
+    (``mxtpu.cache``): the knobs are per-process, so a disk entry
+    records how strictly its WRITER audited and a reader with
+    stricter modes re-audits the reloaded program instead of trusting
+    the writer's (possibly absent) cold-birth audit."""
+    return {"hlo_audit": audit_mode(), "prec_audit": prec_audit_mode()}
+
+
+def needs_reaudit(meta: Dict) -> bool:
+    """True when this process audits more strictly than the writer of
+    a cache entry stamped with ``meta`` did (missing/legacy stamps
+    count as unaudited)."""
+    def _m(v) -> int:
+        return v if isinstance(v, int) else 0
+    return (audit_mode() > _m(meta.get("hlo_audit"))
+            or prec_audit_mode() > _m(meta.get("prec_audit")))
 
 
 def maybe_audit(compiled, label: str = "",

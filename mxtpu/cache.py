@@ -229,24 +229,32 @@ class ExecutableCache:
         return self.path_for(key).is_file()
 
     # -- load (verify-or-quarantine) ------------------------------------
-    def load(self, key: CacheKey):
+    def load(self, key: CacheKey, *, with_meta: bool = False):
         """The checksum-verified loader: returns the loaded executable
         or None (missing / invalid / undeserializable — invalid
-        entries are quarantined, never returned)."""
+        entries are quarantined, never returned).  ``with_meta=True``
+        returns ``(executable_or_None, meta)`` instead, where ``meta``
+        is the writer's :meth:`store` sidecar dict (``{}`` on a miss)
+        — how callers learn e.g. which audit modes the writer process
+        ran, knobs being per-process."""
+        compiled, meta = self._load(key)
+        return (compiled, meta) if with_meta else compiled
+
+    def _load(self, key: CacheKey) -> Tuple[Any, Dict[str, Any]]:
         path = self.path_for(key)
         try:
             blob = path.read_bytes()
         except FileNotFoundError:
             self._bump("miss")
-            return None
+            return None, {}
         except OSError as e:
             self._fallback("read_error", key, err=e)
-            return None
+            return None, {}
         try:
-            payload = self._verify(blob, key)
+            payload, header = self._verify(blob, key)
         except _EntryInvalid as e:
             self._quarantine(path, e.reason, key, detail=str(e))
-            return None
+            return None, {}
         try:
             import pickle
             from jax.experimental.serialize_executable import \
@@ -258,17 +266,20 @@ class ExecutableCache:
                                             out_tree)
         except Exception as e:  # jax/backend mismatch survives checksum
             self._quarantine(path, "deserialize", key, detail=repr(e))
-            return None
+            return None, {}
         self._bump("hit")
         if self._obs:
             self.recorder.record("hit", digest=key.digest[:12],
                                  model=key.components.get("model",
                                                           "")[:16])
-        return compiled
+        meta = header.get("meta")
+        return compiled, meta if isinstance(meta, dict) else {}
 
-    def _verify(self, blob: bytes, key: CacheKey) -> bytes:
-        """Structural + checksum + key revalidation; returns the
-        payload bytes or raises :class:`_EntryInvalid`."""
+    def _verify(self, blob: bytes,
+                key: CacheKey) -> Tuple[bytes, Dict[str, Any]]:
+        """Structural + checksum + key revalidation; returns
+        ``(payload bytes, header dict)`` or raises
+        :class:`_EntryInvalid`."""
         if not blob.startswith(_MAGIC):
             raise _EntryInvalid("magic", "bad magic")
         off = len(_MAGIC)
@@ -304,13 +315,18 @@ class ExecutableCache:
                 "stale_key",
                 f"entry key {header.get('key')} != expected "
                 f"{key.components}")
-        return payload
+        return payload, header
 
     # -- store (crash-safe) ---------------------------------------------
-    def store(self, key: CacheKey, compiled) -> bool:
+    def store(self, key: CacheKey, compiled, *,
+              meta: Optional[Dict[str, Any]] = None) -> bool:
         """Serialize + commit one entry crash-safely: temp file in the
         cache root, fsync, atomic ``os.replace``.  Returns False (and
-        records the degradation) instead of raising on any trouble."""
+        records the degradation) instead of raising on any trouble.
+        ``meta`` is a small JSON-able sidecar stored in the header and
+        handed back by ``load(with_meta=True)`` — NOT part of the key
+        (an entry written under different meta still hits); callers
+        use it for per-process facts like the writer's audit modes."""
         with self._lock:
             if not self._write_ok:
                 return False
@@ -329,6 +345,7 @@ class ExecutableCache:
              "digest": key.digest,
              "payload_sha256": hashlib.sha256(payload).hexdigest(),
              "payload_len": len(payload),
+             "meta": dict(meta or {}),
              "created": time.time(), "writer_pid": os.getpid()},
             sort_keys=True).encode()
         blob = (_MAGIC + f"{len(header):0{_LEN_WIDTH}d}\n".encode()
@@ -378,16 +395,18 @@ class ExecutableCache:
         return True
 
     def load_or_compile(self, key: CacheKey,
-                        compile_fn: Callable[[], Any]
+                        compile_fn: Callable[[], Any], *,
+                        meta: Optional[Dict[str, Any]] = None
                         ) -> Tuple[Any, str]:
         """``(executable, source)`` where source is ``"disk"`` (a
         verified cache hit) or ``"cold"`` (compiled now; stored for
-        the next process if the cache is writable)."""
+        the next process if the cache is writable, with ``meta`` as
+        the entry's header sidecar)."""
         compiled = self.load(key)
         if compiled is not None:
             return compiled, "disk"
         compiled = compile_fn()
-        self.store(key, compiled)
+        self.store(key, compiled, meta=meta)
         return compiled, "cold"
 
     # -- failure bookkeeping --------------------------------------------

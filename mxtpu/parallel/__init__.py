@@ -660,38 +660,48 @@ class TrainStep:
             # lowered with, and AOT executables reject input shardings
             # that drift between steps.
             # ISSUE 13: load-or-compile through the persistent cache.
-            # A verified disk hit skips the XLA compile; the aux
-            # structure the lowering trace would have discovered
-            # comes from eval_shape instead (tracing only, no device
-            # work, no compile).
+            # The lowering trace does double duty: it is the aux
+            # discovery pass AND the cache fingerprint — the lowered
+            # StableHLO text IS the traced program, so two nets with
+            # identical container class and param signatures but
+            # different computations (relu vs tanh, a loss built with
+            # different flags, distinct lambdas) can never share a
+            # key.  A verified disk hit skips only the XLA compile.
             lower_args = (train_vals, frozen_vals, self._opt_state,
                           jax.random.key_data(key), zeros, zeros,
                           x_raw, y_raw)
             t0 = _prof._now_us()
-            source, ckey, loaded = "cold", None, None
+            lowered = fitted.lower(*lower_args)
+            source, ckey, loaded, cmeta = "cold", None, None, {}
             if self._cache is not None:
-                ckey = self._train_cache_key(train_vals, frozen_vals,
-                                             x_raw, y_raw)
-                loaded = self._cache.load(ckey)
+                ckey = self._train_cache_key(lowered, x_raw, y_raw)
+                loaded, cmeta = self._cache.load(ckey, with_meta=True)
             if loaded is not None:
                 source = "disk"
                 fn = loaded
-                jax.eval_shape(step, *lower_args)   # aux discovery
             else:
-                fn = fitted.lower(*lower_args).compile()
-                if ckey is not None:
-                    self._cache.store(ckey, fn)
+                fn = lowered.compile()
             mem = _mem_stats(fn)
             self._last_mem = mem
+            from mxtpu import analysis
+            if source == "cold":
+                # audit (which may raise under MXTPU_HLO_AUDIT=2)
+                # runs BEFORE the store: a failing program never
+                # reaches disk
+                analysis.maybe_audit(fn, label="TrainStep", mem=mem)
+                if ckey is not None:
+                    self._cache.store(ckey, fn,
+                                      meta=analysis.audit_stamp())
+            elif analysis.needs_reaudit(cmeta):
+                # audit knobs are per-process: the entry's writer
+                # audited less strictly than this process asks for,
+                # so the reloaded program is re-audited here
+                analysis.maybe_audit(fn, label="TrainStep", mem=mem)
             if self._obs:
                 if source == "disk":
                     self._m_cache_hit.inc()
                 self._m_compile_s[source].observe(
                     (_prof._now_us() - t0) / 1e6)
-            if source == "cold":
-                # disk hits reload a program audited at its cold birth
-                from mxtpu import analysis
-                analysis.maybe_audit(fn, label="TrainStep", mem=mem)
         else:
             # learn the aux structure without device work
             jax.eval_shape(step, train_vals, frozen_vals,
@@ -841,42 +851,35 @@ class TrainStep:
                str(y_raw.dtype))
         return x_raw, y_raw, sig
 
-    def _train_cache_key(self, train_vals, frozen_vals, x_raw, y_raw):
+    def _train_cache_key(self, lowered, x_raw, y_raw):
         """Persistent-cache key of the AOT one-step program (ISSUE
-        13): a fingerprint of WHAT the step compiles — net class +
-        param signatures, optimizer rule + scalar hyperparams, loss,
-        precision/donation flags, ZeRO mode — crossed with the batch
-        signature and the mesh topology.  Weight/optimizer VALUES are
-        runtime arguments and deliberately excluded; the environment
-        components (jax version, backend, contract hash, salt) are
-        added by ``ExecutableCache.key``."""
+        13): the model component hashes the LOWERED StableHLO text —
+        the traced computation itself, the same program-is-the-
+        fingerprint rule ModelRunner applies to its symbol graph — so
+        everything that shapes the compiled step (architecture and
+        activations, loss flags/lambdas, optimizer rule and baked-in
+        hyperparams, precision/donation, ZeRO layout) is fingerprinted
+        by construction; class names and param signatures alone could
+        alias two different programs.  Weight/optimizer VALUES enter
+        the text only as shapes (they are runtime arguments), and
+        debug locations stay off (``as_text()`` default) so the text
+        is checkout-independent.  The environment components (jax
+        version, backend, contract hash, salt) are added by
+        ``ExecutableCache.key``."""
         import hashlib
-        import json as _json
-        opt = self.optimizer
-        opt_desc = {k: v for k, v in sorted(vars(opt).items())
-                    if isinstance(v, (bool, int, float, str))}
-        blob = _json.dumps({
-            "net": type(self.net).__name__,
-            "train": [[list(v.shape), str(v.dtype)]
-                      for v in train_vals],
-            "frozen": [[list(v.shape), str(v.dtype)]
-                       for v in frozen_vals],
-            "optimizer": [type(opt).__name__, opt_desc],
-            "loss": getattr(self.loss_fn, "__qualname__",
-                            type(self.loss_fn).__name__),
-            "compute_dtype": str(self.compute_dtype),
-            "cast_batch": self.cast_batch, "donate": self.donate,
-            "zero": self.zero, "batch_axis": self.batch_axis,
-            "dp_axis": self.dp_axis,
-        }, sort_keys=True)
+        prog = hashlib.sha256(
+            lowered.as_text().encode()).hexdigest()[:24]
         mesh = "none" if self.mesh is None else \
             str(sorted(self.mesh.shape.items()))
         shape = str(((tuple(x_raw.shape), str(x_raw.dtype)),
                      (tuple(y_raw.shape), str(y_raw.dtype))))
+        # net/opt class names ride along as debuggable context in the
+        # entry header (the program hash already subsumes them)
         return self._cache.key(
-            model=hashlib.sha256(blob.encode()).hexdigest()[:24],
-            shape=shape, mesh=mesh,
-            device=getattr(jax.devices()[0], "device_kind", "unknown"))
+            model=prog, shape=shape, mesh=mesh,
+            device=getattr(jax.devices()[0], "device_kind", "unknown"),
+            net=type(self.net).__name__,
+            opt=type(self.optimizer).__name__)
 
     def _entry_for(self, x_raw, y_raw, sig, key):
         entry = self._compiled.get(sig)

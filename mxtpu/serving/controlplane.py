@@ -251,17 +251,16 @@ class Autoscaler:
         worker = self._make_worker(f"{self.name_prefix}{seq}")
         # ``add_worker`` warms from the donor metadata when present,
         # else from the persistent compile cache (ISSUE 13) when that
-        # holds ladder entries; record which path fired so operators
-        # can tell a disk-warmed scale-up from a cold one.
+        # holds ladder entries; its return value says which path
+        # ACTUALLY fired (no second cache probe, no label that can
+        # disagree with what was warmed).
+        warmed = self._router.add_worker(worker, warm_from=meta)
         if donor is not None:
             warm_src = donor.name
         elif meta is not None:
             warm_src = "last_handoff"
-        elif worker.runner.cached_buckets():
-            warm_src = "disk_cache"
         else:
-            warm_src = None
-        self._router.add_worker(worker, warm_from=meta)
+            warm_src = warmed  # "disk_cache" or None (cold)
         self._router.stats.bump("scale_ups")
         self.recorder.record(
             "scale_up", worker=worker.name, donor=warm_src,

@@ -565,7 +565,8 @@ class FleetRouter:
 
     # -- fleet membership --------------------------------------------------
     def add_worker(self, worker: FleetWorker,
-                   warm_from: Optional[Dict[str, Any]] = None) -> None:
+                   warm_from: Optional[Dict[str, Any]] = None
+                   ) -> Optional[str]:
         """Attach a worker.  ``warm_from`` is a donor's
         :meth:`FleetWorker.handoff` — the replacement pre-compiles the
         donor's bucket working set before its first canary.  With no
@@ -573,11 +574,18 @@ class FleetRouter:
         compile cache (ISSUE 13) are warmed from disk instead, so a
         replacement after preemption still serves its first request
         with zero data-path compiles.  All workers must share the
-        bucket ladder (same batching groups)."""
+        bucket ladder (same batching groups).  Returns how the worker
+        was actually warmed — ``"donor"``, ``"disk_cache"``, or None
+        (cold) — so callers (the Autoscaler) can label their events
+        without re-probing the cache."""
         if warm_from is not None:
             worker.runner.warm_from(warm_from)
-        elif worker.runner.cached_buckets():
-            worker.runner.warm_from_disk()
+            warmed = "donor"
+        else:
+            # one ladder probe: warm_from_disk() returns the buckets
+            # it warmed (empty when there is no cache or no entries)
+            warmed = "disk_cache" if worker.runner.warm_from_disk() \
+                else None
         with self._lock:
             if self._closed:
                 raise WorkerLost("serving: fleet router is closed")
@@ -598,6 +606,7 @@ class FleetRouter:
             self._next_canary[worker.name] = self._clock()
         if self._threaded:
             worker.start()
+        return warmed
 
     def drain(self, name: str, now: Optional[float] = None
               ) -> Dict[str, Any]:

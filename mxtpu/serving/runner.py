@@ -160,7 +160,9 @@ class ModelRunner:
         self._obs = obs.enabled()
         self._m_compile = obs.counter(
             "mxtpu_serving_compile_total",
-            "Bucket executables compiled (jit cache misses).",
+            "Bucket executables actually compiled by XLA (cold "
+            "builds only — disk-cache hits count in "
+            "mxtpu_compile_cache_hit_total instead).",
             labels=("entry",)).labels(entry=self._entry_label)
         # source=cold|disk makes the cold-vs-warm split machine-
         # readable (ISSUE 13 satellite): "cold" paid XLA, "disk"
@@ -376,10 +378,11 @@ class ModelRunner:
             # A verified disk hit skips tracing AND compilation; any
             # corrupt/truncated/stale entry quarantines inside
             # load() and we fall through to the cold path.
-            compiled, source, ckey = None, "cold", None
+            from mxtpu import analysis
+            compiled, source, ckey, cmeta = None, "cold", None, {}
             if self._cache is not None:
                 ckey = self._cache_key(bucket)
-                compiled = self._cache.load(ckey)  # mxlint: sync-point — disk, pre-serving
+                compiled, cmeta = self._cache.load(ckey, with_meta=True)  # mxlint: sync-point — disk, pre-serving
                 if compiled is not None:
                     source = "disk"
             if compiled is None:
@@ -390,16 +393,36 @@ class ModelRunner:
                         donate_argnums=(0,) if self._donate else ())
                     compiled = jitted.lower(in_structs,
                                             self._param_structs).compile()
+                # MXTPU_HLO_AUDIT: static hygiene pass over every
+                # bucket executable as it is born (warmup() therefore
+                # audits the whole ladder) — no host transfers, no f64
+                # creep, no layout-bracketed custom calls.  Audit
+                # BEFORE the store so a program that fails a raising
+                # audit never reaches disk.
+                analysis.maybe_audit(compiled,
+                                     label=f"ModelRunner{bucket}")
                 if ckey is not None:
-                    # serialize for the next process; failures degrade
-                    # to a flight-recorder event inside store()
-                    self._cache.store(ckey, compiled)
+                    # serialize for the next process, stamped with
+                    # this process's audit modes; failures degrade to
+                    # a flight-recorder event inside store()
+                    self._cache.store(ckey, compiled,
+                                      meta=analysis.audit_stamp())
+            elif analysis.needs_reaudit(cmeta):
+                # the audit knobs are per-process: the writer audited
+                # less strictly than this process asks for (or not at
+                # all), so the reloaded program is audited here
+                analysis.maybe_audit(compiled,
+                                     label=f"ModelRunner{bucket}")
             self.compile_seconds[bucket] = time.perf_counter() - t0
             entry = {"compiled": compiled, "in_structs": in_structs}
             self._entries[bucket] = entry
             if self._obs:
-                self._m_compile.inc()
-                if source == "disk":
+                if source == "cold":
+                    # actual XLA compiles only — disk hits are entry
+                    # builds but not compiles (dashboards read this
+                    # as compile volume)
+                    self._m_compile.inc()
+                else:
                     self._m_cache_hit.inc()
                 self._m_compile_s[source].observe(
                     self.compile_seconds[bucket])
@@ -407,15 +430,6 @@ class ModelRunner:
                     "compile_miss", entry=self._entry_label,
                     bucket=str(bucket), source=source,
                     seconds=round(self.compile_seconds[bucket], 4))
-            if source == "cold":
-                # MXTPU_HLO_AUDIT: static hygiene pass over every
-                # bucket executable as it is born (warmup() therefore
-                # audits the whole ladder) — no host transfers, no f64
-                # creep, no layout-bracketed custom calls.  Disk hits
-                # reload a program that was audited at its cold birth.
-                from mxtpu import analysis
-                analysis.maybe_audit(compiled,
-                                     label=f"ModelRunner{bucket}")
             return entry
 
     def warmup(self, buckets: Optional[Sequence[Tuple]] = None

@@ -231,6 +231,9 @@ class InferenceServer:
         batch axis).  Returns a future; raises ServerBusy under
         backpressure.  ``timeout_s`` is the request deadline — expiry
         yields RequestTimeout, never a stale result."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("serving: server is closed")
         ep = self._endpoint(name, version)
         r0 = ep.runners[0]
         if seq_len is None and r0.seq_buckets is not None:
@@ -278,11 +281,18 @@ class InferenceServer:
         return {f"{n}:v{v}": self.stats(n, v) for n, v in items}
 
     def close(self) -> None:
+        """Stop every endpoint's workers and fail anything still
+        queued.  The registry stays readable: workers record a batch's
+        stats AFTER delivering its results, so a snapshot taken while
+        clients are unblocking can run ahead of the tally — ``stats()``
+        after ``close()`` (which joins the workers) is the consistent
+        final reading."""
         with self._lock:
+            if self._closed:
+                return
             self._closed = True
             eps = [ep for vs in self._endpoints.values()
                    for ep in vs.values()]
-            self._endpoints.clear()
         for ep in eps:
             ep.stop()
 

@@ -123,6 +123,28 @@ def test_round_trip_executes_identically(tmp_path):
     np.testing.assert_array_equal(np.asarray(exe2(x)), want)
 
 
+def test_store_meta_round_trips(tmp_path):
+    """The entry-header meta sidecar (writer audit stamp): stored at
+    store(), returned by load(with_meta=True), NOT part of the key —
+    and a miss hands back an empty dict, never None."""
+    cache = ExecutableCache(tmp_path)
+    compiled, x = _tiny_compiled()
+    key = cache.key(model="meta", shape="(8,)f32")
+    assert cache.store(key, compiled,
+                       meta={"hlo_audit": 2, "prec_audit": 0})
+    loaded, meta = cache.load(key, with_meta=True)
+    assert loaded is not None
+    assert meta == {"hlo_audit": 2, "prec_audit": 0}
+    missed, meta2 = cache.load(key.replace(model="nope"),
+                               with_meta=True)
+    assert missed is None and meta2 == {}
+    # meta is a sidecar, not a key component: rewriting the entry
+    # under different meta still hits the same key
+    assert cache.store(key, compiled, meta={"hlo_audit": 0})
+    _, meta3 = cache.load(key, with_meta=True)
+    assert meta3 == {"hlo_audit": 0}
+
+
 def test_identical_keys_across_two_processes_hit(tmp_path):
     """A second process composes the same key (same model fp, shape,
     mesh, jax, backend, contracts) and its entry hits here — the
@@ -313,7 +335,8 @@ def test_fleet_kill_then_disk_warmed_replacement(tmp_path):
         fresh = ExecutableCache(tmp_path)
         w1 = FleetWorker(_mul_runner(cache=fresh), "w1", clock=clk,
                          max_queue_delay_us=0.0)
-        router.add_worker(w1)                # NO warm_from metadata
+        # NO warm_from metadata — add_worker reports the disk path
+        assert router.add_worker(w1) == "disk_cache"
         # the ladder is compiled BEFORE the first request, all off disk
         assert w1.runner.num_compiled() == nbuckets
         assert fresh.stats()["hit"] == nbuckets
@@ -386,6 +409,50 @@ def test_fleet_threaded_disk_warmed_replacement(tmp_path):
         assert w.runner.num_compiled() == nbuckets
 
 
+def test_disk_hit_reaudits_when_writer_audited_less(tmp_path,
+                                                    monkeypatch):
+    """Regression (review): ``MXTPU_HLO_AUDIT`` is a per-process
+    knob.  Entries written by a process with auditing OFF carry that
+    fact in their header stamp, and a process with auditing ON that
+    warms from disk re-audits each reloaded program; a reader whose
+    modes are no stricter than the writer's trusts the cold-birth
+    audit and skips the pass."""
+    from mxtpu import analysis
+
+    calls = []
+    real = analysis.maybe_audit
+
+    def spy(compiled, label="", mem=None):
+        calls.append(label)
+        return real(compiled, label=label, mem=mem)
+
+    monkeypatch.setattr(analysis, "maybe_audit", spy)
+
+    monkeypatch.delenv("MXTPU_HLO_AUDIT", raising=False)
+    monkeypatch.delenv("MXTPU_PREC_AUDIT", raising=False)
+    writer = _mul_runner(cache=ExecutableCache(tmp_path))
+    writer.warmup()                          # stamped hlo_audit=0
+    calls.clear()
+
+    monkeypatch.setenv("MXTPU_HLO_AUDIT", "1")
+    reader = _mul_runner(cache=ExecutableCache(tmp_path))
+    warmed = reader.warm_from_disk()
+    assert len(warmed) == len(reader.buckets())
+    # every disk hit was re-audited (writer never audited them)
+    assert len(calls) == len(reader.buckets())
+
+    # a writer that audits at the reader's level satisfies the stamp:
+    # its entries are trusted, no re-audit fires on the hit
+    for f in Path(tmp_path).glob("*.mxc"):
+        f.unlink()
+    w2 = _mul_runner(cache=ExecutableCache(tmp_path))
+    w2.warmup()                              # stamped hlo_audit=1
+    calls.clear()
+    r2 = _mul_runner(cache=ExecutableCache(tmp_path))
+    assert len(r2.warm_from_disk()) == len(r2.buckets())
+    assert calls == []                       # cold-birth audit trusted
+
+
 def test_autoscaler_scale_up_warms_from_disk_cache(tmp_path):
     """No live donor, no cached handoff — the scale-up replica warms
     from the persistent cache and the ``scale_up`` flight event says
@@ -432,6 +499,42 @@ def test_autoscaler_scale_up_warms_from_disk_cache(tmp_path):
 
 
 # ------------------------------------------------ training integration
+
+def test_train_step_same_signature_different_program_misses(tmp_path):
+    """Regression (review): two nets with the same container class
+    and IDENTICAL param shapes/dtypes but different computations
+    (relu vs tanh activations) must never share a TrainStep cache
+    entry — the key fingerprints the lowered program itself, so the
+    second build is a clean miss (own store), never a silent
+    wrong-gradient hit; rebuilding the same program still hits."""
+    import mxtpu as mx
+    from mxtpu import nd, parallel
+    from mxtpu.gluon import loss as gloss, nn
+
+    cache = ExecutableCache(tmp_path)
+
+    def build(act):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation=act), nn.Dense(2))
+        net.initialize(init="xavier")
+        return parallel.build_train_step(
+            net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.2}, cache=cache)
+
+    rng = np.random.RandomState(11)
+    X = nd.array(rng.randn(8, 2).astype("float32"))
+    y = nd.array((rng.rand(8) > 0.5).astype("int64"))
+    build("relu")(X, y)
+    assert cache.stats() == {"hit": 0, "miss": 1, "store": 1,
+                             "fallback": 0, "quarantined": 0}
+    build("tanh")(X, y)                      # same shapes, same classes
+    st = cache.stats()
+    assert st["store"] == 2 and st["hit"] == 0   # program differs: miss
+    build("tanh")(X, y)                      # identical program: hit
+    st = cache.stats()
+    assert st["hit"] == 1 and st["store"] == 2
+
 
 def test_train_step_second_build_hits_disk_bit_identical(tmp_path):
     import mxtpu as mx
