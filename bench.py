@@ -46,12 +46,29 @@ and the process always exits 0 inside the window.  The pipeline row
 additionally self-limits: repeats stop when its own slice of the
 budget is spent.  Stale ``mxtpu_bench_rec_*`` temp dirs from killed
 runs are swept at startup.
+
+Two ISSUE 14 hardenings close the rc=124 class at the source:
+``JAX_PLATFORMS`` is pinned to ``cpu`` when unset BEFORE jax loads
+(r05's experimental axon plugin hung device discovery at import —
+earlier than any deadline logic), and a ``SIGALRM`` at the wall
+budget flushes the partial record (never-ran rows as
+``{"skipped": "budget"}``) and exits 0 even if a single row hangs
+straight through its estimate.
 """
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
+
+# r05 post-mortem (BENCH_r05.json rc=124, tail shows the experimental
+# `axon` jax plugin initializing): with JAX_PLATFORMS unset, device
+# discovery probes every registered plugin and a dead axon tunnel
+# hangs the process at import — before any deadline logic can run.
+# Pin the platform BEFORE anything imports jax; an explicit setting
+# from the driver (e.g. a real TPU run) always wins.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
 
@@ -804,6 +821,7 @@ def bench_serving_fleet(n_workers=3, n_req=600, repeats=3):
     served req/sec THROUGH the failure; ``details`` carries
     p50/p95/p99 end-to-end latency and the recovery counters
     (retries, requeues, deaths, drains) the router aggregates."""
+    from mxtpu import obs
     from mxtpu import symbol as sym
     from mxtpu.serving import (FleetRouter, FleetWorker, ModelRunner,
                                RequestTimeout)
@@ -840,6 +858,15 @@ def bench_serving_fleet(n_workers=3, n_req=600, repeats=3):
         offered = min(0.5 * n_workers * raw_rps, 4000.0)
         interval = 1.0 / offered
         kill_at, replace_at = n_req // 3, n_req // 2
+        # sampler-overhead row (ISSUE 14): when obs is on the soak
+        # runs with the full operator stack live — 100 Hz sampler +
+        # availability SLO ticking inside the router loop.  Under
+        # MXTPU_OBS=0 both factories hand back the shared no-ops and
+        # attach_slo refuses them, so that run is the control.
+        eng = obs.slo_engine(
+            [obs.AvailabilitySLO("fleet_avail", objective=0.999)],
+            obs.sampler(period_us=10_000.0))
+        router.attach_slo(eng)
         with router:
             for i in range(n_workers):
                 router.add_worker(FleetWorker(
@@ -911,6 +938,7 @@ def bench_serving_fleet(n_workers=3, n_req=600, repeats=3):
             "raw_back_to_back_rps": round(raw_rps, 1),
             "n_workers": n_workers,
             "n_req_per_run": n_req,
+            "obs_live": bool(obs.enabled()),   # sampler+SLO attached?
         },
     }
     return stats, _METRIC_NAMES["serving_fleet"], "req/sec"
@@ -1190,6 +1218,26 @@ def _sweep_stale_tmpdirs():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _emit(results, order, budget, deadline):
+    """The one exit path for bench JSON: primary row + extras + wall
+    block, printed as a single line (success, trim, and the SIGALRM
+    wall backstop all come through here)."""
+    primary = next((results[m] for m in order
+                    if results[m].get("value") is not None),
+                   results[order[0]])
+    out = dict(primary)
+    if len(results) > 1:
+        out["extras"] = {m: results[m] for m in order
+                         if results[m] is not primary}
+    out["wall"] = {"budget_seconds": round(budget, 1),
+                   "elapsed_seconds": round(
+                       budget - (deadline - time.monotonic()), 1),
+                   "skipped": [m for m in order
+                               if results[m].get("skipped")]}
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def main():
     which = knobs.get("MXTPU_BENCH_MODEL")
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
@@ -1289,6 +1337,25 @@ def main():
             baseline = json.load(f).get("metrics", {})
 
     results = {}
+    if hasattr(signal, "SIGALRM"):
+        # last line of the rc=124 defence: even if a single row blows
+        # straight through its estimate (a hung tunnel inside one
+        # compile), the alarm fires at the wall, the rows that never
+        # ran land as {"skipped": "budget"}, the JSON still prints,
+        # and the exit code is 0 — a driver timeout can never again
+        # produce `parsed: null`.
+        def _wall_trip(signum, frame):
+            for m in order:
+                results.setdefault(
+                    m, {"metric": _METRIC_NAMES[m], "value": None,
+                        "unit": None, "mfu": None,
+                        "vs_baseline": None, "skipped": "budget"})
+            print(f"bench: wall budget {budget:.0f}s tripped "
+                  f"mid-row; flushing partial record", file=sys.stderr)
+            _emit(results, order, budget, deadline)
+            os._exit(0)
+        signal.signal(signal.SIGALRM, _wall_trip)
+        signal.alarm(max(1, int(budget)))
     if est_total > budget:
         # r5's rc=124 must never recur: when the sweep as configured
         # cannot fit, trim it UP FRONT by the same arithmetic
@@ -1359,19 +1426,9 @@ def main():
         # ISSUE 8: every row carries the obs registry state as of its
         # run — compile counts, step-time histograms, serving counters
         results[model].setdefault("details", {})["obs"] = obs.summary()
-    primary = next((results[m] for m in order
-                    if results[m]["value"] is not None),
-                   results[order[0]])
-    out = dict(primary)
-    if len(results) > 1:
-        out["extras"] = {m: results[m] for m in order
-                         if results[m] is not primary}
-    out["wall"] = {"budget_seconds": round(budget, 1),
-                   "elapsed_seconds": round(
-                       budget - (deadline - time.monotonic()), 1),
-                   "skipped": [m for m in order
-                               if results[m].get("skipped")]}
-    print(json.dumps(out))
+    if hasattr(signal, "SIGALRM"):
+        signal.alarm(0)
+    _emit(results, order, budget, deadline)
 
 
 if __name__ == "__main__":

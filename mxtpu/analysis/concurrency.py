@@ -65,6 +65,17 @@ _MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
              "remove", "discard", "setdefault", "sort", "reverse",
              "rotate"}
 
+# stdlib modules used as call receivers in the scanned tree: a call
+# through one of these (`os.close(fd)`, `pickle.dumps(x)`) leaves the
+# scanned universe and must never name-resolve to an unrelated scanned
+# method (`DebugServer.close`, `Profiler.dumps`)
+_STDLIB_RECEIVERS = frozenset({
+    "os", "sys", "time", "json", "math", "re", "ast", "io", "errno",
+    "signal", "socket", "shutil", "pickle", "struct", "hashlib",
+    "logging", "threading", "subprocess", "tempfile", "atexit", "gc",
+    "random", "warnings", "itertools", "functools", "collections",
+    "np", "numpy", "jax", "jnp"})
+
 
 # ----------------------------------------------------------------------
 # mxlint core reuse (shared FileCtx / pragma / Finding machinery)
@@ -511,6 +522,9 @@ def _resolve_call(an: Analysis, func: ast.AST,
         if t and t in an.classes:
             q = _method_in_mro(an, t, last)
             return (q,) if q else ()
+        if parts[0] in _STDLIB_RECEIVERS and \
+                parts[0] not in an.modules:
+            return ()
         if parts[0] in an.modules:
             if last in an.module_funcs.get(parts[0], ()):
                 return (f"{parts[0]}.{last}",)

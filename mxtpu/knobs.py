@@ -192,6 +192,22 @@ register("MXTPU_OBS_DUMP_ON_ERROR", "", "str",
          "worker death; `1` also dumps every recorder when a fleet "
          "request fails terminally; a directory path additionally "
          "writes each postmortem there as JSON.", "obs")
+register("MXTPU_OBS_SAMPLE_PERIOD_US", 1000000, "int",
+         "Time-series sampler period (obs.sampler): how often "
+         "maybe_sample() snapshots the metrics registry into the "
+         "bounded per-series rings that back windowed rates, "
+         "p50/p95/p99 and SLO burn windows.", "obs")
+register("MXTPU_OBS_HTTP_PORT", -1, "int",
+         "Debug HTTP server (obs.debug_server): /metrics /varz "
+         "/healthz /statusz /tracez on loopback.  -1 = never serve "
+         "(default); 0 = ephemeral port (tests read it back from "
+         "server.port); >0 = fixed port.", "obs")
+register("MXTPU_SLO_CLASSES", "", "str",
+         "Declarative latency SLOs, comma-separated "
+         "`name:endpoint:target_ms:objective[:percentile]` (e.g. "
+         "`interactive:fleet:50:0.95`), parsed by "
+         "obs.parse_slo_classes into LatencySLO objects next to the "
+         "built-in availability SLO.", "obs")
 
 # -- numerics / engine -------------------------------------------------
 register("MXTPU_ENGINE_TYPE", "ThreadedEnginePerDevice", "str",
@@ -282,6 +298,13 @@ register("MXTPU_FLEET_AUTOSCALE_UP_ETA_US", 0.0, "float",
          "Additional scale-up trigger: predicted queue ETA "
          "(ServingStats.queue_eta_us) above this many microseconds "
          "counts as overload (0 disables the ETA signal).",
+         "controlplane")
+register("MXTPU_FLEET_AUTOSCALE_BURN", False, "bool",
+         "Let an attached SLO engine's firing burn-rate alerts count "
+         "as autoscaler overload ticks (scale up while the error "
+         "budget is burning even if queue depth looks fine).  Off by "
+         "default: scaling behaviour is bit-identical to the "
+         "pre-SLO autoscaler unless explicitly enabled.",
          "controlplane")
 register("MXTPU_FLEET_AUTOSCALE_BREACH_TICKS", 3, "int",
          "Hysteresis: consecutive over/under-band evaluations before "

@@ -35,13 +35,22 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 from .. import knobs
 from ..base import MXNetError
+from . import http as http
 from . import metrics as metrics
 from . import recorder as recorder
+from . import slo as slo
+from . import timeseries as timeseries
 from . import trace as trace
+from .http import NULL_SERVER, DebugServer
 from .metrics import (DEFAULT_BUCKETS, MetricsRegistry, NULL_COUNTER,
-                      NULL_GAUGE, NULL_HISTOGRAM,
-                      parse_prometheus_text, samples_from_snapshot)
+                      NULL_GAUGE, NULL_HISTOGRAM, bucket_quantile,
+                      parse_prometheus_text, percentile,
+                      samples_from_snapshot)
 from .recorder import NULL_RECORDER, FlightRecorder
+from .slo import (DEFAULT_RULES, NULL_SLO_ENGINE, AvailabilitySLO,
+                  BurnRateRule, LatencySLO, SLOEngine,
+                  parse_slo_classes)
+from .timeseries import NULL_SAMPLER, Sampler
 from .trace import (SPAN_BACKOFF, SPAN_EXECUTE, SPAN_HEDGE,
                     SPAN_PAD_SCATTER, SPAN_QUEUE_WAIT, SPAN_REDISPATCH,
                     SPAN_REQUEUE, SPAN_RUN, SPAN_SCALE, SPAN_SHED,
@@ -53,8 +62,13 @@ __all__ = [
     "prometheus_text", "snapshot", "summary", "reset",
     "flight", "flight_recorders", "dump_all", "dump_on_error_path",
     "new_trace_id", "span", "trace_of", "self_check",
-    "MetricsRegistry", "FlightRecorder",
+    "sampler", "slo_engine", "debug_server",
+    "MetricsRegistry", "FlightRecorder", "Sampler", "SLOEngine",
+    "DebugServer", "AvailabilitySLO", "LatencySLO", "BurnRateRule",
+    "DEFAULT_RULES", "parse_slo_classes",
+    "percentile", "bucket_quantile",
     "NULL_COUNTER", "NULL_GAUGE", "NULL_HISTOGRAM", "NULL_RECORDER",
+    "NULL_SAMPLER", "NULL_SLO_ENGINE", "NULL_SERVER",
     "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE", "SPAN_BACKOFF",
     "SPAN_STEAL", "SPAN_REDISPATCH", "SPAN_HEDGE", "SPAN_PAD_SCATTER",
     "SPAN_RUN", "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE",
@@ -63,6 +77,7 @@ __all__ = [
 _REGISTRY = MetricsRegistry()
 _FLIGHT_LOCK = threading.Lock()
 _FLIGHT: Dict[str, FlightRecorder] = {}  # guarded-by: _FLIGHT_LOCK
+_SAMPLER: Optional[Sampler] = None       # guarded-by: _FLIGHT_LOCK
 
 
 def enabled() -> bool:
@@ -120,10 +135,13 @@ def summary() -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Tests only: drop all metric families and flight recorders."""
+    """Tests only: drop all metric families, flight recorders and the
+    process sampler."""
+    global _SAMPLER
     _REGISTRY.reset()
     with _FLIGHT_LOCK:
         _FLIGHT.clear()
+        _SAMPLER = None
 
 
 # -- flight recorders --------------------------------------------------
@@ -158,6 +176,69 @@ def dump_all(reason: str = "", path: Optional[str] = None
             for name, rec in flight_recorders().items()}
 
 
+# -- time-series sampler / SLO engine / debug server (ISSUE 14) --------
+def sampler(period_us: Optional[float] = None,
+            capacity: Optional[int] = None,
+            clock: Optional[Callable[[], float]] = None,
+            enabled_override: Optional[bool] = None):
+    """Get-or-create the process-wide :class:`~.timeseries.Sampler`
+    over the process registry; the shared no-op when obs is off.
+    Like :func:`flight`, ``period_us``/``capacity``/``clock`` only
+    apply on first creation (tests pass the fake clock)."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_SAMPLER
+    global _SAMPLER
+    with _FLIGHT_LOCK:
+        if _SAMPLER is None:
+            kw: Dict[str, Any] = {"period_us": period_us,
+                                  "clock": clock}
+            if capacity is not None:
+                kw["capacity"] = capacity
+            _SAMPLER = Sampler(_REGISTRY, **kw)
+        return _SAMPLER
+
+
+_sampler_factory = sampler   # slo_engine's param shadows the name
+
+
+def slo_engine(slos, sampler=None, *,
+               rules=DEFAULT_RULES,
+               clock: Optional[Callable[[], float]] = None,
+               enabled_override: Optional[bool] = None):
+    """Build an :class:`~.slo.SLOEngine` over ``slos``; the shared
+    no-op when obs is off.  ``sampler`` defaults to the process
+    sampler (:func:`sampler`); wire the result into the fleet with
+    ``router.attach_slo(engine)``."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_SLO_ENGINE
+    if sampler is None:
+        sampler = _sampler_factory(clock=clock)
+    return SLOEngine(slos, sampler, rules=rules, clock=clock)
+
+
+def debug_server(port: Optional[int] = None, *,
+                 host: str = "127.0.0.1", router=None, slo=None,
+                 sampler=None,
+                 enabled_override: Optional[bool] = None):
+    """Start a :class:`~.http.DebugServer` (``/metrics`` ``/varz``
+    ``/healthz`` ``/statusz`` ``/tracez``) on a daemon thread; the
+    shared no-op when obs is off or the port is negative.  ``port``
+    defaults to ``MXTPU_OBS_HTTP_PORT`` (-1 = disabled, 0 =
+    ephemeral — read the bound port back from ``server.port``).  The
+    caller owns ``close()``."""
+    on = enabled() if enabled_override is None else enabled_override
+    if not on:
+        return NULL_SERVER
+    if port is None:
+        port = int(knobs.get("MXTPU_OBS_HTTP_PORT"))
+    if port < 0:
+        return NULL_SERVER
+    return DebugServer(port=port, host=host, router=router, slo=slo,
+                       sampler=sampler)
+
+
 def dump_on_error_path() -> Optional[str]:
     """``MXTPU_OBS_DUMP_ON_ERROR`` decoded: None = off, "" = log
     only, a string = also write JSON under that directory."""
@@ -175,10 +256,18 @@ def self_check(probe: bool = False) -> Dict[str, Any]:
     ``guards.self_check``):
 
     * disabled ⇒ every factory returns its SHARED no-op singleton
-      (no allocation, no registration — zero overhead);
+      (no allocation, no registration — zero overhead); ISSUE 14
+      extends this to the sampler / SLO-engine / debug-server
+      factories, and when obs is off in THIS process the
+      un-overridden factories are asserted null too;
     * the two export surfaces agree: a parsed Prometheus text dump
       carries exactly the samples a flattened JSON snapshot does
       (exercised on a private throwaway registry);
+    * the operator layers work end to end on a private registry and
+      a fake clock: sampler windows (counter rate, histogram bucket
+      quantile), a burn-rate alert edge on a driven availability
+      SLO, and every HTTP renderer producing parseable output — no
+      socket bound;
     * ``probe=True`` additionally dispatches a tiny jitted computation
       with instruments firing around it and asserts bit-identical
       results vs the bare run (obs never touches what is computed).
@@ -197,6 +286,22 @@ def self_check(probe: bool = False) -> Dict[str, Any]:
         raise MXNetError(
             "obs self_check: disabled flight factory is not the "
             "shared no-op recorder")
+    if sampler(enabled_override=False) is not NULL_SAMPLER \
+            or slo_engine([], enabled_override=False) \
+            is not NULL_SLO_ENGINE \
+            or debug_server(enabled_override=False) is not NULL_SERVER:
+        raise MXNetError(
+            "obs self_check: disabled sampler/SLO/HTTP factory is "
+            "not its shared no-op singleton")
+    if not enabled():
+        # the env-driven path, not just the override: with MXTPU_OBS=0
+        # the live factories must hand out the same null singletons
+        if sampler() is not NULL_SAMPLER \
+                or slo_engine([]) is not NULL_SLO_ENGINE \
+                or debug_server() is not NULL_SERVER:
+            raise MXNetError(
+                "obs self_check: MXTPU_OBS=0 but a live factory did "
+                "not return its shared no-op singleton")
 
     # Round-trip on a private registry (never pollutes the process one)
     reg = MetricsRegistry()
@@ -227,10 +332,72 @@ def self_check(probe: bool = False) -> Dict[str, Any]:
             f"obs self_check: exposition surfaces disagree — "
             f"text={text_samples} snapshot={snap_samples}")
 
+    # -- operator layers (ISSUE 14): sampler windows, a burn-rate
+    #    alert edge, and the HTTP renderers — private registry, fake
+    #    clock, no socket ------------------------------------------------
+    import json as _json
+    t = [0.0]
+    reg3 = MetricsRegistry()
+    smp = Sampler(reg3, capacity=8, period_us=1_000_000,
+                  clock=lambda: t[0])
+    done = reg3.counter("mxtpu_serving_completed_total", "probe",
+                        labels=("endpoint",)).labels(endpoint="fleet")
+    tout = reg3.counter("mxtpu_serving_timeout_total", "probe",
+                        labels=("endpoint",)).labels(endpoint="fleet")
+    lat = reg3.histogram("mxtpu_serving_latency_seconds", "probe",
+                         labels=("endpoint",),
+                         buckets=(0.01, 0.1, 1.0)
+                         ).labels(endpoint="fleet")
+    smp.sample(0.0)
+    done.inc(10)
+    for _ in range(10):
+        lat.observe(0.05)
+    t[0] = 10.0
+    smp.sample(10.0)
+    r = smp.rate("mxtpu_serving_completed_total",
+                 {"endpoint": "fleet"}, window_s=60.0)
+    if r is None or abs(r - 1.0) > 1e-9:
+        raise MXNetError(
+            f"obs self_check: sampler rate wrong (want 1.0, got {r})")
+    q50 = smp.quantile("mxtpu_serving_latency_seconds",
+                       {"endpoint": "fleet"}, q=50, window_s=60.0)
+    if q50 is None or not 0.01 < q50 <= 0.1:
+        raise MXNetError(
+            f"obs self_check: sampler quantile wrong (10 samples in "
+            f"(0.01, 0.1] but p50={q50})")
+    eng = SLOEngine(
+        [AvailabilitySLO("selfcheck_avail", objective=0.9)], smp,
+        rules=(BurnRateRule(fast_s=5.0, slow_s=30.0, factor=2.0),),
+        clock=lambda: t[0],
+        alerts=reg3.counter("mxtpu_slo_alerts_total", "probe",
+                            labels=("slo", "window")),
+        recorder=FlightRecorder("selfcheck/slo", clock=lambda: t[0]))
+    tout.inc(40)            # error ratio >> budget in both windows
+    t[0] = 12.0
+    fired = eng.tick(12.0)
+    if not fired or not eng.firing():
+        raise MXNetError(
+            "obs self_check: burn-rate alert did not fire on a "
+            "driven availability SLO (fast+slow windows breached)")
+    if parse_prometheus_text(http.render_metrics(reg3)) != \
+            samples_from_snapshot(reg3.snapshot()):
+        raise MXNetError(
+            "obs self_check: /metrics rendering disagrees with the "
+            "registry snapshot")
+    statusz = _json.loads(http.render_statusz(
+        slo=eng, sampler=smp, recorders={}))
+    if not statusz["slo"]["firing"]:
+        raise MXNetError(
+            "obs self_check: /statusz lost the firing SLO alert")
+    _json.loads(http.render_varz(reg3))
+    _json.loads(http.render_healthz())
+
     info: Dict[str, Any] = {
         "enabled": enabled(),
         "flight_capacity": int(knobs.get("MXTPU_OBS_FLIGHT_CAPACITY")),
         "round_trip_samples": len(text_samples),
+        "slo_probe_alerts": len(fired),
+        "sampler_probe_series": smp.summary()["series"],
     }
     if probe:
         import jax

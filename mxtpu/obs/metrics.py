@@ -36,7 +36,7 @@ from ..base import MXNetError
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "NULL_COUNTER", "NULL_GAUGE", "NULL_HISTOGRAM",
            "parse_prometheus_text", "samples_from_snapshot",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "percentile", "bucket_quantile"]
 
 _NAME_RE = re.compile(r"^mxtpu_[a-z][a-z0-9_]*$")
 _LABEL_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -47,6 +47,45 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 _HIST_SUFFIXES = ("_seconds", "_us", "_bytes")
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted sequence, ``q`` in
+    [0, 100].  THE percentile implementation (ISSUE 14 satellite):
+    ``ServingStats`` (snapshot p50/p95/p99, ``queue_eta_us``) and the
+    time-series sampler delegate here, pinned by an equivalence test
+    on shared sample sets."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def bucket_quantile(bounds: Sequence[float],
+                    cum_counts: Sequence[float],
+                    q: float) -> Optional[float]:
+    """Quantile from cumulative histogram bucket counts (Prometheus
+    ``histogram_quantile`` style), ``q`` in [0, 100].
+
+    ``bounds`` are the finite upper bounds; ``cum_counts`` has one
+    cumulative count per bound plus the trailing ``+Inf`` total —
+    exactly the shape :meth:`_HistogramChild._snap` exposes and the
+    sampler stores.  Linear interpolation inside the landing bucket
+    (from the previous bound, 0 below the first); a quantile landing
+    in ``+Inf`` clamps to the largest finite bound.  None when the
+    (windowed) histogram is empty."""
+    total = float(cum_counts[-1]) if cum_counts else 0.0
+    if total <= 0:
+        return None
+    rank = q / 100.0 * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in zip(bounds, cum_counts):
+        if cum >= rank and cum > prev_cum:
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * max(0.0, frac)
+        prev_bound, prev_cum = float(bound), float(cum)
+    return float(bounds[-1]) if bounds else None
 
 
 def _check_name(name: str, kind: str) -> None:

@@ -139,10 +139,18 @@ class Autoscaler:
                  breach_ticks: Optional[int] = None,
                  cooldown_s: Optional[float] = None,
                  name_prefix: str = "auto",
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 slo=None, burn_scale: Optional[bool] = None):
         self._router = router
         self._make_worker = make_worker
         g = knobs.get
+        # optional SLO coupling (ISSUE 14): when MXTPU_FLEET_AUTOSCALE
+        # _BURN is on AND an engine is supplied, a firing burn-rate
+        # alert counts as an overload tick.  Both default off, so the
+        # decision loop is bit-identical to the pre-SLO autoscaler.
+        self._slo = slo
+        self.burn_scale = bool(burn_scale) if burn_scale is not None \
+            else bool(g("MXTPU_FLEET_AUTOSCALE_BURN"))
         self.min_workers = min_workers if min_workers is not None \
             else g("MXTPU_FLEET_AUTOSCALE_MIN")
         self.max_workers = max_workers if max_workers is not None \
@@ -198,9 +206,13 @@ class Autoscaler:
                           if e is not None), default=0.0)
         else:
             depth_per, eta_us = 0.0, 0.0
+        burning: list = []
+        if self.burn_scale and self._slo is not None:
+            burning = self._slo.firing()
         overload = bool(healthy) and (
             depth_per > self.up_depth
-            or (self.up_eta_us > 0 and eta_us > self.up_eta_us))
+            or (self.up_eta_us > 0 and eta_us > self.up_eta_us)
+            or bool(burning))
         underload = bool(healthy) and pending == 0 \
             and depth_per < self.down_depth
         action: Optional[str] = None
@@ -233,7 +245,7 @@ class Autoscaler:
                     self._scale_downs += 1
         if action == "up":
             self._scale_up(now, seq, healthy, depth_per, eta_us,
-                           pending)
+                           pending, burning)
         elif action == "down":
             self._scale_down(now, healthy, depth_per)
         return action
@@ -241,7 +253,7 @@ class Autoscaler:
     # -- actions (no autoscaler lock held) ---------------------------------
     def _scale_up(self, now: float, seq: int, healthy: list,
                   depth_per: float, eta_us: float,
-                  pending: int) -> None:
+                  pending: int, burning: list = ()) -> None:
         donor = healthy[0] if healthy else None
         if donor is not None:
             meta = donor.handoff()
@@ -262,10 +274,15 @@ class Autoscaler:
         else:
             warm_src = warmed  # "disk_cache" or None (cold)
         self._router.stats.bump("scale_ups")
-        self.recorder.record(
-            "scale_up", worker=worker.name, donor=warm_src,
+        detail: Dict[str, Any] = dict(
+            worker=worker.name, donor=warm_src,
             depth_per=round(depth_per, 2),
             eta_us=round(eta_us, 1), pending=pending)
+        if burning:
+            # only present when the SLO gate contributed — existing
+            # scenario events stay byte-identical with the knob off
+            detail["burn_slos"] = list(burning)
+        self.recorder.record("scale_up", **detail)
         if profiler.is_active():
             obs.span(obs.SPAN_SCALE, now * 1e6, 0.0, cat="fleet",
                      direction="up", worker=worker.name,
@@ -304,4 +321,5 @@ class Autoscaler:
                 "breach_down": self._breach_down,
                 "last_action_t": self._last_action_t,
                 "warm_handoff_cached": self._last_handoff is not None,
+                "burn_scale": self.burn_scale,
             }

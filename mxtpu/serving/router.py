@@ -546,6 +546,7 @@ class FleetRouter:
         # control-plane hooks (e.g. Autoscaler.tick) run at the END of
         # every tick with NO router lock held
         self._controllers: List[Callable[[float], None]] = []  # guarded-by: _lock
+        self._slo = None                # guarded-by: _lock
         self.recorder = obs.flight("fleet/router", clock=clock)
         self._rng = random.Random(seed)
         self.stats = ServingStats(name="fleet", clock=clock,
@@ -658,6 +659,18 @@ class FleetRouter:
         held — the hook may call :meth:`add_worker` / :meth:`drain`."""
         with self._lock:
             self._controllers.append(fn)
+
+    def attach_slo(self, engine) -> None:
+        """Attach an :class:`~mxtpu.obs.SLOEngine`: its ``tick`` runs
+        as a controller (end of every router tick, no router lock)
+        and its snapshot joins :meth:`fleet_stats` /
+        :meth:`postmortem`.  A no-op for the ``MXTPU_OBS=0`` null
+        engine — nothing is registered, ticks stay untouched."""
+        if not getattr(engine, "enabled", True):
+            return
+        with self._lock:
+            self._slo = engine
+        self.add_controller(engine.tick)
 
     # -- request path ------------------------------------------------------
     def submit(self, payload: Dict[str, np.ndarray], *,
@@ -1064,6 +1077,10 @@ class FleetRouter:
                 if w is None:
                     continue
                 w.recorder.record("canary", ok=ok, why=why)
+                if not ok and "CORRUPT" in why:
+                    # silent corruption is a correctness failure: it
+                    # feeds the availability SLO's "wrong" leg
+                    self.stats.bump("wrong_results")
                 if ok:
                     w.health.canary_ok(now)
                 else:
@@ -1176,6 +1193,10 @@ class FleetRouter:
         snap["healthy_workers"] = sum(
             1 for s in states if s == WorkerState.HEALTHY)
         snap["total_workers"] = len(states)
+        with self._lock:
+            slo = self._slo
+        if slo is not None:
+            snap["slo"] = slo.snapshot()
         return snap
 
     def postmortem(self, name: str) -> Dict[str, Any]:
@@ -1186,13 +1207,20 @@ class FleetRouter:
         reads after ``kill``/death to answer *why*."""
         with self._lock:
             w = self._require_locked(name)
-        return {
+            slo = self._slo
+        doc = {
             "worker": name,
             "health": w.health.snapshot(),
             "transitions": list(w.health.transitions),
             "stats": w.stats.snapshot(),
             "flight": w.recorder.snapshot(),
         }
+        if slo is not None:
+            # the SLO/error-budget table at the moment of the
+            # postmortem — which alerts were firing while this worker
+            # was dying answers the operator's "did users notice?"
+            doc["slo"] = slo.snapshot()
+        return doc
 
     def close(self) -> None:
         with self._lock:

@@ -38,13 +38,9 @@ logger = logging.getLogger("mxtpu.serving")
 _ETA_SAMPLE = 256
 
 
-def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile on a pre-sorted list."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+# one quantile implementation for the whole tree (ISSUE 14 satellite):
+# the nearest-rank math lives in obs.metrics next to bucket_quantile
+_percentile = obs.percentile
 
 
 class ServingStats:

@@ -1,0 +1,562 @@
+"""mxtpu.obs operator layers (ISSUE 14): time-series sampler,
+declarative SLOs with multi-window burn-rate alerting, and the debug
+HTTP endpoints.
+
+Everything deterministic runs on a hand-stepped fake clock: sampler
+windows, burn-rate edges and the committed CrashAt acceptance scenario
+are bit-reproducible with no sleeps.  Only the HTTP round-trips touch
+a real socket (loopback, ephemeral port) and they assert payloads,
+not latencies.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxtpu import obs, profiler
+from mxtpu.base import MXNetError
+from mxtpu.obs import (NULL_SAMPLER, NULL_SERVER, NULL_SLO_ENGINE,
+                       AvailabilitySLO, BurnRateRule, LatencySLO,
+                       Sampler, SLOEngine, parse_slo_classes)
+from mxtpu.obs.metrics import (MetricsRegistry, parse_prometheus_text,
+                               samples_from_snapshot)
+from mxtpu.obs.recorder import FlightRecorder
+from mxtpu.serving import Autoscaler, CrashAt, FaultPlan
+from mxtpu.serving import stats as serving_stats
+
+from tests.test_fleet import (FakeClock, _crank, _payload, _router,
+                              _worker)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Each test starts from an empty registry / sampler / recorders."""
+    obs.reset()
+    profiler.set_state("stop")
+    profiler.dumps(reset=True)
+    yield
+    profiler.set_state("stop")
+    profiler.dumps(reset=True)
+    obs.reset()
+
+
+def _fetch(url):
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return resp.read().decode("utf-8")
+
+
+# ------------------------------------------------- unified quantile code
+
+def test_percentile_is_the_one_implementation():
+    """serving/stats delegates to obs.metrics.percentile — one
+    nearest-rank implementation for the whole tree, pinned here."""
+    assert serving_stats._percentile is obs.percentile
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert obs.percentile(vals, 0) == 1.0
+    assert obs.percentile(vals, 50) == 3.0
+    assert obs.percentile(vals, 75) == 4.0
+    assert obs.percentile(vals, 95) == 5.0
+    assert obs.percentile(vals, 100) == 5.0
+    assert obs.percentile([], 50) == 0.0
+    assert obs.percentile([7.5], 99) == 7.5
+
+
+def test_bucket_quantile_pinned():
+    bounds = (1.0, 2.0, 4.0)
+    cum = (5.0, 5.0, 10.0)
+    # rank 5 lands exactly on the first bucket's cumulative count
+    assert obs.bucket_quantile(bounds, cum, 50) == pytest.approx(1.0)
+    # rank 9 interpolates inside (2, 4]: 2 + 2 * (9-5)/(10-5)
+    assert obs.bucket_quantile(bounds, cum, 90) == pytest.approx(3.6)
+    assert obs.bucket_quantile((), (), 50) is None
+    assert obs.bucket_quantile(bounds, (0.0, 0.0, 0.0), 50) is None
+
+
+# ------------------------------------------------------------- sampler
+
+def test_sampler_counter_gauge_windows():
+    t = [0.0]
+    reg = MetricsRegistry()
+    c = reg.counter("mxtpu_flow_total", "f")
+    g = reg.gauge("mxtpu_depth", "d")
+    smp = Sampler(reg, capacity=16, period_us=1_000_000,
+                  clock=lambda: t[0])
+    smp.sample(0.0)
+    c.inc(5)
+    g.set(2)
+    smp.sample(10.0)
+    assert smp.level("mxtpu_depth") == 2.0
+    assert smp.level("mxtpu_flow_total") == 5.0
+    assert smp.delta("mxtpu_flow_total") == 5.0
+    assert smp.rate("mxtpu_flow_total") == pytest.approx(0.5)
+    c.inc(20)
+    smp.sample(20.0)
+    # a 10 s window anchors at the newest sample: only [10, 20]
+    assert smp.delta("mxtpu_flow_total", window_s=10.0) == 20.0
+    assert smp.rate("mxtpu_flow_total", window_s=10.0) == \
+        pytest.approx(2.0)
+    # whole-ring read still spans everything
+    assert smp.delta("mxtpu_flow_total") == 25.0
+    # unknown series / one-sample windows answer None
+    assert smp.level("mxtpu_nope_total") is None
+    assert smp.delta("mxtpu_flow_total", window_s=0.5) is None
+    assert "mxtpu_flow_total" in smp.series_names()
+
+
+def test_sampler_bounded_ring_and_period_gate():
+    t = [0.0]
+    reg = MetricsRegistry()
+    c = reg.counter("mxtpu_flow_total", "f")
+    smp = Sampler(reg, capacity=4, period_us=1_000_000,
+                  clock=lambda: t[0])
+    for k in range(8):
+        t[0] = float(k)
+        c.inc(1)
+        smp.sample()
+    # ring keeps the last 4 samples: delta is vs the oldest retained
+    assert smp.delta("mxtpu_flow_total") == 3.0
+    assert smp.summary()["samples"] == 8
+    # period gating: 0.5 s after the last sample is too soon
+    t[0] = 7.5
+    assert smp.maybe_sample() is False
+    t[0] = 8.0
+    assert smp.maybe_sample() is True
+
+
+def test_sampler_histogram_windowed_quantile():
+    t = [0.0]
+    reg = MetricsRegistry()
+    h = reg.histogram("mxtpu_lat_seconds", "l",
+                      buckets=(0.1, 1.0, 10.0))
+    smp = Sampler(reg, clock=lambda: t[0])
+    smp.sample(0.0)
+    for _ in range(10):
+        h.observe(0.05)
+    smp.sample(10.0)
+    for _ in range(10):
+        h.observe(5.0)
+    smp.sample(20.0)
+    # the 10 s window sees ONLY the slow burst: p50 interpolates in
+    # (1, 10] at rank 5 of 10 -> 1 + 9 * 0.5
+    assert smp.quantile("mxtpu_lat_seconds", q=50, window_s=10.0) == \
+        pytest.approx(5.5)
+    # whole-ring p50 sits in the fast bucket
+    q = smp.quantile("mxtpu_lat_seconds", q=50)
+    assert 0.0 < q <= 0.1
+    d = smp.hist_delta("mxtpu_lat_seconds", window_s=10.0)
+    assert d[0] == (0.1, 1.0, 10.0)
+    assert d[1] == (0.0, 0.0, 10.0, 10.0)
+
+
+def test_null_sampler_answers_none():
+    assert NULL_SAMPLER.maybe_sample(1.0) is False
+    NULL_SAMPLER.sample(1.0)
+    assert NULL_SAMPLER.rate("mxtpu_x_total") is None
+    assert NULL_SAMPLER.quantile("mxtpu_x_seconds") is None
+    assert NULL_SAMPLER.summary()["series"] == 0
+    assert NULL_SAMPLER.series_names() == []
+
+
+def test_factories_return_null_singletons_when_off(monkeypatch):
+    monkeypatch.setenv("MXTPU_OBS", "0")
+    obs.reset()
+    assert obs.sampler() is NULL_SAMPLER
+    assert obs.slo_engine([]) is NULL_SLO_ENGINE
+    assert obs.debug_server(port=0) is NULL_SERVER
+    # self_check proves the same contract from the inside
+    assert obs.self_check()["enabled"] is False
+    assert obs.registry().names() == []
+
+
+def test_sampler_factory_is_a_singleton():
+    smp = obs.sampler(period_us=0)
+    assert obs.sampler() is smp
+    obs.reset()
+    assert obs.sampler(period_us=0) is not smp
+
+
+# ----------------------------------------------------------- SLO math
+
+def _avail_rig(buckets=(0.01, 0.1, 1.0)):
+    """Private registry with the serving series an SLO reads."""
+    t = [0.0]
+    reg = MetricsRegistry()
+    ep = {"endpoint": "fleet"}
+    c = reg.counter("mxtpu_serving_completed_total", "c",
+                    labels=("endpoint",)).labels(**ep)
+    to = reg.counter("mxtpu_serving_timeout_total", "t",
+                     labels=("endpoint",)).labels(**ep)
+    sh = reg.counter("mxtpu_serving_rejected_total", "r",
+                     labels=("endpoint",)).labels(**ep)
+    wr = reg.counter("mxtpu_fleet_events_total", "e",
+                     labels=("endpoint", "kind")).labels(
+                         endpoint="fleet", kind="wrong_results")
+    h = reg.histogram("mxtpu_serving_latency_seconds", "l",
+                      labels=("endpoint",),
+                      buckets=buckets).labels(**ep)
+    smp = Sampler(reg, period_us=0, clock=lambda: t[0])
+    return t, reg, smp, c, to, sh, wr, h
+
+
+def test_availability_slo_formula():
+    t, _, smp, c, to, sh, wr, _ = _avail_rig()
+    slo = AvailabilitySLO("avail", objective=0.9)
+    assert slo.error_ratio(smp, None) is None      # nothing sampled
+    smp.sample(0.0)
+    c.inc(90)
+    to.inc(5)
+    sh.inc(3)
+    wr.inc(2)
+    smp.sample(10.0)
+    # 1 - availability = (timeouts + sheds + wrong) / admitted
+    assert slo.error_ratio(smp, None) == pytest.approx(10.0 / 100.0)
+    # a quiet window (single in-window sample) gives no verdict
+    smp.sample(20.0)
+    assert slo.error_ratio(smp, 5.0) is None
+    with pytest.raises(MXNetError):
+        AvailabilitySLO("bad", objective=1.5)
+
+
+def test_latency_slo_formula_is_conservative():
+    t, _, smp, *_rest, h = _avail_rig()
+    smp.sample(0.0)
+    for _ in range(8):
+        h.observe(0.05)
+    for _ in range(2):
+        h.observe(0.5)
+    smp.sample(10.0)
+    # target on a bucket boundary: the 8 fast requests are good
+    slo = LatencySLO("lat", target_s=0.1, objective=0.95)
+    assert slo.error_ratio(smp, None) == pytest.approx(0.2)
+    # target INSIDE a bucket: everything straddling counts bad
+    strict = LatencySLO("strict", target_s=0.05, objective=0.95)
+    assert strict.error_ratio(smp, None) == pytest.approx(1.0)
+    # display percentile interpolates the bucket deltas
+    assert slo.observed(smp, None) == pytest.approx(0.775)
+    with pytest.raises(MXNetError):
+        LatencySLO("neg", target_s=-1.0)
+
+
+def test_parse_slo_classes():
+    got = parse_slo_classes("gold:fleet:50:0.95,bulk:fleet:500:0.9:99")
+    assert [(s.name, s.endpoint, s.target_s, s.objective,
+             s.percentile) for s in got] == \
+        [("gold", "fleet", 0.05, 0.95, 95.0),
+         ("bulk", "fleet", 0.5, 0.9, 99.0)]
+    assert parse_slo_classes("") == []
+    with pytest.raises(MXNetError):
+        parse_slo_classes("gold:fleet:50")          # too few fields
+    with pytest.raises(MXNetError):
+        parse_slo_classes("gold:fleet:xx:0.95")     # non-numeric
+
+
+# ----------------------------------------------- burn-rate alert edges
+
+def _engine(smp, t, rules):
+    reg = MetricsRegistry()
+    return SLOEngine(
+        [AvailabilitySLO("avail", objective=0.9)], smp, rules=rules,
+        clock=lambda: t[0],
+        alerts=reg.counter("mxtpu_slo_alerts_total", "a",
+                           labels=("slo", "window")),
+        recorder=FlightRecorder("test/slo", clock=lambda: t[0]))
+
+
+def test_burn_rate_needs_both_windows():
+    """The Google-SRE shape: a fast-only spike never fires — the slow
+    window must ALSO breach."""
+    t, _, smp, c, to, *_ = _avail_rig()
+    eng = _engine(smp, t, (BurnRateRule(fast_s=10.0, slow_s=60.0,
+                                        factor=2.0),))
+    # one minute of clean traffic
+    for now in range(0, 60, 10):
+        c.inc(100)
+        t[0] = float(now)
+        assert eng.tick(t[0]) == []
+    # a fast-only spike: fast burn 30/130/0.1 = 2.3x but the slow
+    # window is diluted by the clean history (0.48x) — no alert
+    c.inc(100)
+    to.inc(30)
+    t[0] = 60.0
+    assert eng.tick(60.0) == []
+    assert eng.firing() == []
+    # the burn SUSTAINS: the slow window eventually breaches too and
+    # the alert fires exactly once (edge-triggered)
+    fired = []
+    for now in range(70, 130, 10):
+        to.inc(30)
+        t[0] = float(now)
+        fired += eng.tick(t[0])
+    assert fired == [("avail", "10s/60s")]
+    assert eng.firing() == [("avail", "10s/60s")]
+    assert eng._alerts.labels(slo="avail",
+                              window="10s/60s").value() == 1.0
+    kinds = [e["kind"] for e in eng.recorder.events()]
+    assert kinds.count("slo_alert") == 1
+    snap = eng.snapshot()
+    assert snap["firing"] == [["avail", "10s/60s"]] or \
+        snap["firing"] == [("avail", "10s/60s")]
+    win = snap["slos"]["avail"]["windows"]["10s/60s"]
+    assert win["firing"] is True
+    assert win["fast_burn"] >= 2.0 and win["slow_burn"] >= 2.0
+    assert snap["alerts"][-1]["slo"] == "avail"
+
+
+def test_burn_rate_clears_and_refires():
+    t, _, smp, c, to, *_ = _avail_rig()
+    eng = _engine(smp, t, (BurnRateRule(fast_s=10.0, slow_s=60.0,
+                                        factor=2.0),))
+    smp.sample(0.0)
+    now = 0.0
+    # drive a sustained burn until it fires
+    fired = []
+    while not fired and now < 300.0:
+        now += 10.0
+        to.inc(50)
+        t[0] = now
+        fired = eng.tick(now)
+    assert fired and eng.firing()
+    # recovery: clean traffic clears the fast window immediately
+    now += 10.0
+    c.inc(1000)
+    t[0] = now
+    assert eng.tick(now) == []
+    assert eng.firing() == []
+    assert [e["kind"] for e in eng.recorder.events()].count(
+        "slo_clear") == 1
+    # a second sustained burn re-fires: the counter totals the edges
+    fired = []
+    while not fired and now < 600.0:
+        now += 10.0
+        to.inc(5000)
+        t[0] = now
+        fired = eng.tick(now)
+    assert fired
+    assert eng._alerts.labels(slo="avail",
+                              window="10s/60s").value() == 2.0
+
+
+def test_engine_rejects_duplicate_names():
+    with pytest.raises(MXNetError):
+        SLOEngine([AvailabilitySLO("a"), AvailabilitySLO("a")],
+                  NULL_SAMPLER)
+
+
+def test_null_engine_is_inert():
+    assert NULL_SLO_ENGINE.tick(1.0) == []
+    assert NULL_SLO_ENGINE.firing() == []
+    assert NULL_SLO_ENGINE.snapshot()["slos"] == {}
+
+
+# --------------------------------------------------- debug HTTP server
+
+def test_debug_server_round_trips():
+    c = obs.counter("mxtpu_demo_total", "demo")
+    c.inc(3)
+    srv = obs.debug_server(port=0)
+    try:
+        assert srv.enabled and srv.port > 0
+        base = srv.url
+        # /metrics parses back to exactly the registry snapshot
+        text = _fetch(base + "/metrics")
+        assert parse_prometheus_text(text) == \
+            samples_from_snapshot(obs.registry().snapshot())
+        varz = json.loads(_fetch(base + "/varz"))
+        assert varz["mxtpu_demo_total"]["series"][0]["value"] == 3.0
+        health = json.loads(_fetch(base + "/healthz"))
+        assert health["status"] == "ok"
+        statusz = json.loads(_fetch(base + "/statusz"))
+        assert statusz["workers"] == {} and statusz["slo"] is None
+        # /tracez round-trips (unknown id is an empty timeline)
+        assert json.loads(_fetch(base + "/tracez?id=r-nope")) == []
+        with pytest.raises(urllib.error.HTTPError) as e400:
+            _fetch(base + "/tracez")
+        assert e400.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            _fetch(base + "/nope")
+        assert e404.value.code == 404
+    finally:
+        srv.close()
+    srv.close()                      # idempotent
+
+
+def test_debug_server_disabled_by_default_port():
+    # the knob defaults to -1: no server unless asked for
+    assert obs.debug_server() is NULL_SERVER
+
+
+# --------------------------- acceptance: mid-burst kill trips the SLO
+
+def test_crash_mid_burst_trips_availability_slo_everywhere():
+    """The committed ISSUE 14 scenario: a scripted CrashAt kill during
+    a burst drives the availability SLO's fast AND slow burn windows
+    over threshold; the alert lands in the alerts counter, the
+    fleet/slo flight ring, postmortem()/fleet_stats(), and a live
+    /statusz fetch that also shows the DEAD worker."""
+    clk = FakeClock()
+    with _router(clk, canary=False) as router:
+        smp = obs.sampler(period_us=0, clock=clk)
+        eng = obs.slo_engine(
+            [obs.AvailabilitySLO("avail", objective=0.9)],
+            sampler=smp,
+            rules=(obs.BurnRateRule(fast_s=1.0, slow_s=8.0,
+                                    factor=2.0),),
+            clock=clk)
+        router.attach_slo(eng)
+        srv = obs.debug_server(port=0, router=router, slo=eng,
+                               sampler=smp)
+        try:
+            router.add_worker(_worker(
+                clk, "w0", faults=FaultPlan(CrashAt(at_batch=2))))
+            # warm traffic: two clean batches land in the slow window
+            for i in range(2):
+                r = router.submit(_payload(i), timeout_s=5.0)
+                _crank(router, clk, n=4, dt=0.1)
+                np.testing.assert_allclose(
+                    r.result(timeout=0)[0], [i, 2.0 * i, 3.0 * i])
+            # the burst: batch 2 crashes the only worker mid-flight
+            burst = [router.submit(_payload(9), timeout_s=0.5)
+                     for _ in range(4)]
+            _crank(router, clk, n=10, dt=0.1)
+            assert router.workers()["w0"] == "dead"
+            assert all(r.done() for r in burst)
+            # the alert fired and is still firing
+            assert eng.firing() == [("avail", "1s/8s")]
+            key = 'mxtpu_slo_alerts_total{slo="avail",window="1s/8s"}'
+            assert obs.summary()[key] == 1.0
+            # ... in the flight ring
+            kinds = [e["kind"]
+                     for e in obs.flight("fleet/slo").events()]
+            assert "slo_alert" in kinds
+            # ... in fleet_stats() and the worker's postmortem
+            assert router.fleet_stats()["slo"]["firing"] == \
+                [("avail", "1s/8s")]
+            pm = router.postmortem("w0")
+            assert pm["health"]["state"] == "dead"
+            assert pm["slo"]["firing"] == [("avail", "1s/8s")]
+            # ... and on the LIVE operator page
+            statusz = json.loads(_fetch(srv.url + "/statusz"))
+            assert statusz["workers"]["w0"] == "dead"
+            assert statusz["slo"]["firing"] == [["avail", "1s/8s"]]
+            tail = statusz["flight"]["fleet/slo"]
+            assert any(e["kind"] == "slo_alert" for e in tail)
+            # /healthz rolls up to degraded: nobody admits
+            health = json.loads(_fetch(srv.url + "/healthz"))
+            assert health["status"] == "degraded"
+            metrics = parse_prometheus_text(
+                _fetch(srv.url + "/metrics"))
+            assert metrics[("mxtpu_slo_alerts_total",
+                            (("slo", "avail"),
+                             ("window", "1s/8s")))] == 1.0
+        finally:
+            srv.close()
+
+
+def test_crash_scenario_bit_identical_with_obs_off(monkeypatch):
+    """Zero-overhead contract on the ISSUE 14 scenario: MXTPU_OBS=0
+    swaps every operator-layer object for its null singleton and the
+    serving results are bit-identical."""
+    def run_once():
+        clk = FakeClock()
+        with _router(clk, canary=False) as router:
+            smp = obs.sampler(period_us=0, clock=clk)
+            eng = obs.slo_engine(
+                [obs.AvailabilitySLO("avail", objective=0.9)],
+                sampler=smp,
+                rules=(obs.BurnRateRule(fast_s=1.0, slow_s=8.0,
+                                        factor=2.0),),
+                clock=clk)
+            router.attach_slo(eng)
+            router.add_worker(_worker(
+                clk, "w0", faults=FaultPlan(CrashAt(at_batch=2))))
+            outs = []
+            for i in range(2):
+                r = router.submit(_payload(i), timeout_s=5.0)
+                _crank(router, clk, n=4, dt=0.1)
+                outs.append(np.asarray(r.result(timeout=0)[0]))
+            burst = [router.submit(_payload(9), timeout_s=0.5)
+                     for _ in range(4)]
+            _crank(router, clk, n=10, dt=0.1)
+            snap = router.fleet_stats()
+            verdicts = []
+            for r in burst:
+                try:
+                    verdicts.append(
+                        ("ok", np.asarray(
+                            r.result(timeout=0)[0]).tobytes()))
+                except Exception as e:   # noqa: BLE001 — the verdict
+                    verdicts.append(("err", type(e).__name__))
+            return outs, verdicts, snap["extras"], snap["timed_out"]
+
+    on = run_once()
+    obs.reset()
+    monkeypatch.setenv("MXTPU_OBS", "0")
+    off = run_once()
+    assert obs.sampler(period_us=0) is NULL_SAMPLER
+    for a, b in zip(on[0], off[0]):
+        assert a.tobytes() == b.tobytes()
+    assert on[1:] == off[1:]
+    assert obs.registry().names() == []   # off: registry untouched
+
+
+# ------------------------------------------- autoscaler burn-rate gate
+
+class _FiringSLO:
+    enabled = True
+
+    def firing(self):
+        return [("avail", "1s/8s")]
+
+    def tick(self, now=None):
+        return []
+
+    def snapshot(self):
+        return {"slos": {}, "firing": self.firing(), "alerts": [],
+                "ticks": 0}
+
+
+def test_autoscaler_burn_gate_off_by_default():
+    clk = FakeClock()
+    with _router(clk, canary=False) as r:
+        r.add_worker(_worker(clk, "w0"))
+        made = []
+        scaler = Autoscaler(r, lambda n: made.append(n),
+                            min_workers=1, max_workers=3,
+                            up_depth=100.0, down_depth=0.0,
+                            breach_ticks=2, cooldown_s=0.0,
+                            slo=_FiringSLO())
+        assert scaler.burn_scale is False       # knob default
+        for _ in range(5):
+            clk.advance(0.1)
+            scaler.tick(clk())
+        assert made == []
+        assert scaler.snapshot()["scale_ups"] == 0
+
+
+def test_autoscaler_burn_gate_scales_up_when_enabled():
+    clk = FakeClock()
+    with _router(clk, canary=False) as r:
+        r.add_worker(_worker(clk, "w0"))
+        made = []
+
+        def mk(name):
+            w = _worker(clk, name)
+            made.append(w)
+            return w
+
+        scaler = Autoscaler(r, mk, min_workers=1, max_workers=3,
+                            up_depth=100.0, down_depth=0.0,
+                            breach_ticks=2, cooldown_s=10.0,
+                            slo=_FiringSLO(), burn_scale=True)
+        assert scaler.snapshot()["burn_scale"] is True
+        for _ in range(4):
+            clk.advance(0.1)
+            scaler.tick(clk())
+        # queue depth never breached (up_depth=100) — the firing SLO
+        # alone drove the scale-up
+        assert len(made) == 1
+        ups = [e for e in scaler.recorder.events()
+               if e["kind"] == "scale_up"]
+        assert ups and ups[-1]["burn_slos"] == [("avail", "1s/8s")]

@@ -7,7 +7,9 @@ contracts.
 * ``python -m tools.hlocheck --check`` (lowered programs vs the
   committed ``contracts/`` lockfiles), then
 * ``python -m mxtpu.obs --self-check`` (the observability layer's
-  zero-overhead-when-off + exposition round-trip contract), then
+  zero-overhead-when-off + exposition round-trip contracts, plus the
+  operator layers end-to-end on a fake clock: sampler windows, a
+  driven SLO burn-rate alert, every debug-HTTP page rendering), then
 * ``python -m mxtpu.cache --self-check`` (the persistent compile
   cache's round-trip, key-miss, poison-quarantine and read-only
   fallback probes on a throwaway root), then
