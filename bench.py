@@ -9,7 +9,10 @@ transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet|
 serving_autoscale to run a single workload (moe_ffn, ssd, bert_zero,
 serving_bert, serving_fleet and serving_autoscale are on-demand only —
 not part of the default ``all`` sweep, which is sized to the wall
-budget).  Every row's ``details``
+budget).  ``--amp`` (or MXTPU_BENCH_MODEL=resnet50_amp|bert_amp|
+transformer_amp|bert_zero_amp) runs the ``mxtpu.amp`` pair rows: the
+base workload measured AMP-off and AMP-on, rate + MFU + (for the
+ZeRO pair) contract-pinned comm bytes side by side.  Every row's ``details``
 carries ``hbm_peak`` — the per-device resident high-water
 (temp + argument bytes) of the compiled program, from XLA's
 memory_analysis.  ``bench.py --preflight`` prints the per-row wall
@@ -105,6 +108,12 @@ _METRIC_NAMES = {
     "serving_autoscale": "serving_autoscale_burst_absorb_throughput",
     "serving_coldstart": "serving_coldstart_disk_warm_speedup",
     "lenet": "lenet_mnist_train_throughput",
+    # --amp pairs: each row runs its base workload twice (AMP off /
+    # AMP on via mxtpu.amp) and reports rate + MFU + comm side by side
+    "resnet50_amp": "resnet50_imagenet_amp_train_throughput",
+    "bert_amp": "bert_large_amp_pretrain_throughput",
+    "transformer_amp": "transformer_big_wmt_amp_train_throughput",
+    "bert_zero_amp": "bert_large_zero1_amp_train_throughput",
 }
 
 # Training FLOPs per unit (sample or token), from XLA's own
@@ -140,6 +149,12 @@ _TRAIN_FLOPS = {
     "serving_coldstart": None,  # robustness row — the cold vs
                                 # disk-warmed warmup split is the result
     "lenet": None,            # too small for MFU to mean anything
+    # amp pairs reuse the base row's FLOP denominator: AMP changes
+    # operand dtypes, not the model math being counted
+    "resnet50_amp": 22.49e9,
+    "bert_amp": 2.063e9,
+    "transformer_amp": 0.727e9,
+    "bert_zero_amp": None,
 }
 
 
@@ -206,11 +221,14 @@ def bench_lenet(batch_size=512, warmup=5, iters=30):
         _METRIC_NAMES["lenet"], "samples/sec"
 
 
-def bench_resnet50(batch_size=None, warmup=3, iters=20):
+def bench_resnet50(batch_size=None, warmup=3, iters=20, amp=None):
     """ResNet-50 ImageNet-shaped training step (north-star #1).
     Defaults to the standard TPU recipe — bf16 compute over f32 master
     weights, batch 256 (MXTPU_BENCH_DTYPE= / MXTPU_BENCH_BATCH
-    override; set MXTPU_BENCH_DTYPE="" for pure f32)."""
+    override; set MXTPU_BENCH_DTYPE="" for pure f32).  ``amp=True``
+    switches to the policy-driven ``mxtpu.amp`` path (bf16 storage +
+    f32 masters + loss scaling) instead of the blanket compute-dtype
+    cast — the two are mutually exclusive."""
     from mxtpu import nd
     from mxtpu import parallel
     from mxtpu.gluon import loss as gloss
@@ -222,7 +240,9 @@ def bench_resnet50(batch_size=None, warmup=3, iters=20):
     step = parallel.build_train_step(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        compute_dtype=knobs.get("MXTPU_BENCH_DTYPE") or None)
+        compute_dtype=(None if amp
+                       else knobs.get("MXTPU_BENCH_DTYPE") or None),
+        amp=amp)
     rng = np.random.RandomState(0)
     x = nd.array(rng.randn(batch_size, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, (batch_size,)).astype(np.float32))
@@ -368,9 +388,10 @@ def bench_resnet50_pipeline(batch_size=None, warmup=4, iters=24,
 
 
 def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
-               metric_key="bert"):
+               metric_key="bert", amp=None):
     """BERT-Large MLM-style training step, tokens/sec (north-star #2).
-    bf16 compute by default (set MXTPU_BENCH_DTYPE= to override)."""
+    bf16 compute by default (set MXTPU_BENCH_DTYPE= to override);
+    ``amp=True`` takes the ``mxtpu.amp`` path instead."""
     from mxtpu import nd
     from mxtpu import parallel
     from mxtpu.gluon import loss as gloss
@@ -378,7 +399,7 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
 
     net = bert_large(vocab_size=30522, max_length=seq_len, dropout=0.1)
     net.initialize(init="xavier")
-    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
+    dtype = None if amp else knobs.get("MXTPU_BENCH_DTYPE") or None
 
     def mlm_loss(pred, y):
         V = 30522
@@ -388,7 +409,7 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
     # cast_batch=False: token ids must not be rounded through bf16
     step = parallel.build_train_step(
         net, mlm_loss, "adam", {"learning_rate": 1e-4},
-        compute_dtype=dtype, cast_batch=False)
+        compute_dtype=dtype, cast_batch=False, amp=amp)
     rng = np.random.RandomState(0)
     toks = nd.array(rng.randint(0, 30522, (batch_size, seq_len))
                     .astype(np.float32))
@@ -398,7 +419,7 @@ def bench_bert(batch_size=32, seq_len=128, warmup=3, iters=20,
 
 
 def bench_transformer(batch_size=16, src_len=64, tgt_len=64, warmup=3,
-                      iters=16):
+                      iters=16, amp=None):
     """Transformer-big WMT-shaped seq2seq training step, tokens/sec
     over src+tgt tokens (north-star #4 / M6 bench presence).  Sized to
     fit the wall budget: b16 s64/s64 keeps the compile + 5 measurement
@@ -430,7 +451,7 @@ def bench_transformer(batch_size=16, src_len=64, tgt_len=64, warmup=3,
 
     net = _MTWrap(src_len)
     net.initialize(init="xavier")
-    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
+    dtype = None if amp else knobs.get("MXTPU_BENCH_DTYPE") or None
 
     def mt_loss(pred, y):
         return gloss.SoftmaxCrossEntropyLoss()(
@@ -439,7 +460,7 @@ def bench_transformer(batch_size=16, src_len=64, tgt_len=64, warmup=3,
     # cast_batch=False: token ids must not be rounded through bf16
     step = parallel.build_train_step(
         net, mt_loss, "adam", {"learning_rate": 1e-4},
-        compute_dtype=dtype, cast_batch=False)
+        compute_dtype=dtype, cast_batch=False, amp=amp)
     rng = np.random.RandomState(0)
     x = nd.array(rng.randint(0, V, (batch_size, src_len + tgt_len))
                  .astype(np.float32))
@@ -595,7 +616,8 @@ def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
     return stats, _METRIC_NAMES["moe_ffn"], "tokens/sec"
 
 
-def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
+def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8,
+                    amp=None):
     """ZeRO-1 ablation (on-demand, MXTPU_BENCH_MODEL=bert_zero): the
     BERT-Large adam step replicated vs ZeRO-1 sharded optimizer states
     (``mxtpu.parallel`` TrainStep docs) on a dp mesh over every local
@@ -614,7 +636,7 @@ def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
     from mxtpu.models.transformer import bert_large
 
     V = 30522
-    dtype = knobs.get("MXTPU_BENCH_DTYPE") or None
+    dtype = None if amp else knobs.get("MXTPU_BENCH_DTYPE") or None
     rng = np.random.RandomState(0)
     toks = nd.array(rng.randint(0, V, (batch_size, seq_len))
                     .astype(np.float32))
@@ -629,7 +651,7 @@ def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
         net.initialize(init="xavier")
         step = parallel.build_train_step(
             net, mlm_loss, "adam", {"learning_rate": 1e-4}, mesh=mesh,
-            compute_dtype=dtype, cast_batch=False, zero=zero)
+            compute_dtype=dtype, cast_batch=False, zero=zero, amp=amp)
         stats = _measure(step, toks, toks, warmup, iters,
                          tokens_per_batch, repeats=3)
         return stats, step
@@ -667,6 +689,68 @@ def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8):
     stats = dict(stats)
     stats["info"] = info
     return stats, _METRIC_NAMES["bert_zero"], "tokens/sec"
+
+
+def _contract_comm_bytes():
+    """Reduce-scatter/all-gather byte counts from the committed
+    bert_zero contracts — the f32 program's compiled collectives vs
+    the AMP program's AS-WRITTEN collectives (the CPU backend's
+    float-normalization pass rewrites bf16 collectives back to f32 in
+    compiled text, so the as-written level is where the wire dtype
+    lives; see tools/hlocheck/targets.py::bert_zero_amp).  These are
+    the tiny pinned stand-in programs, not the bench model — the
+    RATIO is the scale-invariant contract property being reported."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "contracts",
+                               "bert_zero.json")) as f:
+            f32 = json.load(f)["programs"]["train_step"]["collectives"]
+        with open(os.path.join(here, "contracts",
+                               "bert_zero_amp.json")) as f:
+            amp = json.load(f)["programs"]["train_step_as_written"][
+                "collectives"]
+    except (OSError, KeyError, ValueError):
+        return None
+    rs_f, rs_a = f32["reduce-scatter"], amp["reduce-scatter"]
+    return {"f32_reduce_scatter_bytes": rs_f["bytes"],
+            "amp_reduce_scatter_bytes": rs_a["bytes"],
+            "reduce_scatter_bytes_ratio": round(
+                rs_a["bytes"] / rs_f["bytes"], 3),
+            "f32_all_gather_bytes": f32["all-gather"]["bytes"],
+            "amp_all_gather_bytes": amp["all-gather"]["bytes"],
+            "counts_equal": rs_f["count"] == rs_a["count"]}
+
+
+def bench_amp_pair(key, base_fn, **kw):
+    """One --amp row: the base workload measured twice — AMP off
+    (the row's existing recipe) and AMP on (``mxtpu.amp``: bf16
+    storage + autocast + f32 masters + loss scaling) — reported side
+    by side.  The primary value is the AMP-on rate; ``details``
+    carries both variants' rate/MFU/HBM and, for the ZeRO pair, the
+    contract-pinned comm-byte split."""
+    off, _, unit = base_fn(amp=None, **kw)
+    on, _, _ = base_fn(amp=True, **kw)
+    peak = _peak_flops()
+    base_key = key[: -len("_amp")]
+
+    def _side(stats):
+        return {"best": round(stats["best"], 1),
+                "median": round(stats["median"], 1),
+                "mfu": _mfu(base_key, stats["best"], peak),
+                "hbm_peak": (stats.get("info") or {}).get("hbm_peak")}
+
+    info = dict(on.get("info") or {})
+    info.update({
+        "amp_off": _side(off), "amp_on": _side(on),
+        "amp_speedup": round(on["best"] / off["best"], 3),
+    })
+    if base_key == "bert_zero":
+        comm = _contract_comm_bytes()
+        if comm:
+            info["comm_contract"] = comm
+    stats = dict(on)
+    stats["info"] = info
+    return stats, _METRIC_NAMES[key], unit
 
 
 def bench_serving_bert(seq_len=64, max_batch=8, repeats=3):
@@ -1203,7 +1287,10 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             "serving_autoscale": 90,
             # 2 repeats x (cold ladder compile + disk-warmed reload +
             # two first-request probes) of a 2-layer BERT
-            "serving_coldstart": 120}
+            "serving_coldstart": 120,
+            # pairs run the base workload twice (off + on)
+            "resnet50_amp": 300, "bert_amp": 300,
+            "transformer_amp": 240, "bert_zero_amp": 300}
 
 
 def _sweep_stale_tmpdirs():
@@ -1261,7 +1348,22 @@ def main():
              "serving_bert": bench_serving_bert,
              "serving_fleet": bench_serving_fleet,
              "serving_autoscale": bench_serving_autoscale,
-             "serving_coldstart": bench_serving_coldstart}
+             "serving_coldstart": bench_serving_coldstart,
+             # --amp pairs (on-demand): AMP off vs on side by side
+             "resnet50_amp": lambda: bench_amp_pair(
+                 "resnet50_amp", bench_resnet50),
+             "bert_amp": lambda: bench_amp_pair(
+                 "bert_amp", bench_bert),
+             "transformer_amp": lambda: bench_amp_pair(
+                 "transformer_amp", bench_transformer),
+             "bert_zero_amp": lambda: bench_amp_pair(
+                 "bert_zero_amp", bench_bert_zero)}
+    if "--amp" in sys.argv[1:]:
+        # `bench.py --amp` swaps every selected row that has an AMP
+        # pair for it (MXTPU_BENCH_MODEL=resnet50 --amp runs the
+        # resnet50_amp pair; rows without a pair run unchanged)
+        if which != "all" and f"{which}_amp" in table:
+            which = f"{which}_amp"
     if which != "all" and which not in table:
         sys.exit(f"unknown MXTPU_BENCH_MODEL={which!r}; "
                  f"choices: {sorted(table) + ['all']}")
@@ -1269,6 +1371,9 @@ def main():
     order = [which] if which != "all" else \
         ["resnet50", "resnet50_pipeline", "bert", "bert_s512",
          "transformer", "lenet"]
+    if "--amp" in sys.argv[1:] and which == "all":
+        order = [f"{m}_amp" if f"{m}_amp" in table else m
+                 for m in order]
     est_total = sum(_ROW_EST[m] for m in order)
     if "--contracts" in sys.argv[1:]:
         # fail FAST if any program drifted from its committed

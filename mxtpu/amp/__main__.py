@@ -1,0 +1,32 @@
+"""``python -m mxtpu.amp --self-check`` — the ci_static AMP stage.
+
+Probes the three contracts the AMP pass rests on: the committed
+``contracts/amp_policy.json`` parses and keeps its class invariants, an
+autocast round-trip on the selftest dot produces bf16 contraction edges
+with zero dtype-flow hazards (and no bf16 leak outside the scope), and
+the dynamic loss scaler's grow/backoff/skip accounting is exact.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m mxtpu.amp")
+    parser.add_argument("--self-check", action="store_true",
+                        help="probe policy parse + autocast round-trip "
+                             "+ scaler units")
+    args = parser.parse_args(argv)
+    if not args.self_check:
+        parser.print_help()
+        return 2
+    # the round-trip lowers a program; stay off any attached accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from . import self_check
+    return self_check(verbose=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,23 @@ import jax
 from .. import knobs
 
 __all__ = ["layer_norm", "flash_attention", "pallas_enabled",
-           "precision_metadata"]
+           "precision_metadata", "layout_metadata"]
+
+
+def layout_metadata():
+    """``{kernel_name: LAYOUT}`` for every Pallas kernel — the
+    declared operand-layout contract (which physical layouts each
+    custom call binds without relayout copies, and the knob that
+    picks a variant).  The layout half of the AMP/MFU work: transpose
+    brackets around custom calls are invisible to cost_analysis, so
+    the contract is stated where dispatch lives and audited by
+    test/hlocheck instead of rediscovered per regression."""
+    import importlib
+    return {
+        name: dict(importlib.import_module(
+            f"{__name__}.{name}").LAYOUT)
+        for name in ("flash_attention", "layer_norm", "batch_norm")
+    }
 
 
 def precision_metadata():

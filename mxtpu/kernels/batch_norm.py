@@ -64,6 +64,31 @@ PRECISION = {
             "add+relu epilogue compute in f32",
 }
 
+# Declared operand-layout contract, aggregated by
+# ``mxtpu.kernels.layout_metadata()`` — what each variant pins about
+# the physical layout of the tensors the custom call binds, so the
+# layout cost (transpose brackets, r5's measured loss) is stated where
+# the dispatch decision lives instead of rediscovered per audit.
+LAYOUT = {
+    "channels_major": {
+        "view": "(N, C, S) blocks, C on sublanes",
+        "binds": "row-major NCHW operands; conv nets whose "
+                 "activations XLA stores channels-minor ({1,0,3,2}) "
+                 "pay full-tensor transpose brackets per call",
+    },
+    "channels_minor": {
+        "view": "(N*S, C) blocks, C on lanes",
+        "binds": "the native channels-minor conv activation layout — "
+                 "the (N,C,S)->(N*S,C) relayout resolves to the "
+                 "copy XLA already performs (or a no-op when the "
+                 "producer is channels-minor), removing the "
+                 "per-call transpose brackets",
+    },
+    "dispatch": "MXTPU_BN_LAYOUT: auto prefers channels-minor when "
+                "one (rows, C) stage fits MXTPU_BN_VMEM_CAP_MB, else "
+                "channels-major, else composite; cm/major force",
+}
+
 
 # ----------------------------------------------------------------------
 # composite oracle (plain jnp, jax-autodiff) — parity target for tests
@@ -158,6 +183,65 @@ def _bwd_kernel(*refs, n, act, add):
     db_ref[:] = dbeta
 
 
+def _fwd_kernel_cm(*refs, n, eps, act, add):
+    # Channels-MINOR twin: the block is (R, cbl) with channels on
+    # LANES — the layout conv activations already have — and the
+    # per-channel stats reduce over the row (sublane) axis, landing
+    # as (1, cbl) lane vectors that broadcast back row-wise with no
+    # relayout at all.
+    if add:
+        x_ref, r_ref, g_ref, b_ref, y_ref, mean_ref, var_ref = refs
+    else:
+        x_ref, g_ref, b_ref, y_ref, mean_ref, var_ref = refs
+    x = x_ref[:].astype(jnp.float32)                     # (R, cbl)
+    s1 = jnp.sum(x, axis=0, keepdims=True)               # (1, cbl)
+    s2 = jnp.sum(x * x, axis=0, keepdims=True)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    rstd = lax.rsqrt(var + eps)
+    g = g_ref[:].astype(jnp.float32)                     # (1, cbl)
+    scale = g * rstd
+    shift = b_ref[:].astype(jnp.float32) - mean * scale
+    y = x * scale + shift
+    if add:
+        y = y + r_ref[:].astype(jnp.float32)
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    var_ref[:] = var
+
+
+def _bwd_kernel_cm(*refs, n, act, add):
+    if add:
+        (x_ref, r_ref, dy_ref, g_ref, b_ref, mean_ref, rstd_ref,
+         dx_ref, dr_ref, dg_ref, db_ref) = refs
+    else:
+        (x_ref, dy_ref, g_ref, b_ref, mean_ref, rstd_ref,
+         dx_ref, dg_ref, db_ref) = refs
+    mean = mean_ref[:]                                   # (1, cbl)
+    rstd = rstd_ref[:]
+    g = g_ref[:].astype(jnp.float32)
+    b = b_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)                     # (R, cbl)
+    dy = dy_ref[:].astype(jnp.float32)
+    xhat = (x - mean) * rstd
+    if act == "relu":
+        a = xhat * g + b
+        if add:
+            a = a + r_ref[:].astype(jnp.float32)
+        dy = jnp.where(a > 0, dy, 0.0)
+    if add:
+        dr_ref[:] = dy.astype(dr_ref.dtype)
+    dbeta = jnp.sum(dy, axis=0, keepdims=True)
+    dgamma = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    grs = g * rstd
+    dx = grs * (dy - dbeta / n - xhat * (dgamma / n))
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dg_ref[:] = dgamma
+    db_ref[:] = dbeta
+
+
 # ----------------------------------------------------------------------
 # block selection / feasibility
 # ----------------------------------------------------------------------
@@ -186,6 +270,25 @@ def _pick_cb(N, C, S, itemsize, mult):
         if C % cb == 0 and mult * cb * per_ch <= _vmem_cap():
             best = cb
         cb += sub
+    return best
+
+
+def _pick_cbl(R, C, itemsize, mult):
+    """Channels-minor lane-block: the largest channel count (lane
+    extent) dividing C whose (R, cbl) stage — rows padded to the
+    sublane tile, lanes to 128 — keeps ``mult`` staged copies under
+    the VMEM cap.  The reduction extent R = N*S stages WHOLE, which is
+    what makes the large-spatial stages infeasible in this layout
+    (the r5 measurement) and why dispatch is per-layer."""
+    sub = 16 if itemsize == 2 else 8
+    rpad = -(-R // sub) * sub
+    best = None
+    cands = sorted({c for c in list(range(128, C + 1, 128)) + [C]
+                    if C % c == 0})
+    for cbl in cands:
+        lpad = -(-cbl // 128) * 128
+        if mult * rpad * lpad * itemsize <= _vmem_cap():
+            best = cbl
     return best
 
 
@@ -274,6 +377,81 @@ def _bwd_call(x3, resid3, dy3, gamma, beta, mean, rstd, act, cb,
 
 
 # ----------------------------------------------------------------------
+# pallas_call wrappers — channels-minor ((N*S, C) views)
+# ----------------------------------------------------------------------
+
+def _blk2(R, cbl):
+    return pl.BlockSpec((R, cbl), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+
+
+def _blkc_cm(cbl):
+    return pl.BlockSpec((1, cbl), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+
+
+def _fwd_call_cm(x2, gamma, beta, resid2, eps, act, cbl, interpret):
+    R, C = x2.shape
+    n = float(R)
+    grid = (C // cbl,)
+    add = resid2 is not None
+    ins = [x2] + ([resid2] if add else []) + \
+        [gamma.reshape(1, C), beta.reshape(1, C)]
+    in_specs = [_blk2(R, cbl)] + ([_blk2(R, cbl)] if add else []) + \
+        [_blkc_cm(cbl), _blkc_cm(cbl)]
+    y, mean, var = pl.pallas_call(
+        functools.partial(_fwd_kernel_cm, n=n, eps=eps, act=act,
+                          add=add),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[_blk2(R, cbl), _blkc_cm(cbl), _blkc_cm(cbl)],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C), x2.dtype),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(*ins)
+    return y, mean.reshape(C), var.reshape(C)
+
+
+def _bwd_call_cm(x2, resid2, dy2, gamma, beta, mean, rstd, act, cbl,
+                 interpret):
+    R, C = x2.shape
+    n = float(R)
+    grid = (C // cbl,)
+    add = resid2 is not None
+    ins = [x2] + ([resid2] if add else []) + \
+        [dy2, gamma.reshape(1, C), beta.reshape(1, C),
+         mean.reshape(1, C), rstd.reshape(1, C)]
+    in_specs = [_blk2(R, cbl)] + ([_blk2(R, cbl)] if add else []) + \
+        [_blk2(R, cbl), _blkc_cm(cbl), _blkc_cm(cbl), _blkc_cm(cbl),
+         _blkc_cm(cbl)]
+    out_specs = [_blk2(R, cbl)] + ([_blk2(R, cbl)] if add else []) + \
+        [_blkc_cm(cbl), _blkc_cm(cbl)]
+    out_shape = [jax.ShapeDtypeStruct((R, C), x2.dtype)] + \
+        ([jax.ShapeDtypeStruct((R, C), dy2.dtype)] if add else []) + \
+        [jax.ShapeDtypeStruct((1, C), jnp.float32),
+         jax.ShapeDtypeStruct((1, C), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel_cm, n=n, act=act, add=add),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(*ins)
+    if add:
+        dx, dr, dg, db = outs
+    else:
+        dx, dg, db = outs
+        dr = None
+    return dx, dr, dg.reshape(C), db.reshape(C)
+
+
+# ----------------------------------------------------------------------
 # custom-VJP wrappers
 # ----------------------------------------------------------------------
 
@@ -329,6 +507,59 @@ def _fused_bn_add_bwd(eps, act, cb, res, dys):
 _fused_bn_add.defvjp(_fused_bn_add_fwd, _fused_bn_add_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_bn_cm(x2, gamma, beta, eps, act, cbl):
+    from . import interpret_mode
+    return _fwd_call_cm(x2, gamma, beta, None, eps, act, cbl,
+                        interpret_mode())
+
+
+def _fused_bn_cm_fwd(x2, gamma, beta, eps, act, cbl):
+    from . import interpret_mode
+    y, mean, var = _fwd_call_cm(x2, gamma, beta, None, eps, act, cbl,
+                                interpret_mode())
+    return (y, mean, var), (x2, gamma, beta, mean, var)
+
+
+def _fused_bn_cm_bwd(eps, act, cbl, res, dys):
+    from . import interpret_mode
+    x2, gamma, beta, mean, var = res
+    rstd = lax.rsqrt(var + eps)
+    dx, _, dg, db = _bwd_call_cm(x2, None, dys[0], gamma, beta, mean,
+                                 rstd, act, cbl, interpret_mode())
+    return dx, dg.astype(gamma.dtype), db.astype(beta.dtype)
+
+
+_fused_bn_cm.defvjp(_fused_bn_cm_fwd, _fused_bn_cm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_bn_add_cm(x2, resid2, gamma, beta, eps, act, cbl):
+    from . import interpret_mode
+    return _fwd_call_cm(x2, gamma, beta, resid2, eps, act, cbl,
+                        interpret_mode())
+
+
+def _fused_bn_add_cm_fwd(x2, resid2, gamma, beta, eps, act, cbl):
+    from . import interpret_mode
+    y, mean, var = _fwd_call_cm(x2, gamma, beta, resid2, eps, act, cbl,
+                                interpret_mode())
+    return (y, mean, var), (x2, resid2, gamma, beta, mean, var)
+
+
+def _fused_bn_add_cm_bwd(eps, act, cbl, res, dys):
+    from . import interpret_mode
+    x2, resid2, gamma, beta, mean, var = res
+    rstd = lax.rsqrt(var + eps)
+    dx, dr, dg, db = _bwd_call_cm(x2, resid2, dys[0], gamma, beta,
+                                  mean, rstd, act, cbl,
+                                  interpret_mode())
+    return dx, dr, dg.astype(gamma.dtype), db.astype(beta.dtype)
+
+
+_fused_bn_add_cm.defvjp(_fused_bn_add_cm_fwd, _fused_bn_add_cm_bwd)
+
+
 # ----------------------------------------------------------------------
 # public entry
 # ----------------------------------------------------------------------
@@ -362,17 +593,42 @@ def fused_bn_act(x, gamma, beta, eps=1e-5, act="none", residual=None):
             S *= d
         # bwd is the high-water mark for scoped VMEM (see _pick_cb)
         mult = 20 if residual is not None else 14
-        cb = _pick_cb(N, C, S, x.dtype.itemsize, mult)
-        if cb is not None:
-            x3 = x.reshape(N, C, S)
-            r3 = residual.reshape(N, C, S) \
-                if residual is not None else None
-            if r3 is None:
-                y, mean, var = _fused_bn(x3, gamma, beta, eps, act, cb)
-            else:
-                y, mean, var = _fused_bn_add(x3, r3, gamma, beta, eps,
-                                             act, cb)
-            return y.reshape(x.shape), mean, var
+        layout = knobs.get("MXTPU_BN_LAYOUT").strip().lower()
+        if layout in ("auto", "cm"):
+            # channels-minor first (the AMP layout fix): C rides the
+            # lanes like the conv activations feeding it, so the
+            # custom call binds without the transpose brackets that
+            # made the channels-major kernel a net loss in conv nets
+            # (module docstring, r5).  Infeasible (large-spatial
+            # stage) -> channels-major under "auto", composite when
+            # forced "cm".
+            cbl = _pick_cbl(N * S, C, x.dtype.itemsize, mult)
+            if cbl is not None:
+                x2 = x.reshape(N, C, S).swapaxes(1, 2).reshape(N * S, C)
+                r2 = residual.reshape(N, C, S).swapaxes(1, 2) \
+                    .reshape(N * S, C) if residual is not None else None
+                if r2 is None:
+                    y, mean, var = _fused_bn_cm(x2, gamma, beta, eps,
+                                                act, cbl)
+                else:
+                    y, mean, var = _fused_bn_add_cm(x2, r2, gamma,
+                                                    beta, eps, act,
+                                                    cbl)
+                y = y.reshape(N, S, C).swapaxes(1, 2).reshape(x.shape)
+                return y, mean, var
+        if layout in ("auto", "major"):
+            cb = _pick_cb(N, C, S, x.dtype.itemsize, mult)
+            if cb is not None:
+                x3 = x.reshape(N, C, S)
+                r3 = residual.reshape(N, C, S) \
+                    if residual is not None else None
+                if r3 is None:
+                    y, mean, var = _fused_bn(x3, gamma, beta, eps, act,
+                                             cb)
+                else:
+                    y, mean, var = _fused_bn_add(x3, r3, gamma, beta,
+                                                 eps, act, cb)
+                return y.reshape(x.shape), mean, var
     # composite fallback: analytic-VJP core + jnp epilogue
     from ..ndarray.ops_impl import _bn_train_core
     y, mean, var = _bn_train_core(x, gamma, beta, 1, eps)

@@ -52,6 +52,21 @@ PRECISION = {
             "downcast to the input dtype on output",
 }
 
+# Operand-layout contract (see batch_norm.LAYOUT): head_dim minor is
+# the layout the QKV projection matmuls emit, so the custom call
+# binds transpose-free on every operand.
+LAYOUT = {
+    "native": {
+        "view": "(seq_block, head_dim) tiles per (batch*heads) "
+                "program, head_dim on lanes",
+        "binds": "row-major (B, H, T, D) — the projection matmul "
+                 "output layout; k is transposed in-kernel on the "
+                 "MXU, never relaid out in HBM",
+    },
+    "dispatch": "MXTPU_FLASH_BWD picks the backward path; forward "
+                "always blockwise on TPU",
+}
+
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
     """Pure-lax attention — fallback path and parity oracle.

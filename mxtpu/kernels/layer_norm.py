@@ -32,6 +32,18 @@ PRECISION = {
             "in f32 for the backward",
 }
 
+# Operand-layout contract (see batch_norm.LAYOUT): already minor-most
+# on the reduced axis, so no relayout brackets arise — the row-major
+# (rows, features) view IS the layout the producing matmuls emit.
+LAYOUT = {
+    "native": {
+        "view": "(rows, features) row blocks, features on lanes",
+        "binds": "row-major — matches the (…, D) activations the "
+                 "surrounding matmuls produce; no transpose brackets",
+    },
+    "dispatch": "always; feature axis stages whole in VMEM",
+}
+
 
 def layer_norm_reference(x, gamma, beta, eps=1e-5):
     """Pure-lax composite — the fallback path and parity oracle."""

@@ -137,6 +137,22 @@ register("MXTPU_EXECUTOR_JIT", True, "bool",
          "Symbolic Executor compiles the bound graph under a "
          "shape-keyed jax.jit; `0` falls back to eager per-op "
          "interpretation.", "kill-switch")
+register("MXTPU_AMP", "", "str",
+         "Policy-driven bf16 autocast (mxtpu.amp, consumes "
+         "contracts/amp_policy.json): `0` is the kill switch — forces "
+         "AMP off everywhere and the trained/served programs are "
+         "bit-identical to pre-AMP; `1` force-enables it for every "
+         "TrainStep/ModelRunner; unset defers to the per-call "
+         "`amp=` argument.", "kill-switch")
+register("MXTPU_AMP_LOSS_SCALE", 65536.0, "float",
+         "Initial dynamic loss scale for AMP training (power of two; "
+         "grows x2 per stable window, halves on non-finite grads).  "
+         "`0` disables loss scaling entirely (pure autocast, no "
+         "skipped-step logic).", "kill-switch")
+register("MXTPU_AMP_SCALE_WINDOW", 2000, "int",
+         "Consecutive finite-grad steps before the AMP loss scale "
+         "doubles (the grow window; backoff on a non-finite step is "
+         "immediate).", "kill-switch")
 
 # -- guards (this PR) --------------------------------------------------
 register("MXTPU_GUARDS", "", "str",
@@ -224,6 +240,11 @@ register("MXTPU_DEFAULT_DTYPE", "float32", "str",
 register("MXTPU_BN_VMEM_CAP_MB", 120, "int",
          "Scoped-VMEM budget for the Pallas BN kernel's channel-block "
          "selection.", "engine")
+register("MXTPU_BN_LAYOUT", "auto", "str",
+         "Fused-BN kernel operand layout: `auto` picks channels-minor "
+         "(C on lanes, one (rows, C) block) when the whole stage fits "
+         "the VMEM cap, else channels-major; `cm`/`major` force a "
+         "variant.", "engine")
 register("MXTPU_KVSTORE_BIGARRAY_BOUND", 1048576, "int",
          "Arrays >= this many elements use the big-array kvstore "
          "path.", "engine")

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import amp as _amp
 from ..base import MXNetError
 from ..ops.registry import OP_REGISTRY, get_op, list_ops
 from . import ops_impl  # noqa: F401  (populates the registry)
@@ -75,10 +76,17 @@ def _invoke_op_inner(name: str, *inputs, **kwargs):
             arrays.append(jnp.asarray(x))
     resolved = op.resolve_params(kwargs)
 
+    # policy-driven autocast (mxtpu.amp): inside an autocast scope,
+    # allow-listed contractions get their f32 inputs cast to bf16
+    # *inside* the dispatched function so both jax AD and the eager
+    # tape differentiate through the casts.  Off path: one global read.
+    amp_fn = _amp.wrap_op(name, op, arrays, resolved) \
+        if _amp._ACTIVE else None
+
     from .. import autograd
     if (autograd.is_recording() and op.differentiable
             and any(autograd._needs_grad(x) for x in inputs)):
-        fn = lambda *arrs: op.fn(*arrs, **resolved)  # noqa: E731
+        fn = amp_fn or (lambda *arrs: op.fn(*arrs, **resolved))  # noqa: E731
         out, node = autograd.record_op(name, fn, inputs, arrays)
         if isinstance(out, tuple):
             wrapped = tuple(NDArray(o, ctx, _placed=True) for o in out)
@@ -89,7 +97,8 @@ def _invoke_op_inner(name: str, *inputs, **kwargs):
         autograd.attach_output(w, node, 0)
         return w
 
-    out = op.fn(*arrays, **resolved)
+    out = amp_fn(*arrays) if amp_fn is not None \
+        else op.fn(*arrays, **resolved)
     if isinstance(out, tuple):
         return tuple(NDArray(o, ctx, _placed=True) for o in out)
     return NDArray(out, ctx, _placed=True)

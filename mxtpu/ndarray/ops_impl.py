@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import amp as _amp
 from ..base import MXNetError
 from ..ops.registry import Param, register_op
 
@@ -574,39 +575,54 @@ def _dot(a, b, transpose_a=False, transpose_b=False):
         a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    pref = _amp.matmul_preferred(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        return jnp.dot(a, b)
+        if pref is not None:  # bf16 fwd+bwd GEMMs, f32 accumulation
+            return _amp.dot_general(a, b, (((0,), (0,)), ((), ())))
+        return jnp.dot(a, b, preferred_element_type=pref)
     # reference dot: contract last axis of a with first axis of b
-    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+    if pref is not None:
+        return _amp.dot_general(a, b,
+                                (((a.ndim - 1,), (0,)), ((), ())))
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]),
+                         preferred_element_type=pref)
 
 
 register_op("dot", num_inputs=2,
             params=[Param("transpose_a", bool, False),
                     Param("transpose_b", bool, False)])(_dot)
 
+def _matmul2(a, b):
+    pref = _amp.matmul_preferred(a, b)
+    if pref is not None and a.ndim >= 2 and b.ndim >= 2:
+        # bf16 fwd+bwd GEMMs, f32 accumulation (amp's custom VJP)
+        return _amp.matmul(a, b)
+    return jnp.matmul(a, b, preferred_element_type=pref)
+
+
 register_op("batch_dot", num_inputs=2,
             params=[Param("transpose_a", bool, False),
                     Param("transpose_b", bool, False)])(
     lambda a, b, transpose_a=False, transpose_b=False:
-    jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
-               jnp.swapaxes(b, -1, -2) if transpose_b else b))
+    _matmul2(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+             jnp.swapaxes(b, -1, -2) if transpose_b else b))
 
-register_op("matmul", num_inputs=2)(lambda a, b: jnp.matmul(a, b))
+register_op("matmul", num_inputs=2)(_matmul2)
 
 register_op("linalg_gemm2", num_inputs=2,
             params=[Param("transpose_a", bool, False),
                     Param("transpose_b", bool, False),
                     Param("alpha", float, 1.0)])(
     lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0:
-    alpha * jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
-                       jnp.swapaxes(b, -1, -2) if transpose_b else b))
+    alpha * _matmul2(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                     jnp.swapaxes(b, -1, -2) if transpose_b else b))
 register_op("linalg_gemm", num_inputs=3,
             params=[Param("transpose_a", bool, False),
                     Param("transpose_b", bool, False),
                     Param("alpha", float, 1.0),
                     Param("beta", float, 1.0)])(
     lambda a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
-    beta=1.0: alpha * jnp.matmul(
+    beta=1.0: alpha * _matmul2(
         jnp.swapaxes(a, -1, -2) if transpose_a else a,
         jnp.swapaxes(b, -1, -2) if transpose_b else b) + beta * c)
 register_op("linalg_potrf")(lambda a: jnp.linalg.cholesky(a))
@@ -658,7 +674,12 @@ register_op("FullyConnected", num_inputs=-1,
 def _fully_connected(x, w, b, no_bias, flatten):
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    y = jnp.matmul(x, w.T)
+    pref = _amp.matmul_preferred(x, w)
+    if pref is not None:  # bf16 fwd+bwd GEMMs, f32 accumulation
+        y = _amp.dot_general(x, w,
+                             (((x.ndim - 1,), (1,)), ((), ())))
+    else:
+        y = jnp.matmul(x, w.T, preferred_element_type=pref)
     if b is not None and not no_bias:
         y = y + b
     return y
@@ -687,15 +708,20 @@ def _convolution(x, w, b=None, kernel=(), stride=None, dilate=None,
     stride = _tuple(stride, nd)
     dilate = _tuple(dilate, nd)
     pad = _tuple(pad, nd) if pad is not None else (0,) * nd
-    # no preferred_element_type upcast: the MXU accumulates bf16
-    # products in f32 natively, and an explicit f32 output dtype breaks
-    # the conv VJP (f32 cotangent against bf16 operands)
-    out = lax.conv_general_dilated(
-        x, w, window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=num_group)
+    if _amp.matmul_preferred(x, w) is not None:
+        # bf16 operands under autocast: lax's builtin conv transpose
+        # rule rejects the f32-cotangent/bf16-operand pair, so the
+        # f32-accumulating conv carries its own VJP in mxtpu.amp
+        out = _amp.conv_general(
+            x, w, stride, tuple((p, p) for p in pad), dilate, dn,
+            num_group)
+    else:
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=num_group)
     if b is not None and not no_bias:
         if layout.endswith("C"):
             out = out + b

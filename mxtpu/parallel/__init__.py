@@ -26,6 +26,7 @@ total comm bytes (rs + ag == ar).
 """
 from __future__ import annotations
 
+import contextlib
 import weakref
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -36,6 +37,7 @@ from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import amp as _amp_mod
 from ..base import MXNetError
 from .. import cache as cache_mod
 from .. import guards
@@ -316,7 +318,7 @@ class TrainStep:
                  dp_axis: str = "dp", batch_axis: int = 0,
                  param_spec_fn: Optional[Callable] = None, donate=True,
                  compute_dtype=None, cast_batch=True, zero=None,
-                 cache: Any = "auto"):
+                 cache: Any = "auto", amp=None):
         from ..gluon.block import _traced_forward
         self._traced_forward = _traced_forward
         self.net = net
@@ -339,6 +341,21 @@ class TrainStep:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self.cast_batch = cast_batch
+        # policy-driven AMP (mxtpu.amp): params stored bf16 over f32
+        # masters, contraction-only bf16 casts from the committed
+        # policy, dynamic loss scaling.  MXTPU_AMP=0 forces this off
+        # everywhere; the off path traces the exact pre-AMP program.
+        self.amp = _amp_mod.resolve(amp)
+        if self.amp and self.compute_dtype is not None:
+            raise MXNetError(
+                "amp and compute_dtype are two mixed-precision "
+                "recipes — pass one (amp supersedes compute_dtype)")
+        self._amp_state = None
+        if self.amp:
+            (self._amp_scaler, self._amp_init_scale,
+             self._amp_window) = _amp_mod.scaler_config()
+        else:
+            self._amp_scaler = False
         self._compiled = {}
         self._params: Optional[List] = None
         self._t = 0
@@ -388,6 +405,17 @@ class TrainStep:
             "In-process compile-cache misses served from the "
             "persistent disk cache instead of XLA.",
             labels=("entry",)).labels(entry=_entry)
+        if self.amp:
+            self._m_amp_scale = obs.gauge(
+                "mxtpu_amp_loss_scale",
+                "Current dynamic loss scale (1.0 when scaling is "
+                "disabled via MXTPU_AMP_LOSS_SCALE=0).",
+                labels=("entry",)).labels(entry=_entry)
+            self._m_amp_skipped = obs.gauge(
+                "mxtpu_amp_skipped_steps",
+                "Optimizer steps skipped because non-finite gradients "
+                "tripped the loss-scaler backoff.",
+                labels=("entry",)).labels(entry=_entry)
 
     def _decide_zero(self, zero) -> bool:
         """Resolve the ZeRO-1 mode: ``MXTPU_ZERO=0`` is the global
@@ -422,6 +450,14 @@ class TrainStep:
             return False
         return True
 
+    def _amp_extra(self) -> tuple:
+        """Trailing loss-scaler argument for the step callables —
+        empty when AMP (or scaling) is off, so the off path keeps the
+        exact pre-AMP signature and traced program."""
+        if self._amp_scaler and self._amp_state is not None:
+            return (self._amp_state,)
+        return ()
+
     # -- parameter bookkeeping -----------------------------------------
     def _collect(self, x):
         if self._params is None:
@@ -440,6 +476,21 @@ class TrainStep:
             # may be indexed by a different ordering (e.g. a shared
             # gluon.Trainer instance).
             self._opt_init, self._opt_update = _opt_rule(self.optimizer)
+            if self.amp:
+                # fp32 masters by construction: trainable f32 params
+                # are STORED bf16 from here on (halving param comm and
+                # the all-gather under ZeRO-1), and the optimizer's
+                # multi-precision rule — which seeds a master copy for
+                # every sub-f32 weight — keeps the f32 truth in the
+                # optimizer state.  Aux-named params (BN running
+                # stats) are never trainable and stay f32.
+                from ..symbol import _is_aux_name
+                for i in self._train_idx:
+                    p = allp[i]
+                    v = p._data._data
+                    if (v.dtype == jnp.float32
+                            and not _is_aux_name(p.name)):
+                        p._data._data = v.astype(jnp.bfloat16)
             if self.mesh is not None:
                 for p in allp:
                     spec = None
@@ -458,6 +509,12 @@ class TrainStep:
                     self._opt_state = jax.tree_util.tree_map(
                         lambda v: _device_put_global(v, self.mesh, P()),
                         self._opt_state)
+            if self._amp_scaler and self._amp_state is None:
+                st = _amp_mod.scaler_init(self._amp_init_scale)
+                if self.mesh is not None:
+                    st = tuple(_device_put_global(v, self.mesh, P())
+                               for v in st)
+                self._amp_state = st
 
     def _init_zero_state(self):
         """ZeRO-1 state: one stacked, padded array per (shape, dtype)
@@ -534,6 +591,7 @@ class TrainStep:
 
         compute_dtype = self.compute_dtype
         cast_batch = self.cast_batch
+        amp_on = self.amp
 
         def loss_flat(train_vals, frozen_vals, key_data, x, y):
             pvals: List[Any] = [None] * n_param
@@ -553,15 +611,33 @@ class TrainStep:
                          for i, v in enumerate(pvals)]
                 if cast_batch and jnp.issubdtype(x.dtype, jnp.floating):
                     x = x.astype(compute_dtype)
-            raw_outs, _, aux_params, raw_aux = traced_forward(
-                net, params, pvals, [NDArray(x, None, _placed=True)],
-                True, key_data)
-            outs = [NDArray(r, None, _placed=True) for r in raw_outs]
-            # Multi-output nets hand ALL outputs to the loss (a custom
-            # loss_fn must unpack them) rather than silently training
-            # only the first head.
-            pred = outs[0] if len(outs) == 1 else outs
-            l = loss_fn(pred, NDArray(y, None, _placed=True))
+            elif amp_on:
+                # AMP entry upcast: every float param re-enters the
+                # graph in f32, so ONLY the policy's allow-listed
+                # contractions ever see bf16 (via the autocast scope
+                # below) and every accumulating reduce stays f32 —
+                # zero dtype-flow hazards by construction.  XLA folds
+                # the bf16→f32→bf16 convert pair at the weight→dot
+                # edges, and the AD transpose of this upcast is what
+                # hands back bf16 grads at the param boundary.
+                pvals = [v.astype(jnp.float32)
+                         if v is not None
+                         and jnp.issubdtype(v.dtype, jnp.floating)
+                         and v.dtype != jnp.float32
+                         else v
+                         for v in pvals]
+            scope = _amp_mod.autocast() if amp_on \
+                else contextlib.nullcontext()
+            with scope:
+                raw_outs, _, aux_params, raw_aux = traced_forward(
+                    net, params, pvals, [NDArray(x, None, _placed=True)],
+                    True, key_data)
+                outs = [NDArray(r, None, _placed=True) for r in raw_outs]
+                # Multi-output nets hand ALL outputs to the loss (a
+                # custom loss_fn must unpack them) rather than silently
+                # training only the first head.
+                pred = outs[0] if len(outs) == 1 else outs
+                l = loss_fn(pred, NDArray(y, None, _placed=True))
             raw_l = l.data if isinstance(l, NDArray) else l
             aux_box["aux_params"] = aux_params
             # loss and aux (running stats) leave the bf16 region in f32
@@ -633,6 +709,49 @@ class TrainStep:
                                                 opt_state, lrs, wds)
             return loss, new_vals, new_state, raw_aux
 
+        if amp_on and not self.zero:
+            window = self._amp_window if self._amp_scaler else None
+
+            if self._amp_scaler:
+                def step(train_vals, frozen_vals, opt_state, key_data,  # noqa: F811
+                         lrs, wds, x, y, scaler):
+                    scale = scaler[0]
+
+                    def scaled(tv, fv, kd, xx, yy):
+                        l, aux = loss_flat(tv, fv, kd, xx, yy)
+                        return l * scale.astype(l.dtype), (l, aux)
+
+                    (_, (loss, raw_aux)), grads = jax.value_and_grad(
+                        scaled, has_aux=True)(train_vals, frozen_vals,
+                                              key_data, x, y)
+                    # grads reach the param edge in bf16 (AD transpose
+                    # of the entry upcast); unscale in f32 so the
+                    # finite test and the optimizer see full range
+                    grads = tuple(g.astype(jnp.float32) / scale
+                                  for g in grads)
+                    finite = _amp_mod.all_finite(grads)
+                    new_vals, new_state = apply_updates(
+                        train_vals, grads, opt_state, lrs, wds)
+                    # skipped step: keep params AND state, back off
+                    keep = lambda n, o: jnp.where(finite, n, o)  # noqa: E731
+                    new_vals = tuple(map(keep, new_vals, train_vals))
+                    new_state = jax.tree_util.tree_map(
+                        keep, new_state, opt_state)
+                    scaler2 = _amp_mod.scaler_update(scaler, finite,
+                                                     window)
+                    return loss, new_vals, new_state, raw_aux, scaler2
+            else:
+                def step(train_vals, frozen_vals, opt_state, key_data,  # noqa: F811
+                         lrs, wds, x, y):
+                    (loss, raw_aux), grads = jax.value_and_grad(
+                        loss_flat, has_aux=True)(train_vals,
+                                                 frozen_vals, key_data,
+                                                 x, y)
+                    grads = tuple(g.astype(jnp.float32) for g in grads)
+                    new_vals, new_state = apply_updates(
+                        train_vals, grads, opt_state, lrs, wds)
+                    return loss, new_vals, new_state, raw_aux
+
         if self.zero:
             # ZeRO-1 replaces the whole sync+update path: an explicit
             # shard_map whose bucket exchange is reduce-scatter →
@@ -669,7 +788,7 @@ class TrainStep:
             # key.  A verified disk hit skips only the XLA compile.
             lower_args = (train_vals, frozen_vals, self._opt_state,
                           jax.random.key_data(key), zeros, zeros,
-                          x_raw, y_raw)
+                          x_raw, y_raw) + self._amp_extra()
             t0 = _prof._now_us()
             lowered = fitted.lower(*lower_args)
             source, ckey, loaded, cmeta = "cold", None, None, {}
@@ -706,7 +825,8 @@ class TrainStep:
             # learn the aux structure without device work
             jax.eval_shape(step, train_vals, frozen_vals,
                            self._opt_state, jax.random.key_data(key),
-                           zeros, zeros, x_raw, y_raw)
+                           zeros, zeros, x_raw, y_raw,
+                           *self._amp_extra())
         # aux (BN running stats) positions inside the frozen tuple, in
         # aux_params order, for the scanned multi-step path to thread
         # them through the carry (None if an aux is somehow trainable)
@@ -730,6 +850,9 @@ class TrainStep:
         buckets = self._zero_buckets
         opt_update = self._opt_update
         batch_axis = self.batch_axis
+        amp_on = self.amp
+        use_scaler = amp_on and self._amp_scaler
+        window = self._amp_window if use_scaler else None
 
         def apply_zero(train_vals, grads, opt_state, lrs, wds):
             new_vals: List[Any] = [None] * len(train_vals)
@@ -753,7 +876,13 @@ class TrainStep:
                 # matching the mean-of-shard-means loss
                 g_loc = lax.psum_scatter(g_s, dp_axis,
                                          scatter_dimension=ax,
-                                         tiled=True) / dp
+                                         tiled=True)
+                if amp_on:
+                    # THE AMP comm payoff: grads arrive bf16 (half the
+                    # per-step reduce-scatter bytes); accumulate the
+                    # unscale/update math in f32 from here on
+                    g_loc = g_loc.astype(jnp.float32)
+                g_loc = g_loc / dp
                 start = me * rows
                 w_loc = lax.dynamic_slice_in_dim(w_s, start, rows, ax)
                 # mxlint: disable=host-sync — Python index lists
@@ -786,6 +915,73 @@ class TrainStep:
                 new_state.append(st2)
             return tuple(new_vals), tuple(new_state)
 
+        def apply_zero_amp(train_vals, grads, opt_state, lrs, wds,
+                           scale):
+            """Loss-scaled variant: phase 1 exchanges every bucket
+            (bf16 reduce-scatter) and unscales in f32, then ONE global
+            finite consensus gates phase 2's updates — every shard
+            must agree to skip, or padded-row mismatches would
+            desynchronize the replicated params."""
+            new_vals: List[Any] = [None] * len(train_vals)
+            new_state = []
+            me = lax.axis_index(dp_axis)
+            prep = []
+            bad = jnp.zeros((), jnp.int32)
+            for b, st in zip(buckets, opt_state):
+                js, ax, pad = b["jidx"], b["axis"], b["pad"]
+                w_s = jnp.stack([train_vals[j] for j in js])
+                g_s = jnp.stack([grads[j] for j in js])
+                orig = w_s.shape[ax]
+                if pad:
+                    widths = [(0, 0)] * w_s.ndim
+                    widths[ax] = (0, pad)
+                    w_s = jnp.pad(w_s, widths)
+                    g_s = jnp.pad(g_s, widths)
+                g_loc = lax.psum_scatter(g_s, dp_axis,
+                                         scatter_dimension=ax,
+                                         tiled=True)
+                g_loc = g_loc.astype(jnp.float32) / dp / scale
+                bad = bad + jnp.sum(
+                    ~jnp.isfinite(g_loc)).astype(jnp.int32)
+                prep.append((b, st, w_s, g_loc, orig))
+            finite = lax.psum(bad, dp_axis) == 0
+            for b, st, w_s, g_loc, orig in prep:
+                js, ax, pad, rows = (b["jidx"], b["axis"], b["pad"],
+                                     b["rows"])
+                start = me * rows
+                w_loc = lax.dynamic_slice_in_dim(w_s, start, rows, ax)
+                # mxlint: disable=host-sync — Python index lists
+                idxa = jnp.asarray(np.asarray(js, np.int32))
+                if ax == 0:
+                    lr_v = jnp.take(lrs, idxa)
+                    wd_v = jnp.take(wds, idxa)
+                    if pad:
+                        lr_v = jnp.pad(lr_v, (0, pad))
+                        wd_v = jnp.pad(wd_v, (0, pad))
+                    bshape = (rows,) + (1,) * (w_s.ndim - 1)
+                    lr_b = lax.dynamic_slice_in_dim(
+                        lr_v, start, rows, 0).reshape(bshape)
+                    wd_b = lax.dynamic_slice_in_dim(
+                        wd_v, start, rows, 0).reshape(bshape)
+                else:
+                    bshape = (len(js),) + (1,) * (w_s.ndim - 1)
+                    lr_b = jnp.take(lrs, idxa).reshape(bshape)
+                    wd_b = jnp.take(wds, idxa).reshape(bshape)
+                w2_loc, st2 = opt_update(w_loc, g_loc, st, lr_b, wd_b,
+                                         stacked=True)
+                # non-finite anywhere: keep shard params AND state
+                keep = lambda n, o: jnp.where(finite, n, o)  # noqa: E731
+                w2_loc = keep(w2_loc, w_loc)
+                st2 = jax.tree_util.tree_map(keep, st2, st)
+                w2 = lax.all_gather(w2_loc, dp_axis, axis=ax,
+                                    tiled=True)
+                if pad:
+                    w2 = lax.slice_in_dim(w2, 0, orig, axis=ax)
+                for a, j in enumerate(js):
+                    new_vals[j] = w2[a]
+                new_state.append(st2)
+            return tuple(new_vals), tuple(new_state), finite
+
         def body(train_vals, frozen_vals, opt_state, key_data, lrs,
                  wds, x, y):
             me = lax.axis_index(dp_axis)
@@ -807,6 +1003,30 @@ class TrainStep:
                                              opt_state, lrs, wds)
             return loss, new_vals, new_state, raw_aux
 
+        def body_amp(train_vals, frozen_vals, opt_state, key_data,
+                     lrs, wds, x, y, scaler):
+            me = lax.axis_index(dp_axis)
+            kd = jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(key_data), me))
+            scale = scaler[0]
+
+            def scaled(tv, fv, k2, xx, yy):
+                l, aux = loss_flat(tv, fv, k2, xx, yy)
+                return l * scale.astype(l.dtype), (l, aux)
+
+            (_, (loss, raw_aux)), grads = jax.value_and_grad(
+                scaled, has_aux=True)(train_vals, frozen_vals, kd,
+                                      x, y)
+            loss = lax.psum(loss, dp_axis) / dp
+            raw_aux = tuple(
+                lax.pmean(a, dp_axis)
+                if jnp.issubdtype(a.dtype, jnp.inexact) else a
+                for a in raw_aux)
+            new_vals, new_state, finite = apply_zero_amp(
+                train_vals, grads, opt_state, lrs, wds, scale)
+            scaler2 = _amp_mod.scaler_update(scaler, finite, window)
+            return loss, new_vals, new_state, raw_aux, scaler2
+
         xspec = [None] * x_raw.ndim
         xspec[batch_axis] = dp_axis
         yspec = [None] * max(y_raw.ndim, 1)
@@ -815,9 +1035,14 @@ class TrainStep:
         in_specs = (P(), P(), self._zero_state_specs, P(), P(), P(),
                     P(*xspec), P(*yspec[:y_raw.ndim]))
         out_specs = (P(), P(), self._zero_state_specs, P())
+        fn = body
+        if use_scaler:
+            fn = body_amp
+            in_specs = in_specs + (P(),)
+            out_specs = out_specs + (P(),)
         # check_rep=False: the rep checker can't infer that the tiled
         # all_gather output is replicated
-        return shard_map(body, mesh=mesh, in_specs=in_specs,
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_rep=False)
 
     # -- the hot call ----------------------------------------------------
@@ -918,9 +1143,12 @@ class TrainStep:
             self._churn.note_call()
         t0 = _prof._now_us() if self._obs else 0.0
         with guards.no_implicit_transfers(self._guards):
-            loss, new_vals, new_state, raw_aux = entry["fn"](
+            out = entry["fn"](
                 train_vals, frozen_vals, self._opt_state,
-                kd, lrs, wds, x_raw, y_raw)
+                kd, lrs, wds, x_raw, y_raw, *self._amp_extra())
+        loss, new_vals, new_state, raw_aux = out[:4]
+        if self._amp_scaler:
+            self._amp_state = out[4]
         for i, v in zip(self._train_idx, new_vals):
             params[i]._data._data = v
         self._opt_state = new_state
@@ -1011,28 +1239,41 @@ class TrainStep:
                 self._m_compile.inc()
             raw_step = entry["raw_step"]
             aux_pos = entry["aux_pos"]
+            amp_scaler = self._amp_scaler
 
             def multi_fn(train_vals, frozen_vals, opt_state, key_data,
-                         lrs, wds, xs, ys):
+                         lrs, wds, xs, ys, *amp_s):
                 def body(carry, inp):
-                    tv, frozen, st = carry
+                    if amp_scaler:
+                        tv, frozen, st, sc = carry
+                    else:
+                        tv, frozen, st = carry
                     if reuse_batch:
                         (kd,) = inp
                         xb, yb = xs, ys
                     else:
                         xb, yb, kd = inp
-                    loss, tv2, st2, raw_aux = raw_step(
-                        tv, frozen, st, kd, lrs, wds, xb, yb)
+                    if amp_scaler:
+                        loss, tv2, st2, raw_aux, sc2 = raw_step(
+                            tv, frozen, st, kd, lrs, wds, xb, yb, sc)
+                    else:
+                        loss, tv2, st2, raw_aux = raw_step(
+                            tv, frozen, st, kd, lrs, wds, xb, yb)
                     frozen2 = list(frozen)
                     for pos, v in zip(aux_pos, raw_aux):
                         if pos is not None:
                             frozen2[pos] = v
-                    return (tv2, tuple(frozen2), st2), loss
+                    carry2 = (tv2, tuple(frozen2), st2)
+                    if amp_scaler:
+                        carry2 = carry2 + (sc2,)
+                    return carry2, loss
                 scanned = (key_data,) if reuse_batch else \
                     (xs, ys, key_data)
-                (tv, frozen, st), losses = lax.scan(
-                    body, (train_vals, frozen_vals, opt_state), scanned)
-                return losses, tv, frozen, st
+                carry0 = (train_vals, frozen_vals, opt_state)
+                if amp_scaler:
+                    carry0 = carry0 + (amp_s[0],)
+                carry, losses = lax.scan(body, carry0, scanned)
+                return (losses,) + carry
 
             donate = (0, 1, 2) if self.donate else ()
             multi = jax.jit(multi_fn, donate_argnums=donate)
@@ -1043,7 +1284,7 @@ class TrainStep:
                 # stats are what bench.py's hbm_peak reports
                 multi = multi.lower(
                     train_vals, frozen_vals, self._opt_state, keys,
-                    lrs, wds, xs, ys).compile()
+                    lrs, wds, xs, ys, *self._amp_extra()).compile()
                 self._last_mem = _mem_stats(multi)
                 from mxtpu import analysis
                 analysis.maybe_audit(multi, label="TrainStep.run_steps",
@@ -1053,9 +1294,12 @@ class TrainStep:
             self._churn.note_call()
         t0 = _prof._now_us() if self._obs else 0.0
         with guards.no_implicit_transfers(self._guards):
-            losses, tv, frozen, st = multi(
+            out = multi(
                 train_vals, frozen_vals, self._opt_state, keys, lrs, wds,
-                xs, ys)
+                xs, ys, *self._amp_extra())
+        losses, tv, frozen, st = out[:4]
+        if self._amp_scaler:
+            self._amp_state = out[4]
         for i, v in zip(self._train_idx, tv):
             params[i]._data._data = v
         for j, i in enumerate(entry["frozen_idx"]):
@@ -1108,7 +1352,7 @@ class TrainStep:
         return fn.lower(
             train_vals, frozen_vals, self._opt_state,
             jax.random.key_data(key), lrs, wds, x_raw,
-            y_raw).compile()
+            y_raw, *self._amp_extra()).compile()
 
     def memory_analysis(self, x, y):
         """Per-device memory footprint of the one-step compiled
@@ -1149,7 +1393,7 @@ class TrainStep:
         return analysis.lowered_text(
             entry["raw_step"], train_vals, frozen_vals,
             self._opt_state, jax.random.key_data(key), lrs, wds,
-            x_raw, y_raw)
+            x_raw, y_raw, *self._amp_extra())
 
     def param_sigs(self, x=None, y=None):
         """``(name, shape, dtype)`` per trainable parameter, in step
@@ -1265,8 +1509,15 @@ class TrainStep:
             raise MXNetError("nothing to save: step never ran")
         state_np = jax.tree_util.tree_map(np.asarray,
                                           self._canonical_state())
+        blob = {"t": self._t, "opt_state": state_np}
+        if self._amp_scaler and self._amp_state is not None:
+            # checkpoint save reads the scaler scalars
+            blob["amp"] = {
+                "scale": float(np.asarray(self._amp_state[0])),  # mxlint: sync-point
+                "good_steps": int(np.asarray(self._amp_state[1])),  # mxlint: sync-point
+                "skipped_steps": int(np.asarray(self._amp_state[2]))}  # mxlint: sync-point
         with open(fname, "wb") as f:
-            pickle.dump({"t": self._t, "opt_state": state_np}, f)
+            pickle.dump(blob, f)
 
     def load_states(self, fname: str, x_example=None) -> None:
         """Restore optimizer state; the step counter resumes bias
@@ -1298,6 +1549,18 @@ class TrainStep:
             raise MXNetError(
                 f"optimizer state structure mismatch: {got} vs {cur}")
         self._t = data["t"]
+        if self._amp_scaler and "amp" in data:
+            # loss-scale state rides the checkpoint: a resumed run
+            # neither re-warms the scale from init nor forgets its
+            # skipped-step accounting (absent in pre-AMP files → the
+            # fresh scaler_init from _collect stands)
+            st = (jnp.asarray(data["amp"]["scale"], jnp.float32),
+                  jnp.asarray(data["amp"]["good_steps"], jnp.int32),
+                  jnp.asarray(data["amp"]["skipped_steps"], jnp.int32))
+            if self.mesh is not None:
+                st = tuple(_device_put_global(v, self.mesh, P())
+                           for v in st)
+            self._amp_state = st
         if self.zero:
             self._opt_state = self._state_from_canonical(loaded)
             return
@@ -1307,6 +1570,28 @@ class TrainStep:
                 lambda v: _device_put_global(v, self.mesh, P()),
                 loaded)
         self._opt_state = loaded
+
+    def amp_stats(self):
+        """Host-readable loss-scaler state — ``{'loss_scale',
+        'good_steps', 'skipped_steps'}`` — and the obs gauge sync
+        point (``mxtpu_amp_loss_scale``, ``mxtpu_amp_skipped_steps``).
+        None when AMP is off; static 1.0/0/0 when scaling is disabled
+        (``MXTPU_AMP_LOSS_SCALE=0``)."""
+        if not self.amp:
+            return None
+        if not self._amp_scaler or self._amp_state is None:
+            stats = {"loss_scale": 1.0, "good_steps": 0,
+                     "skipped_steps": 0}
+        else:
+            # explicit introspection read
+            stats = {
+                "loss_scale": float(np.asarray(self._amp_state[0])),  # mxlint: sync-point
+                "good_steps": int(np.asarray(self._amp_state[1])),  # mxlint: sync-point
+                "skipped_steps": int(np.asarray(self._amp_state[2]))}  # mxlint: sync-point
+        if self._obs:
+            self._m_amp_scale.set(stats["loss_scale"])
+            self._m_amp_skipped.set(stats["skipped_steps"])
+        return stats
 
     def _lrs_wds(self):
         """Per-parameter (lr, wd) vectors for this step — two traced
@@ -1338,7 +1623,7 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
                      batch_axis: int = 0, param_spec_fn=None,
                      donate: bool = True, compute_dtype=None,
                      cast_batch: bool = True, zero=None,
-                     cache: Any = "auto") -> TrainStep:
+                     cache: Any = "auto", amp=None) -> TrainStep:
     """Compile net+loss+optimizer into a single SPMD train step.
 
     ``mesh=None`` → single-device executable (still one fused program).
@@ -1348,13 +1633,22 @@ def build_train_step(net, loss_fn, optimizer="sgd", optimizer_params=None,
     defaults to ZeRO-1 sharded optimizer states (reduce-scatter +
     all-gather instead of all-reduce; see :class:`TrainStep`) —
     ``zero=0`` or ``MXTPU_ZERO=0`` restores the replicated path,
-    ``zero=1`` insists."""
+    ``zero=1`` insists.
+
+    ``amp=1`` turns on policy-driven mixed precision (``mxtpu.amp``):
+    bf16 parameter storage over f32 master weights, bf16 casts on the
+    allow-listed contractions only (f32 accumulation everywhere),
+    dynamic loss scaling, and — under ZeRO-1 — a bf16 reduce-scatter
+    at half the f32 comm bytes.  ``MXTPU_AMP=0`` kills it globally,
+    ``MXTPU_AMP=1`` enables it globally; ``amp=None`` defers to the
+    environment."""
     if not isinstance(optimizer, opt_mod.Optimizer):
         optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
     return TrainStep(net, loss_fn, optimizer, mesh=mesh, dp_axis=dp_axis,
                      batch_axis=batch_axis, param_spec_fn=param_spec_fn,
                      donate=donate, compute_dtype=compute_dtype,
-                     cast_batch=cast_batch, zero=zero, cache=cache)
+                     cast_batch=cast_batch, zero=zero, cache=cache,
+                     amp=amp)
 
 
 from .pipeline import (spmd_pipeline, stack_stage_params,  # noqa: E402

@@ -91,9 +91,16 @@ class ModelRunner:
                  seq_buckets: Optional[Sequence[int]] = None,
                  max_batch_size: Optional[int] = None,
                  device=None, pad_value: float = 0,
-                 donate: Optional[bool] = None, cache: Any = "auto"):
+                 donate: Optional[bool] = None, cache: Any = "auto",
+                 amp=None):
         import jax
 
+        # policy-driven AMP (mxtpu.amp): weights upload bf16 (half the
+        # serving HBM), re-enter the graph in f32, and only the
+        # policy's allow-listed contractions compute in bf16.
+        # MXTPU_AMP=0 kills it; off-path programs are bit-identical.
+        from .. import amp as _amp_mod
+        self._amp = _amp_mod.resolve(amp)
         self._symbol = symbol
         self._input_names = list(input_specs)
         self._input_specs = {k: tuple(v) for k, v in input_specs.items()}
@@ -130,9 +137,25 @@ class ModelRunner:
             raise MXNetError(
                 f"serving: graph inputs {sorted(missing)} have neither "
                 f"a param nor an input_spec")
-        self._param_vals = tuple(
-            jax.device_put(self._as_np(params[n]), self._device)
-            for n in self._param_names)
+        if self._amp:
+            # bf16 weight storage: aux-named params (BN running
+            # stats) stay f32 — their EMA magnitudes need the
+            # mantissa; everything else halves its upload + HBM
+            import jax.numpy as jnp
+            from ..symbol import _is_aux_name
+
+            def _stage(n):
+                v = self._as_np(params[n])
+                if v.dtype == np.float32 and not _is_aux_name(n):
+                    v = v.astype(jnp.bfloat16)
+                return jax.device_put(v, self._device)
+
+            self._param_vals = tuple(_stage(n)
+                                     for n in self._param_names)
+        else:
+            self._param_vals = tuple(
+                jax.device_put(self._as_np(params[n]), self._device)
+                for n in self._param_names)
         # lowering must pin THIS replica's device, or every runner
         # would compile (and expect buffers) on jax.devices()[0]
         self._sharding = jax.sharding.SingleDeviceSharding(self._device)
@@ -279,7 +302,7 @@ class ModelRunner:
         for i, node in enumerate(graph.get("nodes", ())):
             if node.get("op") not in (None, "null"):
                 node["name"] = f"_op{i}"
-        blob = _json.dumps({
+        fp = {
             "symbol": graph,
             "inputs": {n: [list(self._input_specs[n]),
                            str(self._input_dtypes[n])]
@@ -288,7 +311,12 @@ class ModelRunner:
                        for n, v in zip(self._param_names,
                                        self._param_vals)],
             "donate": self._donate, "pad_value": self._pad_value,
-        }, sort_keys=True)
+        }
+        if self._amp:
+            # key only when ON: every pre-AMP cache entry (and the
+            # MXTPU_AMP=0 path) keeps its fingerprint unchanged
+            fp["amp"] = True
+        blob = _json.dumps(fp, sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
     def _cache_key(self, bucket: Tuple):
@@ -330,14 +358,29 @@ class ModelRunner:
         """Pure (traceable) interpretation of the symbol: (input_vals,
         param_vals) -> tuple of raw outputs, inference mode (no
         recording, training=False — dropout is identity)."""
+        import contextlib
+        import jax.numpy as jnp
+        from .. import amp as _amp_mod
         from .. import autograd
         from ..ndarray.ndarray import NDArray
         from ..symbol import _eval_symbol
         sym = self._symbol
         in_names = tuple(self._input_names)
         p_names = self._param_names
+        amp_on = self._amp
 
         def fn(input_vals, param_vals):
+            if amp_on:
+                # AMP entry upcast (the TrainStep rule): bf16 weights
+                # re-enter the graph in f32 so only the policy's
+                # allow-listed contractions — cast back down inside
+                # the autocast scope — ever compute in bf16; XLA
+                # folds the convert pair at the weight→dot edges
+                param_vals = tuple(
+                    v.astype(jnp.float32)
+                    if (jnp.issubdtype(v.dtype, jnp.floating)
+                        and v.dtype != jnp.float32)
+                    else v for v in param_vals)
             bindings = {}
             for n, v in zip(in_names, input_vals):
                 bindings[n] = NDArray(v, None, _placed=True)
@@ -345,8 +388,11 @@ class ModelRunner:
                 bindings[n] = NDArray(v, None, _placed=True)
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(False)
+            scope = _amp_mod.autocast() if amp_on \
+                else contextlib.nullcontext()
             try:
-                outs = _eval_symbol(sym, bindings)
+                with scope:
+                    outs = _eval_symbol(sym, bindings)
             finally:
                 autograd.set_training(prev_train)
                 autograd.set_recording(prev_rec)

@@ -450,6 +450,56 @@ def test_dtype_hygiene_scoped_to_library_code():
         _ctx(src, rel="mxtpu/fake.py")) is True
 
 
+# ------------------------------------------------------- no-adhoc-bf16
+
+def test_no_adhoc_bf16_flags_cast_forms():
+    ctx = _ctx("""
+        import jax.numpy as jnp
+
+        def forward(self, F, x, net):
+            a = x.astype("bfloat16")
+            b = x.astype(jnp.bfloat16)
+            c = F.cast(x, dtype="bf16")
+            net.cast("bfloat16")
+            w = F.zeros((4, 4), dtype="bfloat16")
+            return a, b, c, w
+    """, rel="mxtpu/models/fake.py")
+    found = R.NoAdhocBf16().check(ctx)
+    assert _names(found) == ["no-adhoc-bf16"] * 5
+    assert {f.line for f in found} == {5, 6, 7, 8, 9}
+    msgs = " ".join(f.message for f in found)
+    assert "amp_policy.json" in msgs
+
+
+def test_no_adhoc_bf16_pragma_waives():
+    ctx = _ctx("""
+        def forward(x):
+            a = x.astype("bfloat16")
+            b = x.astype("bfloat16")  # mxlint: disable=no-adhoc-bf16
+            return a + b
+    """, rel="mxtpu/gluon/fake.py")
+    found = [f for f in R.NoAdhocBf16().check(ctx)
+             if not ctx.suppressed(f.rule, f.line)]
+    assert len(found) == 1
+    assert found[0].line == 3
+
+
+def test_no_adhoc_bf16_scoped_to_hot_paths():
+    src = """
+        def forward(x):
+            return x.astype("bfloat16")
+    """
+    # the amp module, parallel's entry upcasts and tests cast bf16 on
+    # purpose — only the model/layer hot paths are held to the policy
+    rule = R.NoAdhocBf16()
+    assert rule.applies(_ctx(src, rel="mxtpu/models/fake.py")) is True
+    assert rule.applies(_ctx(src, rel="mxtpu/gluon/fake.py")) is True
+    assert rule.applies(_ctx(src, rel="mxtpu/amp/fake.py")) is False
+    assert rule.applies(_ctx(src, rel="mxtpu/parallel/fake.py")) \
+        is False
+    assert rule.applies(_ctx(src, rel="tests/test_fake.py")) is False
+
+
 # ----------------------------------------------------- raw-deserialize
 
 def test_raw_deserialize_flags_pickle_and_executable_load():

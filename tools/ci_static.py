@@ -17,7 +17,11 @@ contracts.
   committed ``contracts/lockorder.json`` + guarded-by hygiene), then
 * ``python -m tools.mxprec --check`` (pre-optimization dtype flow vs
   the committed ``contracts/prec/`` ledgers + the derived
-  ``contracts/amp_policy.json``),
+  ``contracts/amp_policy.json``), then
+* ``python -m mxtpu.amp --self-check`` (the AMP pass's three
+  contracts: policy parse/classes, an autocast round-trip on the
+  selftest program — bf16 edges, zero hazards, no leak outside the
+  scope — and the loss-scaler grow/backoff/skip accounting),
 
 prints one PASS/FAIL line per stage, and exits non-zero if any
 failed — the single entry point a CI job or pre-push hook needs.
@@ -40,6 +44,7 @@ STAGES = (
     ("cache-self-check", ("-m", "mxtpu.cache", "--self-check"), False),
     ("mxrace", ("-m", "tools.mxrace", "--check"), True),
     ("mxprec", ("-m", "tools.mxprec", "--check"), True),
+    ("amp-self-check", ("-m", "mxtpu.amp", "--self-check"), False),
 )
 
 

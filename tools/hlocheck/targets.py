@@ -91,7 +91,7 @@ def _prec_train(step, x, y) -> Dict:
             "param_sigs": step.param_sigs(x, y)}
 
 
-def _bert_parts(zero: int):
+def _bert_parts(zero: int, amp: bool = False):
     import jax
     from mxtpu import nd, parallel
     from mxtpu.models.transformer import BERTModel
@@ -103,7 +103,7 @@ def _bert_parts(zero: int):
     mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
     step = parallel.build_train_step(
         net, _mlm_loss(), "adam", {"learning_rate": 1e-3},
-        mesh=mesh, cast_batch=False, zero=zero)
+        mesh=mesh, cast_batch=False, zero=zero, amp=amp or None)
     return step, x, x
 
 
@@ -121,6 +121,24 @@ def bert_zero() -> Dict[str, Artifact]:
     return {"train_step": _train_step_artifact(*_bert_parts(zero=1))}
 
 
+@register_target("bert_zero_amp")
+def bert_zero_amp() -> Dict[str, Artifact]:
+    """``bert_zero`` with ``amp=True`` — pins the AMP comm payoff:
+    the same reduce-scatter count as the f32 contract but the
+    exchanged buckets ride bf16 (collective bytes ~ half of
+    ``bert_zero``'s), upcast to f32 immediately after the exchange.
+
+    The payoff is pinned on the ``train_step_as_written`` program
+    (the pre-optimization lowering): the CPU backend's
+    float-normalization pass rewrites bf16 collectives back to f32
+    in the compiled text, so only the as-written level carries the
+    dtype the wire sees on a real accelerator."""
+    step, x, y = _bert_parts(zero=1, amp=True)
+    return {"train_step": _train_step_artifact(step, x, y),
+            "train_step_as_written":
+                (step.lowered_hlo_text(x, y), None)}
+
+
 @register_prec("bert_replicated")
 def bert_replicated_prec() -> Dict:
     return _prec_train(*_bert_parts(zero=0))
@@ -131,7 +149,17 @@ def bert_zero_prec() -> Dict:
     return _prec_train(*_bert_parts(zero=1))
 
 
-def _transformer_parts():
+@register_prec("bert_replicated_amp")
+def bert_replicated_amp_prec() -> Dict:
+    return _prec_train(*_bert_parts(zero=0, amp=True))
+
+
+@register_prec("bert_zero_amp")
+def bert_zero_amp_prec() -> Dict:
+    return _prec_train(*_bert_parts(zero=1, amp=True))
+
+
+def _transformer_parts(amp: bool = False):
     from mxtpu import nd, parallel
     from mxtpu.gluon.block import HybridBlock
     from mxtpu.models.transformer import TransformerModel
@@ -157,7 +185,7 @@ def _transformer_parts():
     y = nd.array(rng.randint(0, _VOCAB, (4, 16)).astype(np.float32))
     step = parallel.build_train_step(
         net, _mlm_loss(), "adam", {"learning_rate": 1e-4},
-        cast_batch=False)
+        cast_batch=False, amp=amp or None)
     return step, x, y
 
 
@@ -173,7 +201,12 @@ def transformer_prec() -> Dict:
     return _prec_train(*_transformer_parts())
 
 
-def _resnet_parts():
+@register_prec("transformer_amp")
+def transformer_amp_prec() -> Dict:
+    return _prec_train(*_transformer_parts(amp=True))
+
+
+def _resnet_parts(amp: bool = False):
     from mxtpu import nd, parallel
     from mxtpu.gluon import loss as gloss
     from mxtpu.gluon.model_zoo import vision
@@ -184,7 +217,7 @@ def _resnet_parts():
     y = nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
     step = parallel.build_train_step(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9})
+        {"learning_rate": 0.1, "momentum": 0.9}, amp=amp or None)
     return step, x, y
 
 
@@ -200,7 +233,12 @@ def resnet18_prec() -> Dict:
     return _prec_train(*_resnet_parts())
 
 
-def _serving_runner():
+@register_prec("resnet18_amp")
+def resnet18_amp_prec() -> Dict:
+    return _prec_train(*_resnet_parts(amp=True))
+
+
+def _serving_runner(amp: bool = False):
     import os
     import tempfile
     from mxtpu import nd
@@ -216,7 +254,8 @@ def _serving_runner():
     sym_file, param_file = net.export(os.path.join(d, "bert"))
     return ModelRunner.from_export(
         sym_file, param_file, input_specs={"data": (None,)},
-        seq_buckets=[16, 32], max_batch_size=4)
+        seq_buckets=[16, 32], max_batch_size=4,
+        amp=amp or None)
 
 
 @register_target("serving_bert")
@@ -238,6 +277,18 @@ def serving_bert() -> Dict[str, Artifact]:
 def serving_bert_prec() -> Dict:
     # lowering only — no warmup/compile, so the prec sweep stays fast
     runner = _serving_runner()
+    programs = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        programs[f"bucket_b{batch}_s{seq}"] = \
+            runner.lowered_program_text(bucket)
+    return {"programs": programs, "optimizer": None,
+            "param_sigs": None}
+
+
+@register_prec("serving_bert_amp")
+def serving_bert_amp_prec() -> Dict:
+    runner = _serving_runner(amp=True)
     programs = {}
     for bucket in runner.buckets():
         batch, seq = bucket
@@ -277,5 +328,28 @@ def selftest() -> Dict[str, Artifact]:
 def selftest_prec() -> Dict:
     from mxtpu.analysis import lowered_text
     f, a, b = _selftest_parts()
+    return {"programs": {"eigh_matmul": lowered_text(f, a, b)},
+            "optimizer": None, "param_sigs": None}
+
+
+@register_prec("selftest_amp")
+def selftest_amp_prec() -> Dict:
+    """The selftest math with its contraction routed through the nd
+    op registry under an autocast scope — the smallest ledgered
+    specimen of the policy in action (bf16 dot operands, f32
+    accumulation, eigh/transcendental chain untouched)."""
+    import jax.numpy as jnp
+    from mxtpu import amp, nd
+    from mxtpu.analysis import lowered_text
+    from mxtpu.ndarray import NDArray
+
+    def f(a, b):
+        w, v = jnp.linalg.eigh(a.T @ a)
+        with amp.autocast():
+            prod = nd.dot(NDArray(a, None, _placed=True),
+                          NDArray(b, None, _placed=True))
+        return (v * w).sum() + prod._data.sum()
+
+    _, a, b = _selftest_parts()
     return {"programs": {"eigh_matmul": lowered_text(f, a, b)},
             "optimizer": None, "param_sigs": None}

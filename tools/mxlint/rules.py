@@ -731,6 +731,74 @@ class DtypeHygiene(Rule):
         return sorted(out, key=lambda f: f.line)
 
 
+class NoAdhocBf16(Rule):
+    """The AMP pass is the ONE cast authority (r15): bf16 edges are
+    decided by ``contracts/amp_policy.json`` at the op-dispatch choke
+    point, so the six ``*_amp`` precision ledgers describe every
+    program.  A hand-rolled bf16 cast in a model/layer hot path
+    (``mxtpu/models/``, ``mxtpu/gluon/``) bypasses the policy veto and
+    the f32-accumulation rule — it can reintroduce exactly the bf16
+    accumulating reductions mxprec exists to catch, invisibly to the
+    ledgers.  Waive a deliberate site (an I/O boundary, a test
+    fixture block) with ``# mxlint: disable=no-adhoc-bf16`` and say
+    why."""
+
+    name = "no-adhoc-bf16"
+    _BF16_ATTRS = {"np.bfloat16", "numpy.bfloat16", "jnp.bfloat16",
+                   "jax.numpy.bfloat16", "ml_dtypes.bfloat16"}
+    _BF16_STRINGS = {"bfloat16", "bf16"}
+    _CASTERS = {"astype", "cast", "cast_all"}
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return ctx.rel.startswith(("mxtpu/models/", "mxtpu/gluon/"))
+
+    def _is_bf16_arg(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant) and \
+                node.value in self._BF16_STRINGS:
+            return True
+        return dotted_name(node) in self._BF16_ATTRS
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        claimed: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func.attr \
+                if isinstance(node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            args = list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg == "dtype"]
+            if callee not in self._CASTERS:
+                # a dtype="bfloat16" kwarg on any call (array ctor,
+                # layer ctor) plants ad-hoc bf16 state just the same
+                args = [kw.value for kw in node.keywords
+                        if kw.arg == "dtype"]
+            for a in args:
+                if self._is_bf16_arg(a):
+                    claimed.add(id(a))
+                    out.append(Finding(
+                        self.name, ctx.rel, node.lineno,
+                        "ad-hoc bf16 cast in a model/layer hot path — "
+                        "bf16 edges belong to the policy-driven AMP "
+                        "pass (amp=True consumes contracts/"
+                        "amp_policy.json with f32 accumulation); a "
+                        "hand cast bypasses the policy veto and the "
+                        "*_amp ledgers, or waive with a pragma "
+                        "stating why this site is exempt"))
+        for node in ast.walk(ctx.tree):
+            if id(node) in claimed or \
+                    dotted_name(node) not in self._BF16_ATTRS:
+                continue
+            out.append(Finding(
+                self.name, ctx.rel, node.lineno,
+                "bfloat16 literal in a model/layer hot path — route "
+                "mixed precision through mxtpu.amp (amp=True) so the "
+                "precision ledgers stay true, or waive with a pragma"))
+        return sorted(out, key=lambda f: f.line)
+
+
 class RawDeserialize(Rule):
     """Disk artifacts reach the process through ONE verified door
     (ISSUE 13): ``mxtpu/cache.py``'s loader checksums and
@@ -834,7 +902,7 @@ def file_rules() -> List[Rule]:
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
             HloRawAssert(), ObsRegistry(), ThreadHygiene(),
-            DtypeHygiene(), RawDeserialize()]
+            DtypeHygiene(), NoAdhocBf16(), RawDeserialize()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:

@@ -147,6 +147,14 @@ def build_amp_policy(texts_by_target: Dict[str, Dict[str, str]]
     from mxtpu import kernels
     from mxtpu.analysis import dtypeflow
 
+    # ``*_amp`` targets are CONSUMERS of this policy (their lowerings
+    # already carry the bf16 casts it prescribes); feeding them back
+    # in as evidence would be circular and would churn the committed
+    # policy every time an AMP lowering changes.  Derive from the
+    # f32 baselines only.
+    texts_by_target = {t: v for t, v in texts_by_target.items()
+                       if not t.endswith("_amp")}
+
     counts: Dict[str, Dict[str, int]] = {}
     for target in sorted(texts_by_target):
         for prog in sorted(texts_by_target[target]):
