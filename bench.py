@@ -6,10 +6,10 @@ training throughput** (north-star #1, BASELINE.md); the BERT-Large
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
 MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
 transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet|
-serving_autoscale to run a single workload (moe_ffn, ssd, bert_zero,
-serving_bert, serving_fleet and serving_autoscale are on-demand only —
-not part of the default ``all`` sweep, which is sized to the wall
-budget).  ``--amp`` (or MXTPU_BENCH_MODEL=resnet50_amp|bert_amp|
+serving_autoscale|serving_coldstart|serving_bert_int8 to run a single
+workload (moe_ffn, ssd, bert_zero and the serving_* rows are
+on-demand only — not part of the default ``all`` sweep, which is
+sized to the wall budget).  ``--amp`` (or MXTPU_BENCH_MODEL=resnet50_amp|bert_amp|
 transformer_amp|bert_zero_amp) runs the ``mxtpu.amp`` pair rows: the
 base workload measured AMP-off and AMP-on, rate + MFU + (for the
 ZeRO pair) contract-pinned comm bytes side by side.  Every row's ``details``
@@ -107,6 +107,7 @@ _METRIC_NAMES = {
     "serving_fleet": "serving_fleet_soak_throughput",
     "serving_autoscale": "serving_autoscale_burst_absorb_throughput",
     "serving_coldstart": "serving_coldstart_disk_warm_speedup",
+    "serving_bert_int8": "serving_bert_int8_raw_throughput",
     "lenet": "lenet_mnist_train_throughput",
     # --amp pairs: each row runs its base workload twice (AMP off /
     # AMP on via mxtpu.amp) and reports rate + MFU + comm side by side
@@ -148,6 +149,9 @@ _TRAIN_FLOPS = {
                                 # violations vs static-N are the result
     "serving_coldstart": None,  # robustness row — the cold vs
                                 # disk-warmed warmup split is the result
+    "serving_bert_int8": None,  # ablation row — the int8/f32 ratio,
+                                # accuracy delta and s8xs8->s32 census
+                                # are the result, not MFU
     "lenet": None,            # too small for MFU to mean anything
     # amp pairs reuse the base row's FLOP denominator: AMP changes
     # operand dtypes, not the model math being counted
@@ -1261,6 +1265,116 @@ def bench_serving_coldstart(seq_len=64, max_batch=8, repeats=2):
     return stats, _METRIC_NAMES["serving_coldstart"], "x"
 
 
+def bench_serving_bert_int8(seq_len=64, max_batch=8, repeats=3,
+                            iters=30):
+    """INT8 serving ablation row (on-demand,
+    MXTPU_BENCH_MODEL=serving_bert_int8): the serving_bert model
+    exported once and served three ways over the same saturation
+    bucket — f32, bf16 (mxtpu.amp) and int8 (mxtpu.quant,
+    entropy-calibrated on seeded batches) — raw AOT back-to-back
+    throughput and per-request p50/p95 per arm.
+
+    The primary value is the int8 arm's raw req/sec (best of
+    ``repeats``); ``details`` carries the int8-vs-f32 and
+    int8-vs-bf16 speedups, each reduced-precision arm's max-|Δlogit|
+    vs f32 on a fixed eval batch, and the s8×s8→s32 contraction
+    census of the int8 bucket's lowering — the proof the arm actually
+    quantized (on the CPU backend int8 GEMMs may not run faster, so
+    the census, not the ratio, is the floor evidence; the hard
+    accuracy gate on this shape lives in tests/test_quant.py)."""
+    import tempfile
+
+    from mxtpu import nd
+    from mxtpu.analysis import dtypeflow
+    from mxtpu.models.transformer import BERTModel
+    from mxtpu.serving import ModelRunner
+
+    V = 8192
+    net = BERTModel(V, 256, 1024, 4, 4, max_length=seq_len,
+                    dropout=0.0)
+    net.initialize(init="xavier")
+    rng = np.random.RandomState(0)
+    net(nd.array(rng.randint(0, V, (1, seq_len))
+                 .astype(np.float32)))          # materialize params
+    d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_serving_int8_")
+    sym_file, param_file = net.export(os.path.join(d, "bert"))
+
+    bucket = (max_batch, seq_len)
+    calib = [{"data": rng.randint(0, V, (max_batch, seq_len))
+              .astype(np.float32)} for _ in range(4)]
+    eval_rows = [{"data": rng.randint(0, V, (seq_len,))
+                  .astype(np.float32)} for _ in range(max_batch)]
+
+    def make_runner(arm):
+        runner = ModelRunner.from_export(
+            sym_file, param_file, input_specs={"data": (None,)},
+            seq_buckets=[seq_len], max_batch_size=max_batch,
+            amp=(arm == "bf16") or None,
+            quant=(arm == "int8") or None)
+        if arm == "int8":
+            runner.calibrate(calib, mode="entropy")
+        return runner
+
+    arms = {}
+    f32_logits = None
+    int8_census = None
+    for arm in ("f32", "bf16", "int8"):
+        runner = make_runner(arm)
+        if arm == "int8":
+            int8_census = dtypeflow.int8_contraction_census(
+                runner.lowered_program_text(bucket))
+        t0 = time.perf_counter()
+        runner.warmup([bucket])     # one bucket per arm — cheap row
+        compile_s = time.perf_counter() - t0
+        vals = runner._pad_stack(eval_rows, bucket)
+        logits = np.asarray(runner.run_raw(vals, bucket)[0],
+                            np.float32)         # settle + eval batch
+        if arm == "f32":
+            f32_logits = logits
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                outs = runner.run_raw(vals, bucket)
+            np.asarray(outs[0])                 # sync
+            best = max(best,
+                       max_batch * iters / (time.perf_counter() - t0))
+        lats = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(runner.run_raw(vals, bucket)[0])
+            lats.append((time.perf_counter() - t0) * 1e3)
+        lats.sort()
+        arms[arm] = {
+            "raw_rps": round(best, 1),
+            "p50_ms": round(lats[len(lats) // 2], 3),
+            "p95_ms": round(
+                lats[min(len(lats) - 1,
+                         int(round(0.95 * (len(lats) - 1))))], 3),
+            "compile_seconds": round(compile_s, 2),
+            "max_abs_logit_delta_vs_f32": None if arm == "f32" else
+                round(float(np.abs(logits - f32_logits).max()), 5),
+            "weight_mb": round(runner.weight_bytes() / 2 ** 20, 1),
+        }
+    stats = {
+        "best": arms["int8"]["raw_rps"],
+        "median": arms["int8"]["raw_rps"], "n": repeats,
+        "spread": 0.0, "runs": [arms["int8"]["raw_rps"]],
+        "info": {
+            "hbm_peak": None,   # inference path; no scan program
+            "arms": arms,
+            "int8_vs_f32": round(
+                arms["int8"]["raw_rps"] / arms["f32"]["raw_rps"], 4),
+            "int8_vs_bf16": round(
+                arms["int8"]["raw_rps"] / arms["bf16"]["raw_rps"], 4),
+            "int8_contraction_census": int8_census,
+            "f32_logit_scale": round(
+                float(np.abs(f32_logits).max()), 4),
+        },
+    }
+    return stats, _METRIC_NAMES["serving_bert_int8"], "req/sec"
+
+
 def _mfu(model, value, peak, per_unit=None):
     per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
@@ -1288,6 +1402,9 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             # 2 repeats x (cold ladder compile + disk-warmed reload +
             # two first-request probes) of a 2-layer BERT
             "serving_coldstart": 120,
+            # 3 arms (f32/bf16/int8) x one bucket compile + timing
+            # loops + one calibration pass of a 4-layer BERT
+            "serving_bert_int8": 150,
             # pairs run the base workload twice (off + on)
             "resnet50_amp": 300, "bert_amp": 300,
             "transformer_amp": 240, "bert_zero_amp": 300}
@@ -1349,6 +1466,7 @@ def main():
              "serving_fleet": bench_serving_fleet,
              "serving_autoscale": bench_serving_autoscale,
              "serving_coldstart": bench_serving_coldstart,
+             "serving_bert_int8": bench_serving_bert_int8,
              # --amp pairs (on-demand): AMP off vs on side by side
              "resnet50_amp": lambda: bench_amp_pair(
                  "resnet50_amp", bench_resnet50),

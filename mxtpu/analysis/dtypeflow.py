@@ -17,6 +17,15 @@ hazards are classified per instruction (``hazard_findings``):
 * ``f64-creep`` — any instruction carrying f64, named per site (the
   coarse count lives in ``dtype_summary``; this is the ledger's
   per-site form);
+* ``int8-accum-matmul`` — a dot/convolution on int8 operands whose
+  result is narrower than i32: the ``preferred_element_type=int32``
+  the quantized-GEMM recipe requires was dropped, so partial sums
+  wrap at ±127 (mxtpu.quant's accumulation rule, ISSUE 18);
+* ``quant-missing-scale`` — an int8 dot/convolution whose metadata
+  carries no ``q8_<key>`` scale tag: ``mxtpu.quant.wrap_op`` tags
+  every contraction it quantizes with the dispatch key of its
+  recorded activation threshold, so an untagged int8 contraction is
+  a quantized op with no calibrated scale behind it;
 * ``master-weight`` — not an HLO rule: ``master_weight_findings``
   eval_shapes the optimizer's functional rule per parameter and flags
   any sub-f32 param whose update chain carries no f32 master copy.
@@ -56,6 +65,14 @@ _ACCUM_ROOTS = ("add", "multiply")
 
 _MATMUL_OPS = ("dot", "convolution")
 _REDUCE_OPS = ("reduce", "reduce-window")
+
+# the int8 quantization tier (mxtpu.quant): s8/u8 contractions must
+# accumulate in >= 32-bit integers, and each must carry the q8_<key>
+# tag wrap_op stamps (named_scope) when it holds a calibrated scale
+_INT8_DTS = ("s8", "u8")
+_INT_WIDTH = {"s8": 1, "u8": 1, "s16": 2, "u16": 2,
+              "s32": 4, "u32": 4, "s64": 8, "u64": 8}
+_Q8_TAG_RE = re.compile(r"\bq8_")
 
 
 def _norm_path(path: str) -> str:
@@ -293,6 +310,80 @@ def _matmul_hazards(program: HloProgram) -> List[Dict]:
     return out
 
 
+def _int8_operand_dts(comp: Computation,
+                      instr: Instruction) -> List[str]:
+    """Operand dtypes of a contraction when EVERY operand is int8
+    (s8/u8), else [] — the gate both quantization hazard rules and
+    the census share."""
+    op_dts = []
+    for name in instr.operands:
+        src = comp.by_name.get(name)
+        if src and src.shapes:
+            op_dts.append(src.shapes[0][0])
+    if len(op_dts) < 2 or any(dt not in _INT8_DTS for dt in op_dts):
+        return []
+    return op_dts
+
+
+def _int8_matmul_hazards(program: HloProgram) -> List[Dict]:
+    out = []
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode not in _MATMUL_OPS:
+                continue
+            op_dts = _int8_operand_dts(comp, instr)
+            if not op_dts:
+                continue
+            res = _result_dtype(instr)
+            if res in _INT_WIDTH and _INT_WIDTH[res] < 4:
+                out.append(_hazard(
+                    "int8-accum-matmul", instr,
+                    f"{instr.opcode} accumulates "
+                    f"{'x'.join(op_dts)} into {res} — pass "
+                    f"preferred_element_type=int32"))
+    return out
+
+
+def _quant_scale_hazards(program: HloProgram) -> List[Dict]:
+    out = []
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode not in _MATMUL_OPS:
+                continue
+            op_dts = _int8_operand_dts(comp, instr)
+            if not op_dts:
+                continue
+            op_name, _ = instr_site(instr)
+            if not _Q8_TAG_RE.search(op_name):
+                out.append(_hazard(
+                    "quant-missing-scale", instr,
+                    f"int8 {instr.opcode} carries no q8_<key> scale "
+                    f"tag — quantize through mxtpu.quant so every "
+                    f"int8 contraction has a recorded activation "
+                    f"threshold"))
+    return out
+
+
+def int8_contraction_census(program: Union[str, HloProgram]
+                            ) -> Dict[str, int]:
+    """Signature counts of int8 contractions —
+    ``{"s8xs8->s32": n, ...}`` — the i32-accumulation evidence the
+    quantized serving contracts pin."""
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    counts: Dict[str, int] = {}
+    for comp in program.computations.values():
+        for instr in comp.instructions:
+            if instr.opcode not in _MATMUL_OPS:
+                continue
+            op_dts = _int8_operand_dts(comp, instr)
+            if not op_dts:
+                continue
+            sig = f"{'x'.join(op_dts)}->{_result_dtype(instr)}"
+            counts[sig] = counts.get(sig, 0) + 1
+    return {k: counts[k] for k in sorted(counts)}
+
+
 def _f64_hazards(program: HloProgram) -> List[Dict]:
     out = []
     for instr in program.all_instructions():
@@ -310,7 +401,8 @@ def hazard_findings(program: Union[str, HloProgram]) -> List[Dict]:
     if isinstance(program, str):
         program = parse_hlo(program)
     out = (_reduction_hazards(program) + _matmul_hazards(program)
-           + _f64_hazards(program))
+           + _int8_matmul_hazards(program)
+           + _quant_scale_hazards(program) + _f64_hazards(program))
     return sorted(out, key=lambda h: (h["rule"], h["op"], h["site"],
                                       h["detail"]))
 

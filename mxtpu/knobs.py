@@ -153,6 +153,22 @@ register("MXTPU_AMP_SCALE_WINDOW", 2000, "int",
          "Consecutive finite-grad steps before the AMP loss scale "
          "doubles (the grow window; backoff on a non-finite step is "
          "immediate).", "kill-switch")
+register("MXTPU_QUANT", "", "str",
+         "Policy-driven INT8 post-training quantization (mxtpu.quant, "
+         "consumes contracts/quant_policy.json): `0` is the kill "
+         "switch — forces quantization off everywhere and the served "
+         "programs are bit-identical to the unquantized path; `1` "
+         "force-enables it for every ModelRunner; unset defers to the "
+         "per-call `quant=` argument.", "kill-switch")
+register("MXTPU_QUANT_CALIB", "entropy", "str",
+         "Calibration collector for mxtpu.quant activation "
+         "thresholds: `entropy` (KL-minimizing threshold, the "
+         "reference's TensorRT-style search) or `minmax` (abs-max).",
+         "kill-switch")
+register("MXTPU_QUANT_CALIB_BATCHES", 10, "int",
+         "Maximum representative batches a ModelRunner.calibrate() "
+         "pass consumes when the caller does not say otherwise.",
+         "kill-switch")
 
 # -- guards (this PR) --------------------------------------------------
 register("MXTPU_GUARDS", "", "str",

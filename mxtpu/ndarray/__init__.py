@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import amp as _amp
+from .. import quant as _quant
 from ..base import MXNetError
 from ..ops.registry import OP_REGISTRY, get_op, list_ops
 from . import ops_impl  # noqa: F401  (populates the registry)
@@ -76,12 +77,20 @@ def _invoke_op_inner(name: str, *inputs, **kwargs):
             arrays.append(jnp.asarray(x))
     resolved = op.resolve_params(kwargs)
 
+    # policy-driven INT8 quantization (mxtpu.quant): inside a
+    # calibration scope candidate contractions are observed, inside a
+    # quantize scope the ones with a recorded scale become int8 GEMMs
+    # with i32 accumulation.  Checked BEFORE amp so a quantized op is
+    # never double-rewritten; both off paths cost one global read.
+    q_fn = _quant.wrap_op(name, op, arrays, resolved) \
+        if _quant._ACTIVE else None
     # policy-driven autocast (mxtpu.amp): inside an autocast scope,
     # allow-listed contractions get their f32 inputs cast to bf16
     # *inside* the dispatched function so both jax AD and the eager
     # tape differentiate through the casts.  Off path: one global read.
-    amp_fn = _amp.wrap_op(name, op, arrays, resolved) \
-        if _amp._ACTIVE else None
+    amp_fn = q_fn if q_fn is not None else (
+        _amp.wrap_op(name, op, arrays, resolved)
+        if _amp._ACTIVE else None)
 
     from .. import autograd
     if (autograd.is_recording() and op.differentiable

@@ -1,0 +1,37 @@
+"""``python -m mxtpu.quant --self-check`` — the ci_static quant stage.
+
+Probes the contracts the INT8 pass rests on: the committed
+``contracts/quant_policy.json`` parses and keeps its class invariants
+(allow has the contractions, deny carries the transcendentals,
+calibration evidence present), and a calibrate→quantize round trip on
+a tiny two-layer net produces tagged s8×s8→s32 contractions with zero
+dtype-flow hazards, deterministic scales, accuracy within tolerance of
+the f32 reference, and no int8 leak outside the scope.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m mxtpu.quant")
+    parser.add_argument("--self-check", action="store_true",
+                        help="probe policy parse + calibrate->quantize "
+                             "round trip + scale bookkeeping")
+    args = parser.parse_args(argv)
+    if not args.self_check:
+        parser.print_help()
+        return 2
+    # the round-trip lowers a program; stay off any attached
+    # accelerator.  CLI-entry env pinning, before jax loads — not a
+    # calibration-path impurity.
+    os.environ.setdefault(  # mxlint: disable=retrace-impure-call
+        "JAX_PLATFORMS", "cpu")
+    from . import self_check
+    return self_check(verbose=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,7 +92,7 @@ class ModelRunner:
                  max_batch_size: Optional[int] = None,
                  device=None, pad_value: float = 0,
                  donate: Optional[bool] = None, cache: Any = "auto",
-                 amp=None):
+                 amp=None, quant=None):
         import jax
 
         # policy-driven AMP (mxtpu.amp): weights upload bf16 (half the
@@ -101,6 +101,14 @@ class ModelRunner:
         # MXTPU_AMP=0 kills it; off-path programs are bit-identical.
         from .. import amp as _amp_mod
         self._amp = _amp_mod.resolve(amp)
+        # policy-driven INT8 quantization (mxtpu.quant): after a
+        # calibrate() pass records activation thresholds, every
+        # bucket compiles with the policy's allow-listed contractions
+        # as s8xs8 GEMMs accumulating in i32.  MXTPU_QUANT=0 kills
+        # it; off-path programs are bit-identical.
+        from .. import quant as _quant_mod
+        self._quant = _quant_mod.resolve(quant)
+        self._quant_scales: Optional[Dict[str, float]] = None
         self._symbol = symbol
         self._input_names = list(input_specs)
         self._input_specs = {k: tuple(v) for k, v in input_specs.items()}
@@ -316,6 +324,12 @@ class ModelRunner:
             # key only when ON: every pre-AMP cache entry (and the
             # MXTPU_AMP=0 path) keeps its fingerprint unchanged
             fp["amp"] = True
+        if self._quant:
+            # the calibrated thresholds are trace-baked constants, so
+            # they ARE part of what was compiled — recalibration must
+            # miss.  Keyed only when ON (same rule as amp).
+            fp["quant"] = sorted(
+                (self._quant_scales or {}).items()) or True
         blob = _json.dumps(fp, sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
@@ -327,10 +341,16 @@ class ModelRunner:
         batch, seq = bucket
         shapes = {n: list(self._concrete_shape(n, batch, seq))
                   for n in self._input_names}
+        extra = {}
+        if self._quant:
+            # explicit `quant` key component on top of the fingerprint
+            # scales: a quantized executable can NEVER be loaded by an
+            # unquantized runner, or vice versa (tests/test_cache.py)
+            extra["quant"] = "int8"
         return self._cache.key(
             model=self._fingerprint, shape=str(sorted(shapes.items())),
             mesh="1dev", device=getattr(self._device, "device_kind",
-                                        "unknown"))
+                                        "unknown"), **extra)
 
     def cached_buckets(self) -> List[Tuple]:
         """The subset of this runner's ladder present in the
@@ -353,6 +373,81 @@ class ModelRunner:
             return {}
         return self.warmup(hits)
 
+    # -- INT8 calibration (mxtpu.quant, ISSUE 18) -------------------------
+    def calibrate(self, batches: Sequence[Dict[str, Any]],
+                  mode: Optional[str] = None,
+                  num_batches: Optional[int] = None,
+                  collector=None) -> Dict[str, float]:
+        """Post-training calibration: run representative ``batches``
+        (dicts of batched host arrays, one per input) EAGERLY through
+        the deployed graph, observing every candidate contraction's
+        activations with the chosen collector (``mode``: minmax |
+        entropy; default the MXTPU_QUANT_CALIB knob).  The resulting
+        per-tensor |x| thresholds arm the quantized trace path of
+        every subsequent bucket compile, and re-fingerprint the
+        persistent-cache identity (thresholds are trace-baked
+        constants).  Deterministic given fixed batches — byte-equal
+        threshold tables across runs.  Must run before warmup()."""
+        import jax.numpy as jnp
+        from .. import autograd
+        from .. import quant as _quant_mod
+        from ..ndarray.ndarray import NDArray
+        from ..symbol import _eval_symbol
+        if not self._quant:
+            raise MXNetError(
+                "serving: calibrate() on a non-quantized runner — "
+                "pass quant=True (or MXTPU_QUANT=1), and note "
+                "MXTPU_QUANT=0 overrides both")
+        with self._lock:
+            if self._entries:
+                raise MXNetError(
+                    "serving: calibrate() after buckets compiled — "
+                    "calibration changes every program; calibrate "
+                    "before warmup()")
+        if num_batches is None:
+            _, num_batches = _quant_mod.calib_config()
+        if collector is None:
+            collector = _quant_mod.make_collector(mode)
+        # params enter in f32 exactly as _pure_fn re-enters them, so
+        # the observed activations match the traced graph's
+        param_nd = {
+            n: NDArray(v.astype(jnp.float32)
+                       if (jnp.issubdtype(v.dtype, jnp.floating)
+                           and v.dtype != jnp.float32) else v,
+                       None, _placed=True)
+            for n, v in zip(self._param_names, self._param_vals)}
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(False)
+        try:
+            for i, batch in enumerate(batches):
+                if i >= num_batches:
+                    break
+                bindings = dict(param_nd)
+                for n in self._input_names:
+                    # mxlint: sync-point — host batch staging, offline
+                    arr = np.asarray(batch[n], self._input_dtypes[n])
+                    bindings[n] = NDArray(arr, None)
+                with _quant_mod.calibrating(collector):
+                    _eval_symbol(self._symbol, bindings)
+        finally:
+            autograd.set_training(prev_train)
+            autograd.set_recording(prev_rec)
+        self._quant_scales = collector.thresholds()  # mxrace: disable=unguarded-attr (pre-serving setup: calibrate raises once any bucket compiled, so no concurrent reader exists yet and the table is immutable afterwards)
+        if not self._quant_scales:
+            raise MXNetError(
+                "serving: calibration observed no quantizable "
+                "contraction — the graph has no FullyConnected/"
+                "Convolution on f32 inputs")
+        if self._cache is not None:
+            self._fingerprint = self._model_fingerprint()  # mxrace: disable=unguarded-attr (same setup phase: re-fingerprint before any compile/serve thread can read it)
+        return dict(self._quant_scales)
+
+    def quant_scales(self) -> Optional[Dict[str, float]]:
+        """The calibrated activation-threshold table (None before
+        :meth:`calibrate`)."""
+        return dict(self._quant_scales) \
+            if self._quant_scales is not None else None
+
     # -- AOT compile ------------------------------------------------------
     def _pure_fn(self):
         """Pure (traceable) interpretation of the symbol: (input_vals,
@@ -362,12 +457,19 @@ class ModelRunner:
         import jax.numpy as jnp
         from .. import amp as _amp_mod
         from .. import autograd
+        from .. import quant as _quant_mod
         from ..ndarray.ndarray import NDArray
         from ..symbol import _eval_symbol
         sym = self._symbol
         in_names = tuple(self._input_names)
         p_names = self._param_names
         amp_on = self._amp
+        quant_on = self._quant
+        if quant_on and self._quant_scales is None:
+            raise MXNetError(
+                "serving: quantized runner has no calibrated scales — "
+                "run calibrate(batches) before compiling buckets")
+        quant_scales = self._quant_scales
 
         def fn(input_vals, param_vals):
             if amp_on:
@@ -388,8 +490,15 @@ class ModelRunner:
                 bindings[n] = NDArray(v, None, _placed=True)
             prev_rec = autograd.set_recording(False)
             prev_train = autograd.set_training(False)
-            scope = _amp_mod.autocast() if amp_on \
-                else contextlib.nullcontext()
+            # scope nesting: quant outermost — a contraction with a
+            # recorded scale becomes an int8 GEMM; anything it leaves
+            # on the float path still gets amp's bf16 cast when both
+            # passes are on
+            scope = contextlib.ExitStack()
+            if quant_on:
+                scope.enter_context(_quant_mod.quantize(quant_scales))
+            if amp_on:
+                scope.enter_context(_amp_mod.autocast())
             try:
                 with scope:
                     outs = _eval_symbol(sym, bindings)

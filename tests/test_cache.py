@@ -317,6 +317,93 @@ def test_runner_warms_full_ladder_from_disk_zero_compiles(tmp_path):
     assert runner.num_compiled() == nbuckets  # serving added nothing
 
 
+def _fc_quant_runner(cache, quant=False):
+    """One FullyConnected — the smallest graph the INT8 calibration
+    pass accepts (test_quant.py owns the numerics; here it only has
+    to key the cache)."""
+    data = sym.var("data")
+    out = sym.FullyConnected(data, sym.var("w"), sym.var("b"),
+                             num_hidden=4)
+    rng = np.random.RandomState(7)
+    r = ModelRunner(out, {"w": rng.randn(4, 6).astype(np.float32),
+                          "b": np.zeros(4, np.float32)},
+                    {"data": (6,)}, max_batch_size=2, cache=cache,
+                    quant=quant or None)
+    if quant:
+        r.calibrate([{"data": np.linspace(-1.0, 1.0, 12,
+                                          dtype=np.float32)
+                      .reshape(2, 6)}], mode="minmax")
+    return r
+
+
+def test_quantized_entries_isolated_from_float_twin(tmp_path):
+    """INT8 serving (ISSUE 18) never cross-loads: the calibrated
+    fingerprint plus the explicit `quant` key component keep a
+    quantized runner's disk entries disjoint from its float twin's,
+    while a second identically-calibrated quantized process warms
+    fully from disk — and a recalibration on different data misses."""
+    seed = ExecutableCache(tmp_path)
+    fl = _fc_quant_runner(seed)
+    fl.warmup()
+    n = fl.num_compiled()
+    assert n == len(fl.buckets()) >= 2
+    assert seed.stats()["store"] == n
+
+    q1 = _fc_quant_runner(ExecutableCache(tmp_path), quant=True)
+    bucket = fl.buckets()[0]
+    # key level: same model/bucket, the quant component alone splits
+    assert q1._cache_key(bucket).digest != fl._cache_key(bucket).digest
+    # the float ladder on disk is invisible to the quantized runner
+    assert q1.cached_buckets() == []
+    q1.warmup()
+    st = q1._cache.stats()
+    assert st["hit"] == 0 and st["store"] == n
+    # ... and vice versa: a fresh float twin still sees only its own
+    fresh = _fc_quant_runner(ExecutableCache(tmp_path))
+    assert sorted(fresh.cached_buckets()) == sorted(fresh.buckets())
+
+    # same calibration in a "new process" -> full disk warm
+    q2 = _fc_quant_runner(ExecutableCache(tmp_path), quant=True)
+    assert sorted(q2.cached_buckets()) == sorted(q2.buckets())
+    q2.warm_from_disk()
+    st2 = q2._cache.stats()
+    assert st2["hit"] == n and st2["store"] == 0
+
+    # different calibration data -> different thresholds baked into
+    # the trace -> the fingerprint must miss every entry
+    q3 = ModelRunner(fl._symbol, {"w": fl._param_vals[0],
+                                  "b": fl._param_vals[1]},
+                     {"data": (6,)}, max_batch_size=2,
+                     cache=ExecutableCache(tmp_path), quant=True)
+    q3.calibrate([{"data": 5.0 * np.linspace(-1.0, 1.0, 12,
+                                             dtype=np.float32)
+                   .reshape(2, 6)}], mode="minmax")
+    assert q3.cached_buckets() == []
+
+
+def test_quantized_poisoned_entry_quarantines_and_recompiles(tmp_path):
+    """Quarantine-on-mismatch holds on the int8 tier too: a corrupted
+    quantized entry is caught by the verify-or-quarantine loader and
+    recompiled off the data path, never executed."""
+    plan = FaultPlan(CorruptEntry(at_store=0))
+    q1 = _fc_quant_runner(ExecutableCache(tmp_path, faults=plan),
+                          quant=True)
+    q1.warmup()
+    n = q1.num_compiled()
+    assert n >= 2 and plan.fired == ["corruptentry@0"]
+
+    fresh = ExecutableCache(tmp_path)
+    q2 = _fc_quant_runner(fresh, quant=True)
+    # the existence probe still lists the poisoned bucket ...
+    assert sorted(q2.cached_buckets()) == sorted(q2.buckets())
+    q2.warm_from_disk()
+    st = fresh.stats()
+    # ... but the verified load quarantines it and recompiles
+    assert st["quarantined"] == 1 and st["hit"] == n - 1
+    assert st["store"] == 1
+    assert q2.num_compiled() == n
+
+
 def test_fleet_kill_then_disk_warmed_replacement(tmp_path):
     """The acceptance scenario: a worker dies (preemption), no donor
     handoff exists, yet the replacement serves its FIRST request with

@@ -111,7 +111,7 @@ def test_committed_contracts_keep_dtype_shape():
     """The migration is compat: every committed hlocheck contract
     still carries the {converts, f64_ops, upcasts} dtype block."""
     cdir = os.path.join(_ROOT, "contracts")
-    foreign = {"lockorder", "amp_policy"}
+    foreign = {"lockorder", "amp_policy", "quant_policy"}
     seen = 0
     for fn in sorted(os.listdir(cdir)):
         if not fn.endswith(".json") or fn[:-5] in foreign:
@@ -170,6 +170,42 @@ def test_seeded_f64_creep():
     assert set(_rules(led["hazards"])) == {"f64-creep"}
     assert any(h["op"] == "convert" and "test_prec.py" in h["site"]
                for h in led["hazards"])
+
+
+def test_seeded_int8_accum_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    def q8_dot_no_accum(a, b):
+        # tagged like the real pass, so ONLY the accumulation rule
+        # fires — the missing preferred_element_type lets the s8xs8
+        # product land back in s8
+        with jax.named_scope("q8_seeded"):
+            return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+
+    led = analysis.lowered_summary(
+        q8_dot_no_accum,
+        jnp.ones((4, 8), jnp.int8), jnp.ones((8, 2), jnp.int8))
+    assert _rules(led["hazards"]) == ["int8-accum-matmul"]
+    h = led["hazards"][0]
+    assert h["op"] == "dot"
+    assert "test_prec.py" in h["site"]
+    assert "preferred_element_type=int32" in h["detail"]
+
+
+def test_seeded_quant_missing_scale():
+    import jax.numpy as jnp
+    from jax import lax
+
+    led = analysis.lowered_summary(
+        lambda a, b: lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.int32),
+        jnp.ones((4, 8), jnp.int8), jnp.ones((8, 2), jnp.int8))
+    assert _rules(led["hazards"]) == ["quant-missing-scale"]
+    h = led["hazards"][0]
+    assert h["op"] == "dot"
+    assert "test_prec.py" in h["site"]
+    assert "q8_" in h["detail"]
 
 
 def _bf16_step(x, y, oparams):
@@ -374,3 +410,33 @@ def test_amp_policy_is_machine_derived():
         {"batch_norm", "flash_attention", "layer_norm"}
     for meta in policy["custom_calls"].values():
         assert meta["accum_dtype"] == "f32"
+
+
+def test_quant_policy_is_machine_derived():
+    """quant_policy.json carries the allow/deny classes with
+    per-target evidence plus the calibration block — thresholds under
+    both estimators, per-channel weight scales, and the int8
+    contraction census the serving contract pins."""
+    with open(os.path.join(_ROOT, "contracts",
+                           "quant_policy.json")) as f:
+        policy = json.load(f)
+    assert policy["targets"] == ["resnet18", "serving_bert"]
+    for cls in ("allow", "deny"):
+        assert policy[cls], cls
+        for op, entry in policy[cls].items():
+            assert entry["reason"], op
+    assert "dot" in policy["allow"]
+    assert "convolution" in policy["allow"]
+    for op, entry in policy["allow"].items():
+        assert entry["evidence"], op  # {target: float-op count}
+    assert "exponential" in policy["deny"]
+    assert "rsqrt" in policy["deny"]
+    calib = policy["calibration"]
+    th = calib["activation_thresholds"]
+    assert set(th) == {"entropy", "minmax"}
+    assert set(th["entropy"]) == set(th["minmax"]) \
+        == set(calib["weight_scales"])
+    for key, scales in calib["weight_scales"].items():
+        assert scales and all(s > 0 for s in scales), key
+    for census in calib["int8_contractions"].values():
+        assert census == {"s8xs8->s32": 9}

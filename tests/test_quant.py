@@ -1,0 +1,235 @@
+"""mxtpu.quant — INT8 post-training quantization (ISSUE 18).
+
+Covers: the MXTPU_QUANT kill-switch precedence ladder and the
+bit-identical off-path program; calibration determinism (byte-equal
+threshold tables across runs, both collectors); the serving BERT
+accuracy gate against its f32 twin with the s8xs8->s32 contraction
+census and zero dtype-flow hazards pinned; the `python -m tools.mxprec
+--quant` update->check fixed point at the byte level; and the
+calibrate() error contract.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxtpu import quant
+from mxtpu import symbol as sym
+from mxtpu.analysis import dtypeflow
+from mxtpu.base import MXNetError
+from mxtpu.serving import ModelRunner
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fc_runner(**kwargs):
+    """Two tiny FullyConnected layers behind a relu — enough graph for
+    calibration to observe two candidate contractions."""
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("w1"), sym.var("b1"),
+                           num_hidden=8)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.var("w2"), sym.var("b2"),
+                             num_hidden=4)
+    rng = np.random.RandomState(3)
+    params = {"w1": (rng.randn(8, 6) / np.sqrt(6)).astype(np.float32),
+              "b1": np.zeros(8, np.float32),
+              "w2": (rng.randn(4, 8) / np.sqrt(8)).astype(np.float32),
+              "b2": np.zeros(4, np.float32)}
+    return ModelRunner(out, params, {"data": (6,)}, max_batch_size=2,
+                       cache=None, **kwargs)
+
+
+def _calib_batches(scale=1.0, n=3):
+    rng = np.random.RandomState(11)
+    return [{"data": (scale * rng.randn(2, 6)).astype(np.float32)}
+            for _ in range(n)]
+
+
+# ----------------------------------------------------- switch + knobs
+
+def test_resolve_kill_switch_precedence(monkeypatch):
+    monkeypatch.setenv("MXTPU_QUANT", "0")
+    assert quant.resolve(True) is False  # env kill beats the argument
+    monkeypatch.setenv("MXTPU_QUANT", "1")
+    assert quant.resolve(None) is True
+    monkeypatch.delenv("MXTPU_QUANT")
+    assert quant.resolve(None) is False
+    assert quant.resolve(True) is True
+
+
+def test_calib_config_rejects_unknown_mode(monkeypatch):
+    monkeypatch.setenv("MXTPU_QUANT_CALIB", "percentile")
+    with pytest.raises(MXNetError, match="MXTPU_QUANT_CALIB"):
+        quant.calib_config()
+
+
+def test_kill_switch_bit_identical_program(monkeypatch):
+    """MXTPU_QUANT=0 with quant=True requested produces the same
+    pre-opt program, byte for byte, as a plain float runner — the
+    off path really is OFF."""
+    monkeypatch.setenv("MXTPU_QUANT", "0")
+    killed = _fc_runner(quant=True)       # requested, env kills it
+    with pytest.raises(MXNetError, match="non-quantized"):
+        killed.calibrate(_calib_batches())
+    monkeypatch.delenv("MXTPU_QUANT")
+    plain = _fc_runner()
+    bucket = plain.buckets()[0]
+    killed_text = killed.lowered_program_text(bucket)
+    assert killed_text == plain.lowered_program_text(bucket)
+    assert "s8[" not in killed_text
+    # ... while the armed path rewrites the contractions to int8
+    armed = _fc_runner(quant=True)
+    armed.calibrate(_calib_batches())
+    armed_text = armed.lowered_program_text(bucket)
+    assert armed_text != killed_text
+    assert dtypeflow.int8_contraction_census(armed_text) == \
+        {"s8xs8->s32": 2}
+
+
+# ------------------------------------------ calibration determinism
+
+@pytest.mark.parametrize("mode", ["minmax", "entropy"])
+def test_calibration_is_deterministic(mode):
+    """Identical batches -> byte-equal threshold tables, run to run
+    and runner to runner; every value carries the committed 6-sig-fig
+    decimal form (quant_policy.json evidence stays byte-stable)."""
+    runs = []
+    for _ in range(2):
+        r = _fc_runner(quant=True)
+        runs.append(r.calibrate(_calib_batches(), mode=mode))
+    assert runs[0] == runs[1]
+    assert sorted(runs[0]) == ["FullyConnected_0", "FullyConnected_1"]
+    for key, v in runs[0].items():
+        assert v > 0, key
+        assert v == float(f"{v:.6g}"), key  # round6'd
+    # repeated calibration on ONE runner (pre-compile) re-derives the
+    # same table rather than accumulating state
+    r = _fc_runner(quant=True)
+    first = r.calibrate(_calib_batches(), mode=mode)
+    again = r.calibrate(_calib_batches(), mode=mode)
+    assert first == again == r.quant_scales()
+
+
+def test_collectors_disagree_on_outliers():
+    """The two estimators are genuinely different algorithms: on a
+    heavy-tailed activation the KL threshold clips inside the raw
+    |x| max, minmax never does."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(4096).astype(np.float32)
+    x[0] = 40.0                             # one outlier
+    mm = quant.MinMaxCollector()
+    en = quant.EntropyCollector()
+    for c in (mm, en):
+        c.observe("k", x)
+    t_mm = mm.thresholds()["k"]
+    t_en = en.thresholds()["k"]
+    assert t_mm == pytest.approx(40.0, rel=1e-5)
+    assert 0 < t_en < 0.5 * t_mm
+
+
+def test_calibrate_guardrails():
+    r = _fc_runner(quant=True)
+    r.calibrate(_calib_batches())
+    bucket = r.buckets()[0]
+    r.warmup([bucket])
+    with pytest.raises(MXNetError, match="after buckets compiled"):
+        r.calibrate(_calib_batches())
+    # a graph with no quantizable contraction refuses to calibrate
+    data = sym.var("data")
+    mul = ModelRunner(data * sym.var("w"),
+                      {"w": np.ones(3, np.float32)}, {"data": (3,)},
+                      max_batch_size=2, cache=None, quant=True)
+    with pytest.raises(MXNetError, match="no quantizable"):
+        mul.calibrate([{"data": np.ones((2, 3), np.float32)}])
+
+
+# ------------------------------------- serving BERT accuracy + census
+
+def test_bert_int8_accuracy_census_and_hazards():
+    """The acceptance gate: the quantized serving BERT fixture stays
+    within 10% of its f32 twin's logit scale (measured 4.7% at seed
+    0), every per-layer GEMM lowered as s8xs8 accumulating in s32
+    (census == the committed quant_policy.json evidence), zero
+    dtype-flow hazards, and the float twin carries no int8 at all."""
+    from tools.hlocheck import targets as T
+    from mxtpu.ndarray import random as mxrnd
+
+    mxrnd.seed(0)                 # same init stream as the quant twin
+    f32 = T._serving_runner()
+    q8 = T._serving_runner(quant=True)   # reseeds 0 internally
+
+    bucket = (4, 32)
+    rng = np.random.RandomState(123)
+    reqs = [{"data": rng.randint(0, T._VOCAB, (32,))
+             .astype(np.float32)} for _ in range(4)]
+
+    def logits(r):
+        return np.asarray(
+            r.run_raw(r._pad_stack(reqs, bucket), bucket)[0])
+
+    lf, lq = logits(f32), logits(q8)
+    scale = float(np.abs(lf).max())
+    delta = float(np.abs(lq - lf).max())
+    assert 0 < delta <= 0.10 * max(1.0, scale), (delta, scale)
+
+    q_text = q8.lowered_program_text(bucket)
+    census = dtypeflow.int8_contraction_census(q_text)
+    assert census == {"s8xs8->s32": 9}
+    assert dtypeflow.program_ledger(q_text)["hazards"] == []
+    assert "s8[" not in f32.lowered_program_text(bucket)
+    # the census in the committed policy evidence is THIS census
+    with open(os.path.join(_ROOT, "contracts",
+                           "quant_policy.json")) as f:
+        policy = json.load(f)
+    assert policy["calibration"]["int8_contractions"][
+        f"bucket_b{bucket[0]}_s{bucket[1]}"] == census
+
+
+# ---------------------------------------------------------------- CLI
+
+def _mxprec(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxprec", *args],
+        capture_output=True, text=True, cwd=_ROOT, timeout=240)
+
+
+@pytest.mark.slow
+def test_cli_quant_update_check_fixed_point(tmp_path):
+    """`--quant --update` into a scratch dir reproduces the committed
+    policy byte for byte (derivation is deterministic AND the
+    committed file is its own fixed point), and `--quant` catches a
+    corrupted threshold with exit 1."""
+    d = str(tmp_path)
+    up = _mxprec("--quant", "--update", "--contracts-dir", d)
+    assert up.returncode == 0, up.stdout + up.stderr
+    fresh = (tmp_path / "quant_policy.json").read_bytes()
+    with open(os.path.join(_ROOT, "contracts",
+                           "quant_policy.json"), "rb") as f:
+        assert fresh == f.read()
+
+    policy = json.loads(fresh)
+    key = next(iter(policy["calibration"]["activation_thresholds"]
+                    ["entropy"]))
+    policy["calibration"]["activation_thresholds"]["entropy"][key] \
+        += 1.0
+    (tmp_path / "quant_policy.json").write_text(
+        json.dumps(policy, indent=1, sort_keys=True) + "\n")
+    bad = _mxprec("--quant", "--contracts-dir", d)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "quant_policy" in bad.stdout
+
+
+def test_cli_quant_missing_policy_is_a_violation(tmp_path):
+    r = _mxprec("--quant", "--contracts-dir", str(tmp_path))
+    assert r.returncode == 1
+    assert "quant_policy" in r.stdout
+
+
+# --------------------------------------------------------- self-check
+
+def test_self_check_passes():
+    assert quant.self_check() == 0

@@ -21,7 +21,12 @@ contracts.
 * ``python -m mxtpu.amp --self-check`` (the AMP pass's three
   contracts: policy parse/classes, an autocast round-trip on the
   selftest program — bf16 edges, zero hazards, no leak outside the
-  scope — and the loss-scaler grow/backoff/skip accounting),
+  scope — and the loss-scaler grow/backoff/skip accounting), then
+* ``python -m mxtpu.quant --self-check`` (the INT8 tier's contracts:
+  quant-policy parse/classes/evidence, a calibrate→quantize round
+  trip — deterministic scales, s8×s8→s32 accumulation, tagged and
+  hazard-free, numerically close to f32 — and the no-leak-outside-
+  the-scope kill-switch shape),
 
 prints one PASS/FAIL line per stage, and exits non-zero if any
 failed — the single entry point a CI job or pre-push hook needs.
@@ -45,6 +50,7 @@ STAGES = (
     ("mxrace", ("-m", "tools.mxrace", "--check"), True),
     ("mxprec", ("-m", "tools.mxprec", "--check"), True),
     ("amp-self-check", ("-m", "mxtpu.amp", "--self-check"), False),
+    ("quant-self-check", ("-m", "mxtpu.quant", "--self-check"), False),
 )
 
 

@@ -87,9 +87,9 @@ def main(argv=None) -> int:
         # a registered target; a contract whose target vanished is an
         # error, not silence.  contracts/ is shared with mxrace
         # (lockorder.json, checked by `python -m tools.mxrace`) and
-        # mxprec (amp_policy.json + prec/, checked by `python -m
-        # tools.mxprec`), not here.
-        foreign = {"lockorder", "amp_policy"}
+        # mxprec (amp_policy.json + quant_policy.json + prec/, checked
+        # by `python -m tools.mxprec`), not here.
+        foreign = {"lockorder", "amp_policy", "quant_policy"}
         names = sorted(p.stem for p in directory.glob("*.json")
                        if p.stem not in foreign)
         orphans = [n for n in names if n not in T.TARGETS]

@@ -238,12 +238,30 @@ def resnet18_amp_prec() -> Dict:
     return _prec_train(*_resnet_parts(amp=True))
 
 
-def _serving_runner(amp: bool = False):
+def _quant_calib_batches(n: int = 4):
+    """Seeded representative batches for INT8 calibration — fixed
+    token ids, so the calibrated thresholds (and therefore the
+    quantized fixture's HLO, which bakes them in as constants) are
+    byte-reproducible on any box."""
+    rng = np.random.RandomState(0)
+    return [{"data": rng.randint(0, _VOCAB, (4, 32))
+             .astype(np.float32)} for _ in range(n)]
+
+
+def _serving_runner(amp: bool = False, quant: bool = False):
     import os
     import tempfile
     from mxtpu import nd
     from mxtpu.models.transformer import BERTModel
     from mxtpu.serving import ModelRunner
+    if quant:
+        # float serving programs are weight-independent (params are
+        # runtime inputs), but the quantized trace bakes the
+        # CALIBRATED activation thresholds in as constants — and those
+        # depend on the weights, so the int8 fixture pins the global
+        # init stream
+        from mxtpu.ndarray import random as _mxrnd
+        _mxrnd.seed(0)
     net = BERTModel(_VOCAB, 64, 128, 2, 2, max_length=32,
                     dropout=0.0)
     net.initialize(init="xavier")
@@ -252,10 +270,15 @@ def _serving_runner(amp: bool = False):
                  .astype(np.float32)))
     d = tempfile.mkdtemp(prefix="hlocheck_bert_")
     sym_file, param_file = net.export(os.path.join(d, "bert"))
-    return ModelRunner.from_export(
+    runner = ModelRunner.from_export(
         sym_file, param_file, input_specs={"data": (None,)},
         seq_buckets=[16, 32], max_batch_size=4,
-        amp=amp or None)
+        amp=amp or None, quant=quant or None)
+    if quant:
+        # explicit mode (not the env knob): the committed contracts
+        # pin the entropy-calibrated thresholds
+        runner.calibrate(_quant_calib_batches(), mode="entropy")
+    return runner
 
 
 @register_target("serving_bert")
@@ -296,6 +319,102 @@ def serving_bert_amp_prec() -> Dict:
             runner.lowered_program_text(bucket)
     return {"programs": programs, "optimizer": None,
             "param_sigs": None}
+
+
+@register_target("serving_bert_int8")
+def serving_bert_int8() -> Dict[str, Artifact]:
+    """The serving ladder calibrated + quantized (mxtpu.quant): every
+    bucket's compiled program carries the policy's contractions as
+    s8xs8 GEMMs accumulating in i32, plus one ``_as_written``
+    (pre-optimization) entry — the level the prec ledger and the
+    dtypeflow int8 hazard rules read, immune to any CPU-backend
+    normalization of the compiled text."""
+    runner = _serving_runner(quant=True)
+    runner.warmup()
+    out: Dict[str, Artifact] = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        text, mem = runner.program_artifact(bucket)
+        out[f"bucket_b{batch}_s{seq}"] = (text, mem)
+    top = max(runner.buckets())
+    out[f"bucket_b{top[0]}_s{top[1]}_as_written"] = \
+        (runner.lowered_program_text(top), None)
+    return out
+
+
+@register_prec("serving_bert_int8")
+def serving_bert_int8_prec() -> Dict:
+    runner = _serving_runner(quant=True)
+    programs = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        programs[f"bucket_b{batch}_s{seq}"] = \
+            runner.lowered_program_text(bucket)
+    return {"programs": programs, "optimizer": None,
+            "param_sigs": None}
+
+
+class _QuantEvidenceCollector:
+    """MinMax activation collector that ALSO records the per-channel
+    |w| scales the quantized trace computes in-graph — the policy's
+    machine evidence that every quantized weight has a usable
+    per-output-channel scale (``observe_weight`` is the optional hook
+    ``mxtpu.quant.wrap_op`` probes for)."""
+
+    def __init__(self):
+        from mxtpu import quant as Q
+        self._inner = Q.MinMaxCollector()
+        self.weights: Dict[str, list] = {}
+
+    mode = "minmax"
+
+    def observe(self, key, value):
+        self._inner.observe(key, value)
+
+    def observe_weight(self, key, value):
+        from mxtpu import quant as Q
+        arr = np.asarray(value, np.float32)
+        red = tuple(range(1, arr.ndim))
+        t = np.abs(arr).max(axis=red) if arr.ndim > 1 else np.abs(arr)
+        self.weights.setdefault(
+            key, [Q._round6(float(v))
+                  for v in np.ravel(np.maximum(t, 1e-12))])
+
+    def thresholds(self):
+        return self._inner.thresholds()
+
+
+def quant_calibration_evidence() -> Dict:
+    """The ``calibration`` section of ``contracts/quant_policy.json``
+    (written by ``python -m tools.mxprec --quant --update``):
+    deterministic seeded evidence from the quantized serving fixture —
+    both collectors' per-tensor activation thresholds, every quantized
+    parameter's per-channel weight scales, and the s8xs8->s32
+    contraction census of the quantized bucket ladder."""
+    from mxtpu.analysis import dtypeflow
+    batches = _quant_calib_batches()
+    runner = _serving_runner(quant=True)  # entropy-calibrated
+    evidence = _QuantEvidenceCollector()
+    minmax = runner.calibrate(batches, collector=evidence)
+    # re-arm with the entropy table LAST so the census below matches
+    # the committed serving_bert_int8 contracts (also entropy)
+    entropy = runner.calibrate(batches, mode="entropy")
+    census = {}
+    for bucket in runner.buckets():
+        batch, seq = bucket
+        census[f"bucket_b{batch}_s{seq}"] = \
+            dtypeflow.int8_contraction_census(
+                runner.lowered_program_text(bucket))
+    return {
+        "fixture": "serving_bert fixture, quant=True: mxtpu.random "
+                   "seed 0 init, 4 seeded token batches "
+                   "(RandomState(0), shape (4, 32))",
+        "num_batches": len(batches),
+        "activation_thresholds": {"entropy": entropy,
+                                  "minmax": minmax},
+        "weight_scales": evidence.weights,
+        "int8_contractions": census,
+    }
 
 
 def _selftest_parts():

@@ -100,13 +100,29 @@ _IMPURE_PREFIX = ("random.", "np.random.", "numpy.random.",
 class RetraceImpureCall(Rule):
     """Host-impure calls in a jit body run ONCE at trace time and are
     baked into the compiled program — time stands still, randomness
-    freezes, env reads go stale."""
+    freezes, env reads go stale.
+
+    Inside the deterministic scope (``mxtpu/quant/`` — INT8
+    calibration promises byte-identical thresholds across runs, and
+    quant_policy.json commits them) the scan widens from jit bodies to
+    EVERY function body: an RNG or clock call anywhere in the
+    calibration tier silently breaks the committed evidence.  ``print``
+    stays allowed there — it is non-deterministic only in a trace."""
 
     name = "retrace-impure-call"
+    _DETERMINISTIC_SCOPE = ("mxtpu/quant/",)
 
     def check(self, ctx: FileCtx) -> List[Finding]:
         out: List[Finding] = []
-        for body in find_jit_bodies(ctx.tree):
+        deterministic = ctx.rel.startswith(self._DETERMINISTIC_SCOPE)
+        if deterministic:
+            bodies = [n for n in ast.walk(ctx.tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda))]
+        else:
+            bodies = find_jit_bodies(ctx.tree)
+        for body in bodies:
             for node in ast.walk(body):
                 if not isinstance(node, ast.Call):
                     continue
@@ -115,9 +131,13 @@ class RetraceImpureCall(Rule):
                     continue
                 if d in _IMPURE_EXACT or \
                         any(d.startswith(p) for p in _IMPURE_PREFIX) \
-                        or d == "print":
+                        or (d == "print" and not deterministic):
                     out.append(Finding(
                         self.name, ctx.rel, node.lineno,
+                        f"impure call `{d}` in the deterministic "
+                        f"calibration scope breaks byte-reproducible "
+                        f"thresholds (quant_policy.json evidence)"
+                        if deterministic else
                         f"impure call `{d}` inside a jit body executes "
                         f"once at trace time and is constant-folded "
                         f"into the compiled program"))

@@ -11,7 +11,11 @@ hlocheck / mxrace):
 ``--update`` re-lowers the named targets (default: all) at the
 PRE-optimization level and rewrites ``contracts/prec/<target>.json``;
 a full ``--update`` additionally derives ``contracts/amp_policy.json``
-from the same lowerings.  The default mode re-lowers and checks; the
+and ``contracts/quant_policy.json`` from the same lowerings.
+``--quant`` is the focused INT8 mode: it lowers only the quant
+evidence base (``core.QUANT_BASE_TARGETS``) and writes/checks
+``contracts/quant_policy.json`` alone — the cheap round trip the
+quant tests pin.  The default mode re-lowers and checks; the
 AMP-policy and README-table drift checks run only on a full default
 check (no explicit targets), so a single-target round trip stays
 cheap for tier-1 tests.  Lowering happens on the CPU backend with the
@@ -66,6 +70,11 @@ def main(argv=None) -> int:
                     help="regenerate the README precision table from "
                          "the COMMITTED ledgers (no lowering) and "
                          "exit")
+    ap.add_argument("--quant", action="store_true",
+                    help="focused INT8 mode: derive/check "
+                         "contracts/quant_policy.json from the quant "
+                         "evidence base only (with --update: rewrite "
+                         "it)")
     ap.add_argument("--contracts-dir", type=Path, default=None,
                     help="lockfile directory (default: contracts/)")
     args = ap.parse_args(argv)
@@ -92,6 +101,55 @@ def main(argv=None) -> int:
         print("mxprec: README precision table "
               + ("rewritten" if changed else "already fresh"))
         return 0
+
+    if args.quant:
+        # focused INT8 round trip: lower only the evidence base,
+        # write or check contracts/quant_policy.json, nothing else
+        t0 = time.perf_counter()
+        missing = [t for t in core.QUANT_BASE_TARGETS
+                   if t not in T.PREC_TARGETS]
+        if missing:
+            print(f"mxprec: quant base target(s) unregistered: "
+                  f"{', '.join(missing)}", file=sys.stderr)
+            return 2
+        violations = []
+        committed = None
+        ppath = core.quant_policy_path(directory)
+        if not args.update:
+            # probe the committed file BEFORE the expensive lowerings
+            # — a missing/unreadable policy needs no fresh evidence
+            if not ppath.exists():
+                violations.append(
+                    f"quant_policy: no {ppath} — run --quant --update")
+            else:
+                try:
+                    committed = json.loads(ppath.read_text())
+                except (ValueError, OSError) as e:
+                    print(f"mxprec: cannot read {ppath}: {e}",
+                          file=sys.stderr)
+                    return 2
+        if args.update or committed is not None:
+            texts = {}
+            for name in core.QUANT_BASE_TARGETS:
+                _, texts[name] = core.build_target(name)
+            policy = core.build_quant_policy(texts)
+        dt = time.perf_counter() - t0
+        if args.update:
+            path = core.save_quant_policy(policy, directory)
+            print(f"mxprec: wrote {path} ({dt:.1f}s)")
+            return 0
+        if committed is not None:
+            violations += core.compare_policy(committed, policy,
+                                              "quant_policy")
+        if args.as_json:
+            print(json.dumps({"violations": violations,
+                              "seconds": round(dt, 1)}, indent=1))
+        else:
+            for v in violations:
+                print("  " + v)
+            print(f"mxprec: quant policy, {len(violations)} "
+                  f"violation(s) ({dt:.1f}s)")
+        return 1 if violations else 0
 
     if args.targets:
         unknown = [t for t in args.targets
@@ -172,6 +230,10 @@ def main(argv=None) -> int:
                 core.build_amp_policy(texts_by_target), directory)
             if not args.as_json:
                 print(f"mxprec: wrote {path}")
+            qpath = core.save_quant_policy(
+                core.build_quant_policy(texts_by_target), directory)
+            if not args.as_json:
+                print(f"mxprec: wrote {qpath}")
         if args.as_json:
             print(json.dumps(results, indent=1))
         return 0
@@ -191,6 +253,20 @@ def main(argv=None) -> int:
                 return 2
             all_violations += core.compare_policy(committed_policy,
                                                   policy)
+        qpolicy = core.build_quant_policy(texts_by_target)
+        qpath = core.quant_policy_path(directory)
+        if not qpath.exists():
+            all_violations.append(
+                f"quant_policy: no {qpath} — run --quant --update")
+        else:
+            try:
+                committed_q = json.loads(qpath.read_text())
+            except (ValueError, OSError) as e:
+                print(f"mxprec: cannot read {qpath}: {e}",
+                      file=sys.stderr)
+                return 2
+            all_violations += core.compare_policy(
+                committed_q, qpolicy, "quant_policy")
         all_violations += core.readme_drift(
             core.REPO_ROOT, core.committed_ledgers(directory))
 

@@ -53,6 +53,24 @@ _FP32_FORCE_REASON = ("accumulating reduction (incl. cross-replica): "
 _INHERIT_REASON = ("elementwise / data movement / structural: follows "
                    "its input dtype, no accumulation of its own")
 
+# INT8 quantization tier (mxtpu.quant, ISSUE 18).  The allow class is
+# the same machine-observed contraction set, one dtype down; the deny
+# class carries the AMP transcendental list over VERBATIM — int8 has
+# no mantissa for these, they stay bf16/f32.
+_QALLOW_REASON = ("MXU-bound contraction: s8xs8 inputs with i32 "
+                  "accumulation (preferred_element_type=int32); "
+                  "per-channel weight scales, per-tensor activation "
+                  "scales")
+_QDENY_REASON = ("transcendental/division: stays bf16/f32 — carried "
+                 "over verbatim from the AMP deny class (int8 has no "
+                 "mantissa for these)")
+
+# quant-policy evidence base: the serving fixture (dot) plus the conv
+# net (convolution) — the two contraction families the int8 tier
+# rewrites; `--quant` lowers exactly these so the focused mode stays
+# much cheaper than a full sweep
+QUANT_BASE_TARGETS = ("resnet18", "serving_bert")
+
 
 def classify_opcode(opcode: str) -> Tuple[str, str]:
     """(section, reason) for one observed float-carrying opcode."""
@@ -79,6 +97,10 @@ def ledger_path(name: str, directory: Path) -> Path:
 
 def amp_policy_path(directory: Path) -> Path:
     return directory / "amp_policy.json"
+
+
+def quant_policy_path(directory: Path) -> Path:
+    return directory / "quant_policy.json"
 
 
 def _dump(obj) -> str:
@@ -147,13 +169,13 @@ def build_amp_policy(texts_by_target: Dict[str, Dict[str, str]]
     from mxtpu import kernels
     from mxtpu.analysis import dtypeflow
 
-    # ``*_amp`` targets are CONSUMERS of this policy (their lowerings
-    # already carry the bf16 casts it prescribes); feeding them back
-    # in as evidence would be circular and would churn the committed
-    # policy every time an AMP lowering changes.  Derive from the
-    # f32 baselines only.
+    # ``*_amp`` / ``*_int8`` targets are CONSUMERS of the derived
+    # policies (their lowerings already carry the casts / int8 GEMMs
+    # those prescribe); feeding them back in as evidence would be
+    # circular and would churn the committed policy every time a
+    # rewritten lowering changes.  Derive from the f32 baselines only.
     texts_by_target = {t: v for t, v in texts_by_target.items()
-                       if not t.endswith("_amp")}
+                       if not t.endswith(("_amp", "_int8"))}
 
     counts: Dict[str, Dict[str, int]] = {}
     for target in sorted(texts_by_target):
@@ -185,6 +207,58 @@ def build_amp_policy(texts_by_target: Dict[str, Dict[str, str]]
 
 def save_amp_policy(policy: Dict, directory: Path) -> Path:
     path = amp_policy_path(directory)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(_dump(policy))
+    return path
+
+
+def build_quant_policy(texts_by_target: Dict[str, Dict[str, str]]
+                       ) -> Dict:
+    """``contracts/quant_policy.json``: the allow class is every
+    contraction opcode OBSERVED float-carrying across the f32
+    baselines of :data:`QUANT_BASE_TARGETS`, the deny class carries
+    the AMP transcendental list verbatim, and the calibration section
+    is machine evidence from a deterministic seeded calibration of
+    the quantized serving fixture — both collectors' per-tensor
+    activation thresholds, the per-channel weight scales of every
+    quantized parameter, and the s8xs8->s32 contraction census of the
+    quantized bucket ladder.  Byte-deterministic: fixed batches,
+    6-significant-figure rounding, sorted keys."""
+    from mxtpu.analysis import dtypeflow
+    from tools.hlocheck import targets as T
+
+    base = {t: v for t, v in texts_by_target.items()
+            if t in QUANT_BASE_TARGETS}
+    counts: Dict[str, Dict[str, int]] = {}
+    for target in sorted(base):
+        for prog in sorted(base[target]):
+            text = base[target][prog]
+            for op, n in dtypeflow.float_opcode_counts(text).items():
+                slot = counts.setdefault(op, {})
+                slot[target] = slot.get(target, 0) + n
+
+    allow = {op: {"reason": _QALLOW_REASON, "evidence": counts[op]}
+             for op in sorted(counts) if op in _ALLOW_OPS}
+    deny = {op: {"reason": _QDENY_REASON,
+                 "evidence": counts.get(op, {})}
+            for op in sorted(_DENY_OPS)}
+    return {
+        "comment": "machine-derived INT8 quantization policy -- "
+                   "allow = contractions observed in the f32 "
+                   "baselines, deny = the AMP transcendental class "
+                   "verbatim, calibration = deterministic seeded "
+                   "evidence from the quantized serving fixture; "
+                   "regenerate with `python -m tools.mxprec --quant "
+                   "--update`",
+        "targets": sorted(base),
+        "allow": allow,
+        "deny": deny,
+        "calibration": T.quant_calibration_evidence(),
+    }
+
+
+def save_quant_policy(policy: Dict, directory: Path) -> Path:
+    path = quant_policy_path(directory)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(_dump(policy))
     return path
@@ -234,12 +308,13 @@ def compare_ledgers(committed: Dict, fresh: Dict) -> List[str]:
     return out or ["ledger drifted (serialization-level difference)"]
 
 
-def compare_policy(committed: Dict, fresh: Dict) -> List[str]:
+def compare_policy(committed: Dict, fresh: Dict,
+                   label: str = "amp_policy") -> List[str]:
     if _dump(committed) == _dump(fresh):
         return []
     out: List[str] = []
-    _diff(committed, fresh, "amp_policy", out)
-    return out or ["amp_policy drifted"]
+    _diff(committed, fresh, label, out)
+    return out or [f"{label} drifted"]
 
 
 # ---------------------------------------------------------------------
