@@ -6,7 +6,8 @@ training throughput** (north-star #1, BASELINE.md); the BERT-Large
 round's ``BENCH_r{N}.json`` captures the full picture.  Set
 MXTPU_BENCH_MODEL=lenet|resnet50|resnet50_pipeline|bert|bert_s512|
 transformer|moe_ffn|ssd|bert_zero|serving_bert|serving_fleet|
-serving_autoscale|serving_coldstart|serving_bert_int8 to run a single
+serving_autoscale|serving_coldstart|serving_bert_int8|
+serving_generate to run a single
 workload (moe_ffn, ssd, bert_zero and the serving_* rows are
 on-demand only — not part of the default ``all`` sweep, which is
 sized to the wall budget).  ``--amp`` (or MXTPU_BENCH_MODEL=resnet50_amp|bert_amp|
@@ -108,6 +109,7 @@ _METRIC_NAMES = {
     "serving_autoscale": "serving_autoscale_burst_absorb_throughput",
     "serving_coldstart": "serving_coldstart_disk_warm_speedup",
     "serving_bert_int8": "serving_bert_int8_raw_throughput",
+    "serving_generate": "serving_generate_decode_throughput",
     "lenet": "lenet_mnist_train_throughput",
     # --amp pairs: each row runs its base workload twice (AMP off /
     # AMP on via mxtpu.amp) and reports rate + MFU + comm side by side
@@ -152,6 +154,9 @@ _TRAIN_FLOPS = {
     "serving_bert_int8": None,  # ablation row — the int8/f32 ratio,
                                 # accuracy delta and s8xs8->s32 census
                                 # are the result, not MFU
+    "serving_generate": None,   # decode row — tokens/sec, TTFT and
+                                # the kv-vs-naive-reprefill ratio are
+                                # the result, not MFU
     "lenet": None,            # too small for MFU to mean anything
     # amp pairs reuse the base row's FLOP denominator: AMP changes
     # operand dtypes, not the model math being counted
@@ -1375,6 +1380,130 @@ def bench_serving_bert_int8(seq_len=64, max_batch=8, repeats=3,
     return stats, _METRIC_NAMES["serving_bert_int8"], "req/sec"
 
 
+def bench_serving_generate(n_req=8, max_tokens=24, repeats=3):
+    """Generation serving row (on-demand,
+    MXTPU_BENCH_MODEL=serving_generate): KV-cache incremental decode
+    (ISSUE 19) at saturation — ``n_req`` greedy requests continuously
+    batched onto the lane table of a small exported causal BERT,
+    stepped until drained.
+
+    The primary value is decode tokens/sec at saturation (best of
+    ``repeats``; warm ladder — compile time is the coldstart row's
+    job).  ``details`` carries p50/p95 TTFT and per-token latency
+    measured at the stream callback (the timestamps an SSE client
+    would see, BASELINE.md token-latency methodology), and the
+    honesty denominator: a naive re-prefill-every-token baseline that
+    generates the same greedy continuation by running a full prefill
+    over the growing sequence for each token — the speedup over that
+    is what the KV cache actually buys."""
+    import tempfile
+
+    from mxtpu import nd
+    from mxtpu.models.transformer import BERTModel
+    from mxtpu.serving import GenerateBatcher, GenerateRunner
+
+    V, LANES, L = 8192, 4, 64
+    prompt_len = 8
+    net = BERTModel(V, 128, 512, 2, 2, max_length=L, dropout=0.0,
+                    use_token_type=False, causal=True)
+    net.initialize(init="xavier")
+    net.hybridize()
+    rng = np.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, V, (2, 3)).astype(np.float32))
+    stepv = nd.array(np.zeros(2, np.float32))
+    kv0 = nd.array(np.zeros(net.kv_cache_spec(2), np.float32))
+    net(tokens, stepv, kv0)                     # incremental trace
+    d = tempfile.mkdtemp(prefix="mxtpu_bench_rec_generate_")
+    sym_file, param_file = net.export(os.path.join(d, "genbert"))
+    runner = GenerateRunner.from_export(
+        sym_file, param_file, net.kv_cache_spec(LANES, L),
+        prompt_buckets=(16, 32), cache=None)
+    t0 = time.perf_counter()
+    runner.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    prompts = [list(rng.randint(1, V, prompt_len).astype(int))
+               for _ in range(n_req)]
+
+    def saturation_run():
+        """All n_req requests through one batcher; the stream
+        callback records TTFT and inter-token gaps per request."""
+        batcher = GenerateBatcher(runner)
+        marks = [[] for _ in prompts]           # perf_counter stamps
+        reqs = []
+        t_submit = time.perf_counter()
+        for i, p in enumerate(prompts):
+            reqs.append(batcher.submit(
+                p, max_tokens=max_tokens,
+                on_token=lambda t, idx, m=marks[i]:
+                    m.append(time.perf_counter())))
+        while not batcher.drain():
+            batcher.step()
+        elapsed = time.perf_counter() - t_submit
+        batcher.close()
+        total = sum(len(r.result(0)) for r in reqs)
+        ttfts = [(m[0] - t_submit) * 1e3 for m in marks if m]
+        gaps = [(b - a) * 1e3 for m in marks
+                for a, b in zip(m, m[1:])]
+        return total / elapsed, ttfts, gaps
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(round(q * (len(vals) - 1))))], 3)
+
+    best, ttfts, gaps = 0.0, [], []
+    runs = []
+    for _ in range(repeats):
+        rate, t, g = saturation_run()
+        runs.append(round(rate, 1))
+        ttfts += t
+        gaps += g
+        best = max(best, rate)
+
+    # naive denominator: the SAME greedy continuation produced by
+    # re-running a full prefill over the growing sequence per token
+    # (what serving without a KV cache degenerates to); single
+    # request — the naive path has no lane table to batch onto.
+    b = runner.batch_rung_for(1)
+    kv = runner.new_cache()
+    seq = list(prompts[0])
+    t0 = time.perf_counter()
+    while len(seq) - prompt_len < max_tokens:
+        s = runner.prompt_bucket_for(len(seq))
+        tok = np.zeros((b, s), np.float32)
+        tok[0, :len(seq)] = seq
+        logits, kv = runner.prefill(
+            tok, np.zeros(b, np.float32),
+            np.full(b, LANES, np.float32), kv)  # scratch slot
+        seq.append(int(np.argmax(logits[0, len(seq) - 1])))
+    naive_rate = max_tokens / (time.perf_counter() - t0)
+
+    stats = {
+        "best": round(best, 1), "median": sorted(runs)[len(runs) // 2],
+        "n": repeats, "spread": round((max(runs) - min(runs))
+                                      / max(runs), 4),
+        "runs": runs,
+        "info": {
+            "hbm_peak": None,   # inference path; no scan program
+            "ttft_ms": {"p50": pct(ttfts, 0.5),
+                        "p95": pct(ttfts, 0.95)},
+            "per_token_ms": {"p50": pct(gaps, 0.5),
+                             "p95": pct(gaps, 0.95)},
+            "naive_reprefill_tok_per_sec": round(naive_rate, 1),
+            "kv_vs_naive": round(best / naive_rate, 2),
+            "lanes": LANES, "n_req": n_req,
+            "max_tokens": max_tokens, "prompt_len": prompt_len,
+            "warmup_seconds": round(warmup_s, 2),
+            "ladder": [list(map(str, bkt))
+                       for bkt in runner.buckets()],
+        },
+    }
+    import shutil
+    shutil.rmtree(d, ignore_errors=True)
+    return stats, _METRIC_NAMES["serving_generate"], "tok/sec"
+
+
 def _mfu(model, value, peak, per_unit=None):
     per_unit = per_unit or _TRAIN_FLOPS.get(model)
     if per_unit is None or peak is None:
@@ -1405,6 +1534,10 @@ _ROW_EST = {"resnet50": 150, "resnet50_pipeline": 120, "bert": 150,
             # 3 arms (f32/bf16/int8) x one bucket compile + timing
             # loops + one calibration pass of a 4-layer BERT
             "serving_bert_int8": 150,
+            # full generate ladder compile (prefill rungs + decode
+            # step) of a 2-layer causal BERT + 3 saturation drains +
+            # the naive re-prefill baseline loop
+            "serving_generate": 150,
             # pairs run the base workload twice (off + on)
             "resnet50_amp": 300, "bert_amp": 300,
             "transformer_amp": 240, "bert_zero_amp": 300}
@@ -1467,6 +1600,7 @@ def main():
              "serving_autoscale": bench_serving_autoscale,
              "serving_coldstart": bench_serving_coldstart,
              "serving_bert_int8": bench_serving_bert_int8,
+             "serving_generate": bench_serving_generate,
              # --amp pairs (on-demand): AMP off vs on side by side
              "resnet50_amp": lambda: bench_amp_pair(
                  "resnet50_amp", bench_resnet50),

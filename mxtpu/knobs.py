@@ -283,6 +283,17 @@ register("MXTPU_SERVING_MAX_QUEUE", 0, "int",
 register("MXTPU_SERVING_DONATE", True, "bool",
          "Donate padded input buffers to the serving executable on "
          "accelerator backends.", "serving")
+register("MXTPU_GEN_MAX_LANES", 8, "int",
+         "KV-cache lanes per GenerateRunner: the continuous-batching "
+         "decode width (one in-flight generation per lane).",
+         "serving")
+register("MXTPU_GEN_MAX_TOKENS", 64, "int",
+         "Default per-request generation cap when submit passes no "
+         "max_tokens.", "serving")
+register("MXTPU_GEN_STREAM", True, "bool",
+         "Stream tokens through the incremental result channel as "
+         "they decode (off = deliver only the final sequence).",
+         "serving")
 
 # -- serving fleet (router / health / retry) ---------------------------
 register("MXTPU_FLEET_LIVENESS_S", 2.0, "float",

@@ -52,9 +52,33 @@ class MultiHeadAttention(HybridBlock):
                                 self._units // self._heads))
         return F.transpose(t, axes=(0, 2, 1, 3))
 
-    def hybrid_forward(self, F, x, memory=None):
+    def hybrid_forward(self, F, x, *args):
         u = self._units
         split = lambda t: self._split_heads(F, t)
+        if len(args) == 2:
+            # incremental decode: (x, step, cache) — x holds the T new
+            # tokens, cache is (2, B, H, L, u/h) [k; v], step (B,) is
+            # each lane's write frontier.  Returns (out, new_cache).
+            step, cache = args
+            qkv = self.qkv(x)
+            q = split(F.slice_axis(qkv, axis=-1, begin=0, end=u))
+            k = split(F.slice_axis(qkv, axis=-1, begin=u, end=2 * u))
+            v = split(F.slice_axis(qkv, axis=-1, begin=2 * u,
+                                   end=3 * u))
+            k_cache = F.squeeze(
+                F.slice_axis(cache, axis=0, begin=0, end=1), axis=0)
+            v_cache = F.squeeze(
+                F.slice_axis(cache, axis=0, begin=1, end=2), axis=0)
+            k_cache = F.kv_cache_write(k_cache, k, step)
+            v_cache = F.kv_cache_write(v_cache, v, step)
+            out = F.cached_attention(q, k_cache, v_cache, step)
+            out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                            shape=(0, -1, u))
+            out = self.proj(out)
+            if self.drop is not None:
+                out = self.drop(out)
+            return out, F.stack(k_cache, v_cache, axis=0)
+        memory = args[0] if args else None
         if memory is None:
             qkv = self.qkv(x)
             q = split(F.slice_axis(qkv, axis=-1, begin=0, end=u))
@@ -113,7 +137,13 @@ class TransformerEncoderCell(HybridBlock):
         self.ln1 = nn.FusedResidualLayerNorm(dropout)
         self.ln2 = nn.FusedResidualLayerNorm(dropout)
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, *args):
+        if args:
+            step, cache = args
+            a, cache = self.attn(x, step, cache)
+            x = self.ln1(a, x)
+            x = self.ln2(self.ffn(x), x)
+            return x, cache
         x = self.ln1(self.attn(x), x)
         x = self.ln2(self.ffn(x), x)
         return x
@@ -135,7 +165,19 @@ class TransformerEncoder(HybridBlock):
                 cell.set_remat(True)
             self.layers.add(cell)
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, *args):
+        if args:
+            # incremental: cache is (num_layers, 2, B, H, L, u/h);
+            # per-layer slices are static (python loop over cells), so
+            # the whole stack still traces into one XLA program
+            step, cache = args
+            outs = []
+            for i, cell in enumerate(self.layers):
+                c = F.squeeze(F.slice_axis(cache, axis=0, begin=i,
+                                           end=i + 1), axis=0)
+                x, c = cell(x, step, c)
+                outs.append(c)
+            return x, F.stack(*outs, axis=0)
         return self.layers(x)
 
 
@@ -159,7 +201,17 @@ class TransformerDecoderCell(HybridBlock):
         self.ln2 = nn.FusedResidualLayerNorm(dropout)
         self.ln3 = nn.FusedResidualLayerNorm(dropout)
 
-    def hybrid_forward(self, F, x, memory):
+    def hybrid_forward(self, F, x, memory, *args):
+        if args:
+            # incremental: only self-attention is cached; cross-attn
+            # keys/values are recomputed from the (fixed) memory each
+            # step — stateless and correct, at a small recompute cost
+            step, cache = args
+            a, cache = self.self_attn(x, step, cache)
+            x = self.ln1(a, x)
+            x = self.ln2(self.cross_attn(x, memory), x)
+            x = self.ln3(self.ffn(x), x)
+            return x, cache
         x = self.ln1(self.self_attn(x), x)
         x = self.ln2(self.cross_attn(x, memory), x)
         x = self.ln3(self.ffn(x), x)
@@ -180,7 +232,16 @@ class TransformerDecoder(HybridBlock):
                 cell.set_remat(True)
             self.layers.add(cell)
 
-    def hybrid_forward(self, F, x, memory):
+    def hybrid_forward(self, F, x, memory, *args):
+        if args:
+            step, cache = args
+            outs = []
+            for i, cell in enumerate(self.layers):
+                c = F.squeeze(F.slice_axis(cache, axis=0, begin=i,
+                                           end=i + 1), axis=0)
+                x, c = cell(x, memory, step, c)
+                outs.append(c)
+            return x, F.stack(*outs, axis=0)
         for cell in self.layers:
             x = cell(x, memory)
         return x
@@ -197,6 +258,9 @@ class TransformerModel(HybridBlock):
                  dropout=0.1, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._max_length = max_length
         self.embed = nn.Embedding(vocab_size, units)
         self.pos_embed = self.params.get(
             "pos_embed", shape=(max_length, units), init="normal")
@@ -224,7 +288,42 @@ class TransformerModel(HybridBlock):
             x = self.drop(x)
         return x
 
-    def hybrid_forward(self, F, src, tgt, pos_embed=None):
+    def _embed_at(self, F, tokens, step, pos_embed, scale):
+        """Embedding + position add for incremental decode: token t of
+        lane b sits at absolute position ``step_b + t``, so positions
+        are *gathered* from the table (``take``) instead of sliced —
+        the dynamic-offset twin of the slice_like trick."""
+        x = self.embed(tokens) if scale is None else \
+            self.embed(tokens) * scale
+        pos = F.slice_like(
+            F.expand_dims(F._arange(start=0, stop=self._max_length),
+                          axis=0), x, axes=(1,))
+        pos = F.broadcast_add(pos, F.expand_dims(step, axis=1))
+        x = x + F.take(pos_embed, pos, axis=0)
+        x = self.embed_ln(x)
+        if self.drop is not None:
+            x = self.drop(x)
+        return x
+
+    def kv_cache_spec(self, batch_size, max_len=None):
+        """Shape of the stacked decoder self-attention KV cache this
+        model consumes/returns in incremental mode."""
+        L = self._max_length if max_len is None else int(max_len)
+        return (self._num_layers, 2, int(batch_size), self._num_heads,
+                L, self._units // self._num_heads)
+
+    def hybrid_forward(self, F, src, tgt, *args, pos_embed=None):
+        if args:
+            # incremental decode: (src, tgt_new, step, cache).  The
+            # encoder runs full on src each call (prefill recomputes
+            # it; the decode path feeds the same bucketed src), the
+            # decoder consumes/returns per-layer KV state.
+            step, cache = args
+            memory = self.encoder(self._embed(F, src, pos_embed))
+            x = self._embed_at(F, tgt, step, pos_embed,
+                               float(np.sqrt(self._units)))
+            dec, cache = self.decoder(x, memory, step, cache)
+            return self.out_proj(dec), cache
         memory = self.encoder(self._embed(F, src, pos_embed))
         dec = self.decoder(self._embed(F, tgt, pos_embed), memory)
         return self.out_proj(dec)
@@ -236,9 +335,13 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size, units, hidden_size, num_layers,
                  num_heads, max_length=512, dropout=0.1,
-                 use_token_type=True, remat=False, **kwargs):
+                 use_token_type=True, causal=False, remat=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._max_length = max_length
         self.word_embed = nn.Embedding(vocab_size, units)
         self.pos_embed = self.params.get(
             "pos_embed", shape=(max_length, units), init="normal")
@@ -246,13 +349,42 @@ class BERTModel(HybridBlock):
             if use_token_type else None
         self.embed_ln = nn.LayerNorm()
         self.embed_drop = nn.Dropout(dropout) if dropout else None
+        # causal=True turns the encoder stack into a decoder-only LM
+        # (GPT-style) — the configuration mxtpu.serving.generate serves
         self.encoder = TransformerEncoder(num_layers, units,
                                           hidden_size, num_heads,
-                                          dropout, remat=remat)
+                                          dropout, causal=causal,
+                                          remat=remat)
         self.mlm = nn.Dense(vocab_size, flatten=False)
 
-    def hybrid_forward(self, F, tokens, token_types=None,
-                       pos_embed=None):
+    def kv_cache_spec(self, batch_size, max_len=None):
+        """Shape of the stacked per-layer KV cache this model
+        consumes/returns in incremental mode:
+        (num_layers, 2, B, num_heads, L, units // num_heads)."""
+        L = self._max_length if max_len is None else int(max_len)
+        return (self._num_layers, 2, int(batch_size), self._num_heads,
+                L, self._units // self._num_heads)
+
+    def hybrid_forward(self, F, tokens, *args, pos_embed=None):
+        if len(args) == 2:
+            # incremental decode: (tokens, step, cache) — tokens are
+            # the T new tokens per lane, positions step_b + t gathered
+            # from the table; token-type embeddings don't apply to the
+            # generation path.  Returns (logits, new_cache).
+            step, cache = args
+            x = self.word_embed(tokens)
+            pos = F.slice_like(
+                F.expand_dims(
+                    F._arange(start=0, stop=self._max_length), axis=0),
+                x, axes=(1,))
+            pos = F.broadcast_add(pos, F.expand_dims(step, axis=1))
+            x = x + F.take(pos_embed, pos, axis=0)
+            x = self.embed_ln(x)
+            if self.embed_drop is not None:
+                x = self.embed_drop(x)
+            x, cache = self.encoder(x, step, cache)
+            return self.mlm(x), cache
+        token_types = args[0] if args else None
         x = self.word_embed(tokens)
         # slice_like (not a static-T slice_axis) keeps the exported
         # graph valid for ANY sequence length <= max_length: the

@@ -26,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..base import MXNetError
@@ -206,6 +207,63 @@ register_op(
             Param("state_outputs", bool, False)],
     num_outputs_fn=_rnn_num_outputs,
     doc=_rnn_impl.__doc__)(_rnn_impl)
+
+
+def _kv_cache_write_op(cache, new, step):
+    """Bucket-paged KV-cache write for incremental decode
+    (mxtpu.serving.generate).  ``cache``: (B, H, L, D) — each batch row
+    is one cache *lane* owned by an in-flight request; ``new``:
+    (B, H, T, D) freshly projected keys or values; ``step``: (B,)
+    per-lane write offsets (each lane advances independently under
+    continuous batching).  Lowers to one ``lax.dynamic_update_slice``
+    per lane via vmap — the signature contracts/generate_decode.json
+    pins.  Values are cast to the cache dtype on write, so a bf16
+    cache under mxtpu.amp stays bf16 regardless of compute dtype."""
+    idx = jnp.asarray(step).astype(jnp.int32)
+
+    def _one(c, n, s):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (0, s, 0))
+    return jax.vmap(_one)(cache, new, idx)
+
+
+register_op("kv_cache_write", num_inputs=3, differentiable=False,
+            doc=_kv_cache_write_op.__doc__)(_kv_cache_write_op)
+
+
+def _cached_attention_op(q, k_cache, v_cache, step, sm_scale=-1.0):
+    """Decode-step attention over a preallocated KV cache.  ``q``:
+    (B, H, T, D) — the T new query tokens of each lane sit at absolute
+    positions ``step_b + t``; ``k_cache``/``v_cache``: (B, H, L, D).
+    Causal masking against valid lengths (key position l attends iff
+    ``l <= step_b + t``), so stale cache contents beyond a lane's
+    frontier — including leftovers from a previous occupant of a
+    reused lane — are unreachable by construction.  Scores, softmax
+    and the probs @ V contraction all accumulate in f32 and only the
+    final output is cast back to the query dtype: the zero-hazard
+    bf16-decode/f32-accum recipe contracts/prec/generate_decode.json
+    pins.  ``sm_scale < 0`` means 1/sqrt(D)."""
+    B, H, T, D = q.shape
+    L = k_cache.shape[2]
+    scale = (1.0 / float(np.sqrt(D))) \
+        if (sm_scale is None or sm_scale < 0) else float(sm_scale)
+    s = jnp.asarray(step).astype(jnp.int32)
+    scores = jnp.einsum("bhtd,bhld->bhtl", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    pos_q = s[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    pos_k = jnp.arange(L, dtype=jnp.int32)
+    mask = pos_k[None, None, :] <= pos_q[:, :, None]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhtl,bhld->bhtd", probs,
+                     v_cache.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+register_op("cached_attention", num_inputs=4, differentiable=False,
+            params=[Param("sm_scale", float, -1.0)],
+            doc=_cached_attention_op.__doc__)(_cached_attention_op)
 
 
 def _flash_attention_op(q, k, v, causal=False, sm_scale=-1.0):

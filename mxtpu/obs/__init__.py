@@ -52,9 +52,10 @@ from .slo import (DEFAULT_RULES, NULL_SLO_ENGINE, AvailabilitySLO,
                   parse_slo_classes)
 from .timeseries import NULL_SAMPLER, Sampler
 from .trace import (SPAN_BACKOFF, SPAN_EXECUTE, SPAN_HEDGE,
-                    SPAN_PAD_SCATTER, SPAN_QUEUE_WAIT, SPAN_REDISPATCH,
-                    SPAN_REQUEUE, SPAN_RUN, SPAN_SCALE, SPAN_SHED,
-                    SPAN_STEAL, SPAN_SUBMIT,
+                    SPAN_PAD_SCATTER, SPAN_PREFILL, SPAN_QUEUE_WAIT,
+                    SPAN_REDISPATCH, SPAN_REPLAY, SPAN_REQUEUE,
+                    SPAN_RUN, SPAN_SCALE, SPAN_SHED, SPAN_STEAL,
+                    SPAN_SUBMIT, SPAN_TOKEN,
                     new_trace_id, span, trace_of)
 
 __all__ = [
@@ -72,6 +73,7 @@ __all__ = [
     "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE", "SPAN_BACKOFF",
     "SPAN_STEAL", "SPAN_REDISPATCH", "SPAN_HEDGE", "SPAN_PAD_SCATTER",
     "SPAN_RUN", "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE",
+    "SPAN_PREFILL", "SPAN_TOKEN", "SPAN_REPLAY",
 ]
 
 _REGISTRY = MetricsRegistry()

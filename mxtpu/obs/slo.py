@@ -157,7 +157,8 @@ class LatencySLO(_SLO):
 
     def __init__(self, name: str, target_s: float,
                  objective: float = 0.95, endpoint: str = "fleet",
-                 percentile: float = 95.0):
+                 percentile: float = 95.0,
+                 metric: str = "mxtpu_serving_latency_seconds"):
         super().__init__(name, objective)
         if target_s <= 0:
             raise MXNetError(
@@ -165,10 +166,15 @@ class LatencySLO(_SLO):
         self.target_s = float(target_s)
         self.endpoint = str(endpoint)
         self.percentile = float(percentile)
+        # which latency histogram to burn against: the default is the
+        # end-to-end request latency; generation endpoints (ISSUE 19)
+        # point this at mxtpu_serving_ttft_seconds or
+        # mxtpu_serving_token_seconds for TTFT / per-token objectives
+        self.metric = str(metric)
 
     def error_ratio(self, sampler,
                     window_s: Optional[float]) -> Optional[float]:
-        d = sampler.hist_delta("mxtpu_serving_latency_seconds",
+        d = sampler.hist_delta(self.metric,
                                {"endpoint": self.endpoint}, window_s)
         if d is None:
             return None
@@ -184,14 +190,14 @@ class LatencySLO(_SLO):
 
     def observed(self, sampler,
                  window_s: Optional[float]) -> Optional[float]:
-        return sampler.quantile("mxtpu_serving_latency_seconds",
+        return sampler.quantile(self.metric,
                                 {"endpoint": self.endpoint},
                                 q=self.percentile, window_s=window_s)
 
     def describe(self) -> Dict[str, Any]:
         d = super().describe()
         d.update(endpoint=self.endpoint, target_s=self.target_s,
-                 percentile=self.percentile)
+                 percentile=self.percentile, metric=self.metric)
         return d
 
 

@@ -28,7 +28,8 @@ __all__ = ["new_trace_id", "span", "trace_of",
            "SPAN_SUBMIT", "SPAN_QUEUE_WAIT", "SPAN_EXECUTE",
            "SPAN_BACKOFF", "SPAN_STEAL", "SPAN_REDISPATCH",
            "SPAN_HEDGE", "SPAN_PAD_SCATTER", "SPAN_RUN",
-           "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE"]
+           "SPAN_REQUEUE", "SPAN_SHED", "SPAN_SCALE",
+           "SPAN_PREFILL", "SPAN_TOKEN", "SPAN_REPLAY"]
 
 # Request-phase span names (the committed vocabulary; tests and the
 # README's reconstruction example key off these).
@@ -46,6 +47,13 @@ SPAN_REQUEUE = "serving/requeue"
 # every shed and scale decision is reconstructable from one dump
 SPAN_SHED = "fleet/shed"
 SPAN_SCALE = "fleet/scale"
+# generation phases (ISSUE 19): prefill (prompt → KV cache + first
+# token), one instant span per emitted token, and the replay marker a
+# stolen generation leaves when it resumes on a surviving worker —
+# trace_of() reconstructs a kill-spanning stream from these
+SPAN_PREFILL = "gen/prefill"
+SPAN_TOKEN = "gen/token"
+SPAN_REPLAY = "gen/replay"
 
 _SEQ = itertools.count(1)
 _SEQ_LOCK = threading.Lock()

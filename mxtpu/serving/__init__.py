@@ -19,6 +19,13 @@ serving layer:
 - :class:`ServingStats` (stats.py): rolling p50/p95/p99, queue depth,
   batch fill-rate, req/sec; Speedometer-style log line; chrome-trace
   spans via ``mxtpu.profiler``.
+- :class:`GenerateRunner` / :class:`GenerateBatcher` (generate.py,
+  ISSUE 19): KV-cache incremental decode — AOT-compiled prefill
+  executables per (batch, prompt-bucket) plus ONE decode-step
+  executable over a preallocated slot-paged KV cache, continuous
+  batching (join/evict at step boundaries), token streaming, and
+  deterministic seeded sampling keyed by absolute position (identical
+  across runs AND across a replay-on-steal).
 - :class:`FleetRouter` / :class:`FleetWorker` (router.py): front-end
   router over N workers — canary health checks driving the
   :class:`WorkerHealth` state machine (health.py), retry with capped
@@ -48,8 +55,11 @@ from .faults import (CorruptEntry, CrashAt, Corrupt, Fault, FaultPlan,
                      Hang, QueueWedge, ReadOnlyDir, SlowExec,
                      SlowStart, SlowStartError, StaleKey,
                      TruncateEntry, WorkerCrashed)
+from .generate import (GenerateBatcher, GenerateRequest,
+                       GenerateRunner, sample_token)
 from .health import WorkerHealth, WorkerState
-from .router import FleetRequest, FleetRouter, FleetWorker
+from .router import (FleetGenerateRequest, FleetRequest, FleetRouter,
+                     FleetWorker)
 from .runner import ModelRunner, batch_ladder
 from .server import InferenceServer
 from .stats import ServingStats
@@ -58,7 +68,10 @@ __all__ = ["ModelRunner", "InferenceServer", "DynamicBatcher",
            "ServingStats", "InferenceRequest", "Batch", "ServerBusy",
            "RequestTimeout", "RetriableError", "WorkerLost",
            "batch_ladder",
+           "GenerateRunner", "GenerateBatcher", "GenerateRequest",
+           "sample_token",
            "FleetRouter", "FleetWorker", "FleetRequest",
+           "FleetGenerateRequest",
            "WorkerHealth", "WorkerState",
            "Autoscaler", "PriorityClass", "parse_classes",
            "Fault", "FaultPlan", "Hang", "SlowStart", "CrashAt",

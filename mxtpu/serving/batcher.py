@@ -71,7 +71,19 @@ class RequestTimeout(RetriableError):
 class WorkerLost(RetriableError):
     """The worker/batcher holding this request died or shut down
     before completing it.  Retriable — the same payload may well
-    succeed on another worker (the fleet router does exactly that)."""
+    succeed on another worker (the fleet router does exactly that).
+
+    ``partial``, when set, carries the partial-generation state of a
+    request that died mid-decode (ISSUE 19): prompt + already-emitted
+    tokens + the ORIGINAL ``t_submit``/``deadline``, so a replay on a
+    surviving worker resumes the stream instead of restarting it, and
+    deadline accounting spans the kill (never double-billed — the
+    replay inherits the first attempt's clock, it does not reset
+    it)."""
+
+    def __init__(self, msg: str = "", partial: Optional[dict] = None):
+        super().__init__(msg)
+        self.partial = partial
 
 
 class InferenceRequest:
@@ -181,6 +193,26 @@ class InferenceRequest:
         if self.t_dequeue is None:
             return None
         return (self.t_dequeue - self.t_submit) * 1e6
+
+
+def _lost_for(req: InferenceRequest,
+              err: BaseException) -> BaseException:
+    """The WorkerLost a dying batcher hands one request: a request
+    that can describe its partial-generation progress
+    (``partial_state()`` — GenerateRequest does) gets a per-request
+    error carrying that state so the fleet layer can replay it
+    without restarting the stream or resetting its deadline clock."""
+    state_fn = getattr(req, "partial_state", None)
+    if state_fn is None:
+        return err
+    try:
+        partial = state_fn()
+    except Exception:  # noqa: BLE001 — a broken state provider must
+        return err     # not mask the loss itself
+    if partial is None:
+        return err
+    return WorkerLost(str(err) or "serving: worker lost mid-"
+                      "generation", partial=partial)
 
 
 class Batch:
@@ -354,11 +386,11 @@ class DynamicBatcher:
                     "serving: deadline expired before the failed "
                     "batch could requeue"), now)
             for r in lost:
-                r._fail(WorkerLost(
+                r._fail(_lost_for(r, WorkerLost(
                     "serving: batch execution failed "
                     + ("again after a requeue"
                        if r.requeues else "and the batcher is "
-                       "closed")), now)
+                       "closed"))), now)
             if requeued:
                 # back to the FRONT: they were the oldest waiters and
                 # FIFO head priority is what bounds tail latency
@@ -446,11 +478,11 @@ class DynamicBatcher:
                 "serving: batcher closed — worker lost before the "
                 "request completed")
             for r in self._queue:
-                r._fail(err, now)
+                r._fail(_lost_for(r, err), now)
             self._queue.clear()
             for r in self._inflight:
                 if not r.done():
-                    r._fail(err, now)
+                    r._fail(_lost_for(r, err), now)
             self._inflight = []
             self._note_depth_locked()
             self._cond.notify_all()

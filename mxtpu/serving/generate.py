@@ -1,0 +1,1150 @@
+# mxlint: hot-path
+"""mxtpu.serving.generate — KV-cache incremental decode with
+continuous batching, token streaming, and replay-on-steal (ISSUE 19
+tentpole).
+
+Three pieces:
+
+- :class:`GenerateRunner` AOT-compiles a *prefill* executable per
+  (batch-rung x prompt-bucket) and ONE incremental *decode-step*
+  executable over a preallocated bucket-paged KV cache.  The cache is
+  a slot table: each in-flight request owns a cache *lane* (axis 2 of
+  the stacked ``(num_layers, 2, slots, heads, L, head_dim)`` array);
+  ``kv_cache_write`` (``lax.dynamic_update_slice`` under vmap) writes
+  each lane at its OWN step index and ``cached_attention`` masks
+  scores to each lane's valid prefix, so stale cache beyond a lane's
+  frontier is unreachable and lane reuse needs no zeroing.  Both
+  executables load-or-compile through the persistent disk cache
+  (ISSUE 13) under generation-specific keys, so a rollout's first
+  token on a warmed worker is never a compile.
+
+- :class:`GenerateRequest` is the streaming future: tokens fire
+  through ``on_token`` as they are sampled, ``result()`` returns the
+  full stream, and ``partial_state()`` describes generation progress
+  so a worker death mid-decode hands the fleet layer everything a
+  replay needs (prompt + already-streamed tokens + the ORIGINAL
+  submit clock — ``WorkerLost.partial``).
+
+- :class:`GenerateBatcher` is the continuous (in-flight) batching
+  policy, pure and clock-injected like :class:`DynamicBatcher`: each
+  ``step(now)`` admits queued requests into freed lanes (join at a
+  step boundary — grouped by prompt bucket, prefilled, first token
+  sampled), runs ONE decode step over all lanes, samples/streams one
+  token per active lane, and evicts finished (EOS / max_tokens /
+  capacity) and deadline-expired requests.  Deterministic in sync
+  mode — fake-clock tests drive it step by step.
+
+Sampling is host-side and replay-deterministic: greedy argmax, or
+top-k seeded by ``(seed, absolute_position)`` — the same token ids
+come out across runs AND across a mid-stream worker steal, because a
+replayed request resumes at the same absolute positions.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import guards
+from .. import knobs
+from .. import obs
+from .. import profiler
+from .batcher import (InferenceRequest, RequestTimeout, ServerBusy,
+                      WorkerLost, _lost_for)
+from .runner import batch_ladder
+
+__all__ = ["GenerateRequest", "GenerateRunner", "GenerateBatcher",
+           "sample_token"]
+
+
+def sample_token(logits, *, position: int, seed: int = 0,
+                 top_k: int = 1) -> int:
+    """Replay-deterministic host-side sampling of ONE token.
+
+    ``top_k <= 1`` is greedy argmax.  Otherwise the top-k logits are
+    softmaxed and drawn with a generator seeded by ``(seed,
+    absolute_position)`` — a pure function of (logits, seed,
+    position), so a replayed generation that re-reaches the same
+    position samples the SAME token regardless of which worker (or
+    which run) computes it."""
+    # mxlint: sync-point — logits are already host rows here
+    row = np.asarray(logits, np.float64).reshape(-1)  # mxlint: disable=dtype-hygiene (f64 host sampling on purpose: platform-identical softmax/ties)
+    if top_k is None or top_k <= 1:
+        return int(np.argmax(row))
+    k = min(int(top_k), row.shape[0])
+    idx = np.argpartition(row, -k)[-k:]
+    # stable descending order: ties break by token id, not partition
+    # order, so the distribution is identical on every platform
+    idx = idx[np.lexsort((idx, -row[idx]))]
+    sub = row[idx] - row[idx].max()
+    p = np.exp(sub)
+    p /= p.sum()
+    rng = np.random.default_rng([int(seed) & 0x7FFFFFFF,
+                                 int(position) & 0x7FFFFFFF])
+    return int(idx[rng.choice(k, p=p)])
+
+
+class GenerateRequest(InferenceRequest):
+    """Streaming generation future.
+
+    ``prompt`` is the token-id list to condition on; ``prefix`` is the
+    already-streamed continuation a REPLAY resumes from (empty for a
+    fresh request) — the worker prefills ``prompt + prefix`` and the
+    first freshly sampled token has stream index ``len(prefix)``.
+    ``on_token(token, index)`` fires per emitted token (the streaming
+    channel); ``result()`` returns the full stream
+    ``prefix + new tokens``.  ``finish_reason`` is "eos" or "length"
+    once complete."""
+
+    __slots__ = ("prompt", "max_tokens", "eos_id", "top_k", "seed",
+                 "prefix", "on_token", "tokens", "finish_reason")
+
+    def __init__(self, prompt: Sequence[int], *,
+                 max_tokens: int, eos_id: Optional[int] = None,
+                 top_k: int = 1, seed: int = 0,
+                 prefix: Sequence[int] = (),
+                 on_token: Optional[Callable[[int, int], None]] = None,
+                 group: Any = None, t_submit: float = 0.0,
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
+        prompt = [int(t) for t in prompt]
+        super().__init__(prompt, group=group, seq_len=len(prompt),
+                         t_submit=t_submit, deadline=deadline,
+                         trace_id=trace_id)
+        self.prompt = prompt
+        self.max_tokens = int(max_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.prefix = [int(t) for t in prefix]
+        self.on_token = on_token
+        # tokens emitted by THIS attempt, appended by the (single)
+        # stepping thread under the batcher's _cond; readers see them
+        # through partial_state() / result() after completion.
+        # mxrace: disable=unguarded-attr (single-writer stepping thread)
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None  # mxrace: disable=unguarded-attr (single-writer stepping thread)
+
+    @property
+    def emitted(self) -> int:
+        """Total stream length so far (replayed prefix included)."""
+        return len(self.prefix) + len(self.tokens)
+
+    def partial_state(self) -> Dict[str, Any]:
+        """What a replay needs (rides ``WorkerLost.partial`` when the
+        worker holding this request dies): the prompt, EVERY token
+        streamed so far (prefix + this attempt), and the ORIGINAL
+        submit clock + deadline — a replay resumes the stream and
+        inherits the first attempt's deadline accounting, it never
+        double-bills."""
+        return {"prompt": list(self.prompt),
+                "tokens": list(self.prefix) + list(self.tokens),
+                "t_submit": self.t_submit,
+                "deadline": self.deadline}
+
+
+class GenerateRunner:
+    """AOT-compiled prefill + decode-step executables over a slot-table
+    KV cache (one device).
+
+    Parameters
+    ----------
+    symbol : mxtpu.symbol.Symbol
+        A 3-input incremental export (``HybridBlock.export`` of a
+        model called in incremental mode): inputs ``(tokens, step,
+        cache)``, outputs ``(logits, new_cache)``.  The cache layout
+        contract is ``(num_layers, 2, B, heads, L, head_dim)`` —
+        exactly what ``TransformerModel.kv_cache_spec`` /
+        ``BERTModel.kv_cache_spec`` describe.
+    params : dict name -> numpy/NDArray
+        Trained weights (uploaded once, shared by every executable).
+    kv_spec : tuple
+        ``net.kv_cache_spec(max_lanes, max_len)`` — axis 2 is the lane
+        count, axis 4 the cache capacity L.  The runner allocates ONE
+        extra scratch slot internally (prefill batch padding scatters
+        there; its contents are garbage by construction and never
+        read), so the device cache has ``max_lanes + 1`` slots.
+    prompt_buckets : ascending ints
+        Prompt-length rungs; prefill compiles per (batch-rung x
+        prompt-bucket).  Prompts (plus replay prefixes) longer than
+        the largest bucket prefill in bucket-width chunks.
+    quant_scales : dict, optional — calibrated activation thresholds
+        (from a :class:`ModelRunner` ``calibrate()`` over the same
+        architecture) arming the int8 trace path; required when
+        ``quant`` resolves on.  Quantized executables key SEPARATELY
+        in the persistent cache (``quant=int8`` key component).
+    """
+
+    def __init__(self, symbol, params: Dict[str, Any],
+                 kv_spec: Sequence[int], *,
+                 prompt_buckets: Sequence[int],
+                 input_names: Sequence[str] = ("data0", "data1",
+                                               "data2"),
+                 device=None, donate: Optional[bool] = None,
+                 cache: Any = "auto", amp=None, quant=None,
+                 quant_scales: Optional[Dict[str, float]] = None):
+        import jax
+
+        from .. import amp as _amp_mod
+        from .. import quant as _quant_mod
+        self._amp = _amp_mod.resolve(amp)
+        self._quant = _quant_mod.resolve(quant)
+        self._quant_scales = dict(quant_scales) if quant_scales else None
+        self._symbol = symbol
+        if len(input_names) != 3:
+            raise MXNetError(
+                "generate: input_names must be the (tokens, step, "
+                "cache) triple of the incremental export")
+        self._input_names = tuple(input_names)
+        kv_spec = tuple(int(d) for d in kv_spec)
+        if len(kv_spec) != 6 or kv_spec[1] != 2:
+            raise MXNetError(
+                "generate: kv_spec must be (num_layers, 2, lanes, "
+                "heads, L, head_dim) — use net.kv_cache_spec()")
+        self.max_lanes = kv_spec[2]
+        if self.max_lanes < 1:
+            raise MXNetError("generate: kv_spec lane count must be >= 1")
+        # one scratch slot past the lanes: prefill batch-padding rows
+        # scatter there (duplicate scratch writes are garbage by
+        # design — the scratch lane is never sampled from)
+        self._slots = self.max_lanes + 1
+        self.scratch_slot = self.max_lanes
+        self._kv_shape = kv_spec[:2] + (self._slots,) + kv_spec[3:]
+        self.max_len = kv_spec[4]
+        self.prompt_buckets = tuple(sorted(int(s)
+                                           for s in prompt_buckets))
+        if not self.prompt_buckets:
+            raise MXNetError("generate: prompt_buckets must be "
+                             "non-empty")
+        if self.prompt_buckets[-1] > self.max_len:
+            raise MXNetError(
+                f"generate: largest prompt bucket "
+                f"{self.prompt_buckets[-1]} exceeds KV capacity "
+                f"{self.max_len}")
+        self.batch_buckets = batch_ladder(self.max_lanes)
+        self._device = device if device is not None else jax.devices()[0]
+        if donate is None:
+            donate = knobs.get("MXTPU_SERVING_DONATE") and \
+                jax.default_backend() != "cpu"  # cpu: donation no-ops
+        self._donate = bool(donate)  # mxlint: disable=host-sync
+
+        # -- one weight upload shared by prefill AND decode ------------
+        known = set(symbol.list_inputs())
+        for n in self._input_names:
+            if n not in known:
+                raise MXNetError(
+                    f"generate: graph has no input {n!r} — pass the "
+                    f"incremental export's input_names")
+        self._param_names = tuple(
+            n for n in params
+            if n in known and n not in self._input_names)
+        missing = known - set(self._param_names) \
+            - set(self._input_names)
+        if missing:
+            raise MXNetError(
+                f"generate: graph inputs {sorted(missing)} have "
+                f"neither a param nor an input name")
+        if self._amp:
+            import jax.numpy as jnp
+            from ..symbol import _is_aux_name
+
+            def _stage(n):
+                v = self._as_np(params[n])
+                if v.dtype == np.float32 and not _is_aux_name(n):
+                    v = v.astype(jnp.bfloat16)
+                return jax.device_put(v, self._device)
+
+            self._param_vals = tuple(_stage(n)
+                                     for n in self._param_names)
+        else:
+            self._param_vals = tuple(
+                jax.device_put(self._as_np(params[n]), self._device)
+                for n in self._param_names)
+        self._sharding = jax.sharding.SingleDeviceSharding(self._device)
+        self._param_structs = tuple(
+            jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                 sharding=self._sharding)
+            for v in self._param_vals)
+
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, Any] = {}  # guarded-by: _lock
+        self.compile_seconds: Dict[Tuple, float] = {}  # guarded-by: _lock
+        # source per built entry ("cold" paid XLA, "disk" loaded off
+        # the persistent cache) — what the zero-cold-compile-on-a-
+        # warmed-worker acceptance test asserts on.
+        self._compile_sources: Dict[Tuple, str] = {}  # guarded-by: _lock
+        self._guards = guards.enabled()
+        self._entry_label = f"GenerateRunner[{type(symbol).__name__}]"
+        self._churn = guards.ChurnDetector(
+            self._entry_label, limit=len(self.buckets()) + 4)
+        self._obs = obs.enabled()
+        self._m_compile = obs.counter(
+            "mxtpu_serving_compile_total",
+            "Bucket executables actually compiled by XLA (cold "
+            "builds only — disk-cache hits count in "
+            "mxtpu_compile_cache_hit_total instead).",
+            labels=("entry",)).labels(entry=self._entry_label)
+        _h = obs.histogram(
+            "mxtpu_serving_compile_seconds",
+            "Per-bucket entry build wall time (source=cold: XLA "
+            "compile; source=disk: verified load from the persistent "
+            "cache).", labels=("entry", "source"))
+        self._m_compile_s = {
+            src: _h.labels(entry=self._entry_label, source=src)
+            for src in ("cold", "disk")}
+        self._m_cache_hit = obs.counter(
+            "mxtpu_compile_cache_hit_total",
+            "In-process compile-cache misses served from the "
+            "persistent disk cache instead of XLA.",
+            labels=("entry",)).labels(entry=self._entry_label)
+
+        from .. import cache as cache_mod
+        self._cache = cache_mod.default_cache() if cache == "auto" \
+            else cache
+        self._fingerprint = ""
+        if self._cache is not None:
+            self._fingerprint = self._model_fingerprint()
+
+    @staticmethod
+    def _as_np(v):
+        # mxlint: sync-point — host-side param ingest, pre-upload
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    @classmethod
+    def from_export(cls, symbol_file: str, params_file: str,
+                    kv_spec: Sequence[int], **kwargs
+                    ) -> "GenerateRunner":
+        """Load the incremental export's ``-symbol.json`` +
+        ``-NNNN.params`` artifacts through the c_predict binding
+        path."""
+        from .. import symbol as sym_mod
+        from ..c_predict import _params_from_bytes
+        with open(symbol_file) as f:
+            symbol = sym_mod.load_json(f.read())
+        with open(params_file, "rb") as f:
+            params = _params_from_bytes(f.read())
+        return cls(symbol, params, kv_spec, **kwargs)
+
+    # -- buckets ---------------------------------------------------------
+    def prompt_bucket_for(self, need: int) -> int:
+        """Smallest prompt bucket covering ``need`` tokens — capped at
+        the largest bucket (longer prefills chunk at that width)."""
+        if need < 1:
+            raise MXNetError("generate: empty prompt")
+        for s in self.prompt_buckets:
+            if s >= need:
+                return s
+        return self.prompt_buckets[-1]
+
+    def batch_rung_for(self, n: int) -> int:
+        if n < 1 or n > self.max_lanes:
+            raise MXNetError(
+                f"generate: prefill batch {n} outside 1..{self.max_lanes}")
+        return next(r for r in self.batch_buckets if r >= n)
+
+    def buckets(self) -> List[Tuple]:
+        """Full executable ladder: every (prefill, (batch, prompt))
+        rung plus THE decode step — what ``warmup()`` compiles."""
+        out: List[Tuple] = [("prefill", (b, s))
+                            for s in self.prompt_buckets
+                            for b in self.batch_buckets]
+        out.append(("decode", (self._slots,)))
+        return out
+
+    # -- persistent cache keys (ISSUE 13) --------------------------------
+    def _model_fingerprint(self) -> str:
+        """sha256 over everything that shapes the compiled programs
+        except the bucket: graph json (op names canonicalized), input
+        names, KV layout, donation, amp/quant arming.  Weight VALUES
+        are runtime arguments — one entry warms every checkpoint of
+        the architecture."""
+        import hashlib
+        import json as _json
+        graph = _json.loads(self._symbol.tojson())
+        for i, node in enumerate(graph.get("nodes", ())):
+            if node.get("op") not in (None, "null"):
+                node["name"] = f"_op{i}"
+        fp = {
+            "symbol": graph,
+            "gen_inputs": list(self._input_names),
+            "kv_shape": list(self._kv_shape),
+            "params": [[n, list(v.shape), str(v.dtype)]
+                       for n, v in zip(self._param_names,
+                                       self._param_vals)],
+            "donate": self._donate,
+        }
+        if self._amp:
+            fp["amp"] = True
+        if self._quant:
+            fp["quant"] = sorted(
+                (self._quant_scales or {}).items()) or True
+        blob = _json.dumps(fp, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+    def _cache_key(self, bucket: Tuple):
+        """Persistent-cache key of one generation executable:
+        fingerprint x ``gen:<kind>:<shape>`` x device — the ``gen:``
+        prefix keys decode-step programs apart from any batch-path
+        entry of the same graph, and ``quant=int8`` keys int8 decode
+        apart from the float path (never loadable cross-mode)."""
+        kind, shp = bucket
+        extra = {}
+        if self._quant:
+            extra["quant"] = "int8"
+        return self._cache.key(
+            model=self._fingerprint,
+            shape=f"gen:{kind}:{tuple(shp)}", mesh="1dev",
+            device=getattr(self._device, "device_kind", "unknown"),
+            **extra)
+
+    def cached_buckets(self) -> List[Tuple]:
+        """Subset of the ladder present in the persistent cache right
+        now (existence probe; loads verify later)."""
+        if self._cache is None:
+            return []
+        return [b for b in self.buckets()
+                if self._cache.contains(self._cache_key(b))]
+
+    def warm_from_disk(self) -> Dict[Tuple, float]:
+        """Warm every ladder entry the persistent cache holds —
+        zero cold compiles on a warmed worker (asserted by test via
+        :meth:`compile_sources`)."""
+        hits = self.cached_buckets()
+        if not hits:
+            return {}
+        return self.warmup(hits)
+
+    def compile_sources(self) -> Dict[Tuple, str]:
+        """Per built entry: "cold" (paid XLA) or "disk" (loaded off
+        the persistent cache)."""
+        with self._lock:
+            return dict(self._compile_sources)
+
+    def cold_compiles(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._compile_sources.values()
+                       if s == "cold")
+
+    # -- pure (traceable) programs ---------------------------------------
+    def _scopes(self):
+        import contextlib
+        from .. import amp as _amp_mod
+        from .. import quant as _quant_mod
+        if self._quant and self._quant_scales is None:
+            raise MXNetError(
+                "generate: quantized runner has no calibrated scales "
+                "— pass quant_scales (from a ModelRunner.calibrate "
+                "over the same architecture)")
+        scope = contextlib.ExitStack()
+        if self._quant:
+            scope.enter_context(
+                _quant_mod.quantize(self._quant_scales))
+        if self._amp:
+            scope.enter_context(_amp_mod.autocast())
+        return scope
+
+    def _eval_incremental(self, tokens, step, kv_small, param_vals):
+        """Trace the incremental graph once: (tokens, step, small
+        cache) -> (logits, new small cache), inference mode."""
+        import jax.numpy as jnp
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+        from ..symbol import _eval_symbol
+        if self._amp:
+            param_vals = tuple(
+                v.astype(jnp.float32)
+                if (jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.dtype != jnp.float32)
+                else v for v in param_vals)
+        bindings = {self._input_names[0]: NDArray(tokens, None,
+                                                  _placed=True),
+                    self._input_names[1]: NDArray(step, None,
+                                                  _placed=True),
+                    self._input_names[2]: NDArray(kv_small, None,
+                                                  _placed=True)}
+        for n, v in zip(self._param_names, param_vals):
+            bindings[n] = NDArray(v, None, _placed=True)
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(False)
+        try:
+            with self._scopes():
+                outs = _eval_symbol(self._symbol, bindings)
+        finally:
+            autograd.set_training(prev_train)
+            autograd.set_recording(prev_rec)
+        if len(outs) != 2:
+            raise MXNetError(
+                f"generate: incremental graph must output (logits, "
+                f"cache), got {len(outs)} outputs")
+        return outs[0].data, outs[1].data
+
+    def _prefill_pure(self):
+        """(tokens (b,s), step (b,), lane_idx (b,), kv_big, params) ->
+        (logits (b,s,V), kv_big').  Gather-extend-scatter: each row's
+        lane is pulled from the slot table, extended by its s tokens
+        at its own step offset, and written back — so chunked prefill
+        of a long prompt+prefix is just repeated calls at advancing
+        step offsets.  Padding rows target the scratch slot."""
+        import jax.numpy as jnp
+
+        def fn(tokens, step, lane_idx, kv_big, param_vals):
+            idx = lane_idx.astype(jnp.int32)
+            kv_small = kv_big[:, :, idx]
+            logits, new_small = self._eval_incremental(
+                tokens, step, kv_small, param_vals)
+            kv_big = kv_big.at[:, :, idx].set(
+                new_small.astype(kv_big.dtype))
+            return logits, kv_big
+
+        return fn
+
+    def _decode_pure(self):
+        """(tokens (slots,1), step (slots,), kv_big, params) ->
+        (logits (slots,1,V), kv_big') — THE decode step: every slot
+        advances one position; inactive slots compute ignored rows
+        (masked attention keeps them finite)."""
+        def fn(tokens, step, kv_big, param_vals):
+            return self._eval_incremental(tokens, step, kv_big,
+                                          param_vals)
+
+        return fn
+
+    def _structs(self, bucket: Tuple):
+        import jax
+        f32 = np.float32
+        kind, shp = bucket
+
+        def sds(shape):
+            return jax.ShapeDtypeStruct(tuple(shape), f32,
+                                        sharding=self._sharding)
+
+        kv = sds(self._kv_shape)
+        if kind == "prefill":
+            b, s = shp
+            return (sds((b, s)), sds((b,)), sds((b,)), kv)
+        if kind == "decode":
+            (slots,) = shp
+            return (sds((slots, 1)), sds((slots,)), kv)
+        raise MXNetError(f"generate: unknown executable kind {kind!r}")
+
+    def _entry(self, bucket: Tuple):
+        """Load-or-compile one generation executable (exactly once,
+        under ``_lock``) through the persistent cache — same contract
+        as ``ModelRunner._entry``."""
+        bucket = (bucket[0], tuple(bucket[1]))
+        with self._lock:
+            entry = self._entries.get(bucket)
+            if entry is not None:
+                return entry
+            import jax
+            if self._guards:
+                self._churn.note_compile(bucket)
+            kind = bucket[0]
+            in_structs = self._structs(bucket)
+            # the KV slot table is the LAST data operand — donated on
+            # accelerator backends so every step recycles it in place
+            kv_argnum = len(in_structs) - 1
+            t0 = time.perf_counter()
+            from mxtpu import analysis
+            compiled, source, ckey, cmeta = None, "cold", None, {}
+            if self._cache is not None:
+                ckey = self._cache_key(bucket)
+                compiled, cmeta = self._cache.load(ckey, with_meta=True)  # mxlint: sync-point — disk, pre-serving
+                if compiled is not None:
+                    source = "disk"
+            if compiled is None:
+                fn = self._prefill_pure() if kind == "prefill" \
+                    else self._decode_pure()
+                with profiler.Task(f"generate:compile:{kind}"
+                                   f"{bucket[1]}"):
+                    jitted = jax.jit(
+                        fn, donate_argnums=(kv_argnum,)
+                        if self._donate else ())
+                    compiled = jitted.lower(
+                        *in_structs, self._param_structs).compile()
+                analysis.maybe_audit(compiled,
+                                     label=f"GenerateRunner{bucket}")
+                if ckey is not None:
+                    self._cache.store(ckey, compiled,
+                                      meta=analysis.audit_stamp())
+            elif analysis.needs_reaudit(cmeta):
+                analysis.maybe_audit(compiled,
+                                     label=f"GenerateRunner{bucket}")
+            self.compile_seconds[bucket] = time.perf_counter() - t0
+            entry = {"compiled": compiled, "in_structs": in_structs}
+            self._entries[bucket] = entry
+            self._compile_sources[bucket] = source
+            if self._obs:
+                if source == "cold":
+                    self._m_compile.inc()
+                else:
+                    self._m_cache_hit.inc()
+                self._m_compile_s[source].observe(
+                    self.compile_seconds[bucket])
+                obs.flight("compile").record(
+                    "compile_miss", entry=self._entry_label,
+                    bucket=str(bucket), source=source,
+                    seconds=round(self.compile_seconds[bucket], 4))
+            return entry
+
+    def warmup(self, buckets: Optional[Sequence[Tuple]] = None
+               ) -> Dict[Tuple, float]:
+        """Pre-build the ladder (or a subset) so no token pays a
+        compile; returns per-entry build seconds."""
+        with guards.no_implicit_transfers(self._guards):
+            for bucket in (buckets if buckets is not None
+                           else self.buckets()):
+                self._entry(bucket)
+        with self._lock:
+            return dict(self.compile_seconds)
+
+    # -- execution --------------------------------------------------------
+    def new_cache(self):
+        """Fresh zeroed KV slot table on this runner's device."""
+        import jax
+        return jax.device_put(
+            np.zeros(self._kv_shape, np.float32), self._device)
+
+    def prefill(self, tokens: np.ndarray, step: np.ndarray,
+                lane_idx: np.ndarray, kv) -> Tuple[np.ndarray, Any]:
+        """One prefill dispatch on already-bucketed host arrays:
+        ``tokens (b, s)`` / ``step (b,)`` / ``lane_idx (b,)`` must
+        match a ladder rung exactly (the batcher pads).  Returns
+        (host logits (b, s, V), new device KV table) — the passed
+        table is consumed (donated on accelerator backends)."""
+        import jax
+        b, s = tokens.shape
+        entry = self._entry(("prefill", (b, s)))
+        tok = jax.device_put(np.asarray(tokens, np.float32),  # mxlint: sync-point — staging host rows for device_put
+                             self._device)
+        stp = jax.device_put(np.asarray(step, np.float32),  # mxlint: sync-point — staging host rows for device_put
+                             self._device)
+        idx = jax.device_put(np.asarray(lane_idx, np.float32),  # mxlint: sync-point — staging host rows for device_put
+                             self._device)
+        if self._guards:
+            self._churn.note_call()
+        with guards.no_implicit_transfers(self._guards):
+            logits, kv = entry["compiled"](tok, stp, idx, kv,
+                                           self._param_vals)
+        # mxlint: sync-point — deliberate D2H: the batcher samples on host
+        return np.asarray(logits), kv
+
+    def decode(self, tokens: np.ndarray, step: np.ndarray, kv
+               ) -> Tuple[np.ndarray, Any]:
+        """THE decode step: ``tokens (slots, 1)`` / ``step (slots,)``
+        advance every slot one position.  Returns (host logits
+        (slots, 1, V), new device KV table)."""
+        import jax
+        entry = self._entry(("decode", (self._slots,)))
+        tok = jax.device_put(np.asarray(tokens, np.float32),  # mxlint: sync-point — staging host rows for device_put
+                             self._device)
+        stp = jax.device_put(np.asarray(step, np.float32),  # mxlint: sync-point — staging host rows for device_put
+                             self._device)
+        if self._guards:
+            self._churn.note_call()
+        with guards.no_implicit_transfers(self._guards):
+            logits, kv = entry["compiled"](tok, stp, kv,
+                                           self._param_vals)
+        # mxlint: sync-point — deliberate D2H: the batcher samples on host
+        return np.asarray(logits), kv
+
+    # -- introspection / contracts ----------------------------------------
+    def default_bucket(self, kind: str = "decode") -> Tuple:
+        if kind == "decode":
+            return ("decode", (self._slots,))
+        return ("prefill", (self.batch_buckets[-1],
+                            self.prompt_buckets[-1]))
+
+    def program_artifact(self, bucket: Optional[Tuple] = None):
+        """``(hlo_text, mem_stats)`` of one executable (decode step by
+        default) — what tools/hlocheck pins the ``generate_decode``
+        contract on."""
+        from mxtpu import analysis
+        if bucket is None:
+            bucket = self.default_bucket()
+        compiled = self._entry(bucket)["compiled"]
+        return compiled.as_text(), analysis.mem_stats(compiled)
+
+    def program_summary(self, bucket: Optional[Tuple] = None):
+        from mxtpu import analysis
+        text, mem = self.program_artifact(bucket)
+        return analysis.summarize(text, mem)
+
+    def lowered_program_text(self, bucket: Optional[Tuple] = None
+                             ) -> str:
+        """PRE-optimization HLO of one generation program (lowers
+        only, never compiles) — mxprec's ledger substrate."""
+        from mxtpu import analysis
+        if bucket is None:
+            bucket = self.default_bucket()
+        bucket = (bucket[0], tuple(bucket[1]))
+        fn = self._prefill_pure() if bucket[0] == "prefill" \
+            else self._decode_pure()
+        return analysis.lowered_text(fn, *self._structs(bucket),
+                                     self._param_structs)
+
+    def num_compiled(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- fleet handoff -----------------------------------------------------
+    def ladder_metadata(self) -> Dict[str, Any]:
+        """What a draining worker hands its replacement: which
+        generation executables were actually built and what each
+        cost."""
+        with self._lock:
+            compiled = sorted(self._entries)
+            secs = dict(self.compile_seconds)
+        return {"max_lanes": self.max_lanes,
+                "prompt_buckets": list(self.prompt_buckets),
+                "compiled_buckets": [[k, list(s)] for k, s in compiled],
+                "compile_seconds": {str(k): v for k, v in secs.items()},
+                "weight_bytes": self.weight_bytes()}
+
+    def warm_from(self, metadata: Dict[str, Any]) -> Dict[Tuple, float]:
+        """Warm this (replacement) runner from a donor's
+        :meth:`ladder_metadata`, restricted to this runner's own
+        ladder."""
+        own = set(self.buckets())
+        donor = [(k, tuple(s))
+                 for k, s in metadata.get("compiled_buckets", [])]
+        return self.warmup([b for b in donor if b in own])
+
+    def weight_buffers(self) -> Tuple:
+        return self._param_vals
+
+    def weight_bytes(self) -> int:
+        return int(sum(v.nbytes for v in self._param_vals))
+
+
+class _Lane:
+    """One in-flight generation: the lane's cache frontier (tokens
+    written so far) and the last sampled token (next decode input)."""
+
+    __slots__ = ("req", "frontier", "last_token", "t_last")
+
+    def __init__(self, req: GenerateRequest, frontier: int,
+                 last_token: int, t_last: float):
+        self.req = req
+        self.frontier = frontier
+        self.last_token = last_token
+        self.t_last = t_last
+
+
+class GenerateBatcher:
+    """Continuous (in-flight) batching over a :class:`GenerateRunner`.
+
+    Pure, clock-injected policy: ``submit()`` enqueues, ``step(now)``
+    advances the whole slot table one decode step — admitting queued
+    requests into freed lanes at the step boundary first (prompt-
+    bucket-grouped prefill, first token sampled from the last valid
+    prompt position), then ONE decode dispatch over all slots, then
+    per-lane sampling, streaming, and eviction (EOS / max_tokens /
+    KV capacity / deadline).  No wall time, no threads — fake-clock
+    tests drive it deterministically; the server wraps it in a
+    stepping thread.
+
+    Lock order: ``_step_lock`` (one stepper at a time) -> ``_cond``
+    (queue + lane table); executions run OUTSIDE ``_cond`` so submit
+    never blocks on the device."""
+
+    def __init__(self, runner: GenerateRunner, *,
+                 max_queue: Optional[int] = None,
+                 max_lanes: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 stats=None,
+                 default_max_tokens: Optional[int] = None,
+                 stream: Optional[bool] = None,
+                 on_timeout: Optional[Callable[[int], None]] = None):
+        self.runner = runner
+        # operational width cap (MXTPU_GEN_MAX_LANES): the runner's
+        # KV table is sized at export time; this narrows how many of
+        # its lanes continuous batching may occupy at once without
+        # re-exporting (the decode executable still spans all slots)
+        self.max_lanes = max(1, min(
+            runner.max_lanes,
+            int(max_lanes if max_lanes is not None
+                else knobs.get("MXTPU_GEN_MAX_LANES"))))
+        self.max_queue = int(max_queue) if max_queue is not None \
+            else 8 * runner.max_lanes
+        self._clock = clock
+        self._stats = stats
+        self.default_max_tokens = int(
+            default_max_tokens if default_max_tokens is not None
+            else knobs.get("MXTPU_GEN_MAX_TOKENS"))
+        self.stream = bool(knobs.get("MXTPU_GEN_STREAM")  # mxlint: disable=host-sync (knob bool, no device data)
+                           if stream is None else stream)
+        self._on_timeout = on_timeout
+        self._step_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue: List[GenerateRequest] = []  # guarded-by: _cond
+        # guarded-by: _cond
+        self._lanes: List[Optional[_Lane]] = [None] * self.max_lanes
+        self._closed = False  # guarded-by: _cond
+        self._joins = 0       # guarded-by: _cond — lifetime lane claims
+        self._steps = 0       # guarded-by: _cond — decode steps run
+        # the slot table lives here; only the stepping thread touches
+        # it (single stepper enforced by _step_lock)
+        self._kv = None  # guarded-by: _step_lock
+
+    # -- submit side ------------------------------------------------------
+    def submit(self, prompt: Sequence[int], *,
+               max_tokens: Optional[int] = None,
+               eos_id: Optional[int] = None, top_k: int = 1,
+               seed: int = 0, prefix: Sequence[int] = (),
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               on_token: Optional[Callable[[int, int], None]] = None
+               ) -> GenerateRequest:
+        """Enqueue one generation; it joins the running decode batch
+        at the next step boundary with a free lane.  ``prefix`` seeds
+        a replay (already-streamed tokens — prefilled, not re-emitted).
+        Raises :class:`ServerBusy` when the bounded queue is full."""
+        now = self._clock()
+        prompt = [int(t) for t in prompt]
+        prefix = [int(t) for t in prefix]
+        if not prompt:
+            raise MXNetError("generate: empty prompt")
+        need = len(prompt) + len(prefix)
+        if need >= self.runner.max_len:
+            raise MXNetError(
+                f"generate: prompt+prefix ({need}) fills the KV "
+                f"capacity ({self.runner.max_len}) — nothing left to "
+                f"generate")
+        mt = int(max_tokens if max_tokens is not None
+                 else self.default_max_tokens)
+        if mt <= len(prefix):
+            raise MXNetError(
+                f"generate: max_tokens {mt} already exhausted by the "
+                f"replayed prefix ({len(prefix)} tokens)")
+        req = GenerateRequest(
+            prompt, max_tokens=mt, eos_id=eos_id, top_k=top_k,
+            seed=seed, prefix=prefix, on_token=on_token,
+            group=self.runner.prompt_bucket_for(need), t_submit=now,
+            deadline=None if timeout_s is None else now + timeout_s,
+            trace_id=trace_id)
+        with self._cond:
+            if self._closed:
+                raise WorkerLost(
+                    "generate: batcher is closed (worker shut down "
+                    "or lost) — resubmit elsewhere")
+            if len(self._queue) >= self.max_queue:
+                raise ServerBusy(
+                    f"generate: queue full ({self.max_queue} "
+                    f"waiting); retry with backoff")
+            self._queue.append(req)
+            self._cond.notify()
+        return req
+
+    # -- accounting (what the router's admission control reads) ----------
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def free_lanes(self) -> int:
+        with self._cond:
+            return sum(1 for l in self._lanes if l is None)
+
+    def active(self) -> Dict[int, GenerateRequest]:
+        """Lane table snapshot: {lane index: request} — the lane-
+        accounting surface the join-at-step-boundary tests assert
+        on."""
+        with self._cond:
+            return {i: l.req for i, l in enumerate(self._lanes)
+                    if l is not None}
+
+    @property
+    def joins(self) -> int:
+        """Lifetime lane claims (a request joining the running batch
+        bumps this exactly once)."""
+        with self._cond:
+            return self._joins
+
+    @property
+    def steps(self) -> int:
+        with self._cond:
+            return self._steps
+
+    def oldest_waiting_age(self, now: Optional[float] = None
+                           ) -> Optional[float]:
+        with self._cond:
+            if not self._queue:
+                return None
+            return (self._clock() if now is None else now) \
+                - self._queue[0].t_submit
+
+    # -- the step ---------------------------------------------------------
+    def step(self, now: Optional[float] = None) -> Dict[str, int]:
+        """Advance the whole batch one decode step; returns counters
+        ``{"admitted", "active", "emitted", "finished"}``.  The join
+        point for queued requests AND the eviction point for finished/
+        expired ones — continuous batching is exactly this loop."""
+        with self._step_lock:
+            now = self._clock() if now is None else now
+            # (req, token, stream index, is_first, seconds since the
+            # request's previous emission) — fired outside all locks
+            emissions: List[Tuple[GenerateRequest, int, int, bool,
+                                  float]] = []
+            finished: List[GenerateRequest] = []
+            # (req, final value): resolved AFTER _fire so the future's
+            # done-callbacks (the fleet watcher) observe a fully
+            # delivered stream — completing first would let a watcher
+            # snapshot the ledger one token short of the final emission
+            completions: List[Tuple[GenerateRequest, List[int]]] = []
+            with self._cond:
+                if self._closed:
+                    return {"admitted": 0, "active": 0, "emitted": 0,
+                            "finished": 0}
+                self._expire_queued_locked(now)
+                self._evict_deadlines_locked(now, finished)
+                admitted = self._admit_locked(now)
+            if admitted:
+                self._prefill_locked(admitted, now, emissions, finished,
+                              completions)
+            with self._cond:
+                active = [(i, l) for i, l in enumerate(self._lanes)
+                          if l is not None]
+            n_active = len(active)
+            if active:
+                self._decode_locked(active, now, emissions, finished,
+                             completions)
+            self._fire(emissions, now)
+            for r, value in completions:
+                r._complete(value, now)
+            return {"admitted": len(admitted), "active": n_active,
+                    "emitted": len(emissions),
+                    "finished": len(finished)}
+
+    def _finish_reason(self, r: GenerateRequest, lane: _Lane
+                       ) -> Optional[str]:
+        """Evaluated right after each emission: EOS terminates the
+        stream; ``max_tokens`` and KV capacity (no room left to write
+        the token just emitted, so it cannot be extended) finish as
+        "length"."""
+        if r.eos_id is not None and lane.last_token == r.eos_id:
+            return "eos"
+        if r.emitted >= r.max_tokens:
+            return "length"
+        if lane.frontier >= self.runner.max_len:
+            return "length"
+        return None
+
+    def _expire_queued_locked(self, now: float) -> None:
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        if not expired:
+            return
+        self._queue = [r for r in self._queue if r not in expired]
+        if self._on_timeout is not None:
+            self._on_timeout(len(expired))
+        for r in expired:
+            r._fail(RequestTimeout(
+                "generate: deadline expired while queued"), now)
+
+    def _evict_deadlines_locked(self, now: float,
+                                finished: List[GenerateRequest]
+                                ) -> None:
+        """Mid-decode deadline eviction: an expired lane frees at the
+        step boundary — its caller gets RequestTimeout, never a late
+        stream."""
+        n_evicted = 0
+        for i, lane in enumerate(self._lanes):
+            if lane is None:
+                continue
+            r = lane.req
+            if r.deadline is not None and now > r.deadline:
+                self._lanes[i] = None
+                n_evicted += 1
+                r._fail(RequestTimeout(
+                    f"generate: deadline expired mid-decode after "
+                    f"{r.emitted} tokens"), now)
+                finished.append(r)
+        if n_evicted and self._on_timeout is not None:
+            self._on_timeout(n_evicted)
+
+    def _admit_locked(self, now: float
+                      ) -> List[Tuple[int, GenerateRequest]]:
+        """Claim freed lanes for the oldest queued requests — one
+        prompt-bucket group per step (FIFO head priority, same rule as
+        DynamicBatcher)."""
+        free = [i for i, l in enumerate(self._lanes) if l is None]
+        if not free or not self._queue:
+            return []
+        head = self._queue[0]
+        take = [r for r in self._queue
+                if r.group == head.group][:len(free)]
+        taken = set(map(id, take))
+        self._queue = [r for r in self._queue if id(r) not in taken]
+        pairs = []
+        for r in take:
+            lane = free.pop(0)
+            r.t_dequeue = now
+            self._joins += 1
+            pairs.append((lane, r))
+        return pairs
+
+    def _prefill_locked(self, pairs: List[Tuple[int, GenerateRequest]],
+                 now: float, emissions, finished,
+                 completions) -> None:
+        """Prefill the joiners' prompts (+ replay prefixes) into their
+        claimed lanes and sample each one's first token.  Prompts
+        longer than the bucket chunk at bucket width; batch padding
+        rows target the scratch slot.  Device dispatches run outside
+        ``_cond``; the lane-table commit reacquires it."""
+        runner = self.runner
+        if self._kv is None:
+            self._kv = runner.new_cache()
+        s = pairs[0][1].group
+        b = runner.batch_rung_for(len(pairs))
+        full = [r.prompt + r.prefix for _, r in pairs]
+        need = [len(f) for f in full]
+        chunks = max(1, math.ceil(max(need) / s))
+        first_logits: List[Optional[np.ndarray]] = [None] * len(pairs)
+        t0 = now * 1e6
+        for c in range(chunks):
+            base = c * s
+            tokens = np.zeros((b, s), np.float32)
+            step = np.zeros((b,), np.float32)
+            lidx = np.full((b,), runner.scratch_slot, np.float32)
+            for row, (lane, r) in enumerate(pairs):
+                if base >= need[row]:
+                    continue  # this row finished in an earlier chunk
+                valid = min(s, need[row] - base)
+                tokens[row, :valid] = full[row][base:base + valid]
+                step[row] = base
+                lidx[row] = lane
+            logits, self._kv = runner.prefill(tokens, step, lidx,
+                                              self._kv)
+            for row in range(len(pairs)):
+                last = need[row] - 1
+                if base <= last < base + s:
+                    first_logits[row] = logits[row, last - base]
+        with self._cond:
+            if self._closed:
+                # the batcher died between admit and commit: these
+                # joiners were already off the queue, so close()
+                # could not see them — fail them here, with partial
+                # state (nothing emitted yet) for replay
+                err = WorkerLost("generate: batcher closed during "
+                                 "prefill")
+                for _, r in pairs:
+                    if not r.done():
+                        r._fail(_lost_for(r, err), now)
+                        finished.append(r)
+                return
+            for row, (lane, r) in enumerate(pairs):
+                pos = need[row]  # absolute position of the 1st new token
+                tok = sample_token(first_logits[row], position=pos,
+                                   seed=r.seed, top_k=r.top_k)
+                ln = _Lane(r, frontier=need[row], last_token=tok,
+                           t_last=now)
+                r.tokens.append(tok)
+                emissions.append((r, tok, len(r.prefix), True,
+                                  now - r.t_submit))
+                reason = self._finish_reason(r, ln)
+                if reason is not None:
+                    r.finish_reason = reason
+                    completions.append(
+                        (r, list(r.prefix) + list(r.tokens)))
+                    finished.append(r)
+                else:
+                    self._lanes[lane] = ln
+                if r.trace_id is not None and profiler.is_active():
+                    obs.span(obs.SPAN_PREFILL, t0, now * 1e6 - t0,
+                             trace_id=r.trace_id, cat="gen",
+                             lane=lane, prompt=len(r.prompt),
+                             prefix=len(r.prefix))
+            self._cond.notify_all()
+
+    def _decode_locked(self, active: List[Tuple[int, _Lane]], now: float,
+                emissions, finished, completions) -> None:
+        """ONE decode dispatch over the whole slot table (each lane's
+        last token written at its own frontier), then per-lane
+        sampling, finish evaluation, and lane release."""
+        runner = self.runner
+        slots = runner.max_lanes + 1
+        tokens = np.zeros((slots, 1), np.float32)
+        steps = np.zeros((slots,), np.float32)
+        for i, lane in active:
+            tokens[i, 0] = lane.last_token
+            steps[i] = lane.frontier
+        logits, self._kv = runner.decode(tokens, steps, self._kv)
+        done: List[Tuple[int, _Lane, str]] = []
+        for i, lane in active:
+            r = lane.req
+            lane.frontier += 1   # last_token is now in the cache
+            dt = now - lane.t_last
+            pos = lane.frontier  # absolute position of the new token
+            tok = sample_token(logits[i, 0], position=pos,
+                               seed=r.seed, top_k=r.top_k)
+            lane.last_token = tok
+            lane.t_last = now
+            r.tokens.append(tok)
+            emissions.append((r, tok, r.emitted - 1, False, dt))
+            reason = self._finish_reason(r, lane)
+            if reason is not None:
+                done.append((i, lane, reason))
+        with self._cond:
+            self._steps += 1
+            for i, lane, reason in done:
+                if self._lanes[i] is lane:
+                    self._lanes[i] = None
+                r = lane.req
+                r.finish_reason = reason
+                completions.append(
+                    (r, list(r.prefix) + list(r.tokens)))
+                finished.append(r)
+            self._cond.notify_all()
+
+    def _fire(self, emissions, now: float) -> None:
+        """Stream callbacks + per-token stats/spans, OUTSIDE every
+        lock (on_token is arbitrary user code)."""
+        stats = self._stats
+        active = profiler.is_active()
+        for r, tok, index, is_first, dt in emissions:
+            if stats is not None:
+                if is_first and not r.prefix:
+                    # true time-to-first-token: submit -> first emit
+                    stats.record_ttft(max(0.0, dt) * 1e6)
+                else:
+                    stats.record_token(max(0.0, dt) * 1e6)
+            if active and r.trace_id is not None:
+                obs.span(obs.SPAN_TOKEN, now * 1e6, 0.0,
+                         trace_id=r.trace_id, cat="gen", token=tok,
+                         index=index)
+            if self.stream and r.on_token is not None:
+                try:
+                    r.on_token(tok, index)
+                except Exception:  # noqa: BLE001 — a stream consumer
+                    pass           # must never poison the decode loop
+
+    # -- wind-down ---------------------------------------------------------
+    def drain(self) -> bool:
+        with self._cond:
+            return not self._queue and all(
+                l is None for l in self._lanes)
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        """Fail everything queued AND every in-flight lane with a
+        :class:`WorkerLost` carrying each request's partial-generation
+        state (``partial_state()``), so the fleet layer can replay the
+        stream on a surviving worker.  No waiter is left hanging."""
+        with self._cond:
+            self._closed = True
+            now = self._clock()
+            err = error if error is not None else WorkerLost(
+                "generate: batcher closed — worker lost before the "
+                "stream completed")
+            for r in self._queue:
+                r._fail(_lost_for(r, err), now)
+            self._queue.clear()
+            for i, lane in enumerate(self._lanes):
+                if lane is not None and not lane.req.done():
+                    lane.req._fail(_lost_for(lane.req, err), now)
+                self._lanes[i] = None
+            self._cond.notify_all()

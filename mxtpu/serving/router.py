@@ -60,7 +60,7 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -72,10 +72,12 @@ from .batcher import (DynamicBatcher, InferenceRequest, RequestTimeout,
                       ServerBusy, WorkerLost)
 from .controlplane import PriorityClass, parse_classes
 from .faults import FaultPlan, HangSignal, WorkerCrashed
+from .generate import GenerateBatcher
 from .health import WorkerHealth, WorkerState
 from .stats import ServingStats
 
-__all__ = ["FleetRequest", "FleetWorker", "FleetRouter"]
+__all__ = ["FleetRequest", "FleetGenerateRequest", "FleetWorker",
+           "FleetRouter"]
 
 logger = logging.getLogger("mxtpu.serving.fleet")
 
@@ -180,6 +182,104 @@ class FleetRequest:
         return (self.t_done - self.t_submit) * 1e6
 
 
+class FleetGenerateRequest(FleetRequest):
+    """Caller-side streamed-generation future spanning every attempt
+    (ISSUE 19): tokens arrive through an incremental result channel
+    (``_note_token``, wired as the worker attempt's ``on_token``) and
+    are DEDUPED BY STREAM INDEX under a leaf lock — a replay after a
+    worker death re-emits nothing the caller already saw, and a
+    replayed worker disagreeing with the original stream is counted
+    as a wrong token (the kill-mid-generation test asserts both stay
+    zero).  The dedup ledger doubles as the replay prefix: the next
+    attempt prefills ``prompt + tokens_snapshot()`` and resumes."""
+
+    __slots__ = ("prompt", "max_tokens", "eos_id", "top_k", "seed",
+                 "on_token", "finish_reason", "_tok_lock", "_stream",
+                 "duplicate_tokens", "wrong_tokens")
+
+    def __init__(self, prompt: List[int], *, max_tokens: int,
+                 eos_id: Optional[int], top_k: int, seed: int,
+                 t_submit: float, deadline: Optional[float],
+                 trace_id: Optional[str] = None,
+                 priority: str = "default",
+                 on_token: Optional[Callable[[int, int], None]] = None):
+        super().__init__(None, None, len(prompt), t_submit, deadline,
+                         trace_id=trace_id, priority=priority)
+        self.prompt = [int(t) for t in prompt]
+        self.max_tokens = int(max_tokens)
+        self.eos_id = eos_id
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.on_token = on_token
+        # mxrace: disable=unguarded-attr (written once by the winning watcher before _event.set())
+        self.finish_reason: Optional[str] = None
+        # leaf lock (may fire under a GenerateBatcher step): the
+        # deduped stream ledger + anomaly counters
+        self._tok_lock = threading.Lock()
+        self._stream: List[int] = []   # guarded-by: _tok_lock
+        self.duplicate_tokens = 0      # guarded-by: _tok_lock
+        self.wrong_tokens = 0          # guarded-by: _tok_lock
+
+    def tokens_snapshot(self) -> List[int]:
+        """The deduped stream so far — what the NEXT attempt prefills
+        as its replay prefix."""
+        with self._tok_lock:
+            return list(self._stream)
+
+    def _note_token(self, tok: int, index: int) -> None:
+        """Incremental result channel (the worker attempt's
+        ``on_token``).  Exactly-once forwarding: only the first
+        arrival of each stream index reaches the caller; duplicates
+        (a replay racing the original) and disagreements are
+        counted, never forwarded."""
+        fire = False
+        with self._tok_lock:
+            if index == len(self._stream):
+                self._stream.append(int(tok))
+                fire = True
+            elif index < len(self._stream):
+                self.duplicate_tokens += 1
+                if self._stream[index] != int(tok):
+                    self.wrong_tokens += 1
+            else:
+                # a gap means the stream skipped indices — count it
+                # as wrong rather than silently reordering
+                self.wrong_tokens += 1
+        if fire and self.on_token is not None:
+            try:
+                self.on_token(int(tok), int(index))
+            except Exception:   # noqa: BLE001 — a stream consumer
+                pass            # must never poison the decode loop
+
+    def _merge_partial(self, partial: Dict[str, Any]) -> None:
+        """Fold a dead worker's ``WorkerLost.partial`` into the
+        ledger: tokens the stream channel already delivered must
+        AGREE (else they count as wrong); tokens it never delivered
+        (e.g. MXTPU_GEN_STREAM=0) extend it and reach the caller
+        exactly once."""
+        toks = partial.get("tokens") or []
+        added: List[tuple] = []
+        with self._tok_lock:
+            for i, t in enumerate(toks):
+                if i < len(self._stream):
+                    if self._stream[i] != int(t):
+                        self.wrong_tokens += 1
+                else:
+                    self._stream.append(int(t))
+                    added.append((int(t), i))
+        if self.on_token is not None:
+            for t, i in added:
+                try:
+                    self.on_token(t, i)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def anomalies(self) -> Dict[str, int]:
+        with self._tok_lock:
+            return {"duplicate_tokens": self.duplicate_tokens,
+                    "wrong_tokens": self.wrong_tokens}
+
+
 class FleetWorker:
     """One fleet worker: a runner + its own bounded batcher + health
     record (+ an execution thread in threaded mode).  The dispatch
@@ -194,7 +294,11 @@ class FleetWorker:
                  start_recovering: bool = False,
                  liveness_s: Optional[float] = None,
                  dead_after: Optional[int] = None,
-                 exec_recovers: bool = False):
+                 exec_recovers: bool = False,
+                 gen_runner=None):
+        if runner is None and gen_runner is None:
+            raise ValueError("FleetWorker needs a runner, a "
+                             "gen_runner, or both")
         self.runner = runner
         self.name = name
         self._clock = clock
@@ -211,11 +315,19 @@ class FleetWorker:
         # shared no-op when MXTPU_OBS=0.
         self.recorder = obs.flight(f"fleet/{name}", clock=clock)
         self.batcher = DynamicBatcher(
-            max_batch_size=runner.max_batch_size,
+            max_batch_size=runner.max_batch_size if runner is not None
+            else 1,
             max_queue_delay_us=max_queue_delay_us,
             max_queue=max_queue, clock=clock,
             on_timeout=self._on_evicted,
             on_depth=self.stats.record_queue_depth)
+        # decode plane (ISSUE 19): its own continuous batcher so
+        # generation lanes and one-shot inference batches never
+        # contend for admission — both planes share the worker's
+        # health record and stats
+        self.generator = None if gen_runner is None else \
+            GenerateBatcher(gen_runner, clock=clock, stats=self.stats,
+                            on_timeout=self._on_evicted)
         self.health = WorkerHealth(
             name,
             liveness_s=liveness_s if liveness_s is not None
@@ -260,6 +372,10 @@ class FleetWorker:
         and RECOVERING ones (that IS the recovery path).  Raises
         :class:`WorkerLost` (retriable) on refusal, :class:`ServerBusy`
         when the bounded queue is full."""
+        if self.runner is None:
+            raise WorkerLost(
+                f"serving: worker {self.name} is decode-only — "
+                f"no inference runner")
         ok = self.health.admits_canary() if canary \
             else self.health.admits()
         if not ok:
@@ -278,6 +394,40 @@ class FleetWorker:
             # the predicted drain time instead of blind backoff
             if e.retry_after_us is None:
                 e.retry_after_us = self.stats.queue_eta_us()
+            raise
+
+    def submit_generate_attempt(self, freq: "FleetGenerateRequest",
+                                now: float) -> "GenerateRequest":
+        """Admit one GENERATION attempt (ISSUE 19).  The replay
+        contract lives here: the attempt's prefix is the fleet
+        request's deduped stream snapshot, so a resumed rollout
+        prefills ``prompt + already-streamed tokens`` and the lane
+        picks up at the exact next stream index — tokens the caller
+        already saw are never re-emitted (``_note_token`` dedupes by
+        index even if a worker disagrees)."""
+        if self.generator is None:
+            raise WorkerLost(
+                f"serving: worker {self.name} has no decode plane — "
+                f"cannot host generation")
+        if not self.health.admits():
+            raise WorkerLost(
+                f"serving: worker {self.name} is {self.health.state} "
+                f"({self.health.reason}) — not admitting")
+        prefix = freq.tokens_snapshot()
+        timeout_s = None if freq.deadline is None \
+            else max(0.0, freq.deadline - now)
+        try:
+            return self.generator.submit(
+                freq.prompt, max_tokens=freq.max_tokens,
+                eos_id=freq.eos_id, top_k=freq.top_k, seed=freq.seed,
+                prefix=prefix, timeout_s=timeout_s,
+                trace_id=freq.trace_id, on_token=freq._note_token)
+        except ServerBusy as e:
+            # price a decode refusal in TOKENS, not batches: the ETA
+            # is lanes-freeing time, which scales with max_tokens
+            if e.retry_after_us is None:
+                e.retry_after_us = self.stats.token_eta_us(
+                    max(1, freq.max_tokens - len(prefix)))
             raise
 
     # -- execution ---------------------------------------------------------
@@ -300,6 +450,37 @@ class FleetWorker:
             return False
         self._dispatch(batch, now)
         return True
+
+    def pump_generate(self, now: Optional[float] = None) -> bool:
+        """One decode step of the continuous-batching loop (ISSUE 19):
+        admit joiners at the step boundary, run one fused decode step
+        across every occupied lane, emit tokens.  Returns True if the
+        step did any work.  The threaded loop and the router's sync
+        tick both funnel through ``GenerateBatcher.step``, so
+        join/evict policy is identical in both modes."""
+        if self.generator is None:
+            return False
+        now = self._clock() if now is None else now
+        with self._lock:
+            if self._stuck:
+                return False
+        if self._stop.is_set() or \
+                self.health.state == WorkerState.DEAD:
+            return False
+        if self.generator.drain():
+            return False
+        try:
+            out = self.generator.step(now)
+        except Exception as e:  # noqa: BLE001 — decode-step failure:
+            # lanes keep their state; health decides if it's terminal
+            self.health.exec_fail(now)
+            self.recorder.record("gen_exec_fail", error=str(e))
+            logger.debug("fleet worker %s: decode step failed (%s)",
+                         self.name, e)
+            return False
+        if out["admitted"] or out["active"]:
+            self.health.exec_ok(now)
+        return bool(out["admitted"] or out["active"])
 
     def _dispatch(self, batch, now: float) -> None:
         with self._lock:
@@ -386,12 +567,21 @@ class FleetWorker:
                 else now - self._inflight_t
 
     def queued_age(self, now: float) -> Optional[float]:
-        return self.batcher.oldest_waiting_age(now)
+        ages = [self.batcher.oldest_waiting_age(now)]
+        if self.generator is not None:
+            ages.append(self.generator.oldest_waiting_age(now))
+        ages = [a for a in ages if a is not None]
+        return max(ages) if ages else None
 
     def outstanding(self) -> int:
         with self._lock:
             inflight = self._inflight_n
-        return self.batcher.depth + inflight
+        n = self.batcher.depth + inflight
+        if self.generator is not None:
+            # live decode lanes count as outstanding work so drain()
+            # waits for every stream to finish, not just one-shots
+            n += self.generator.depth + len(self.generator.active())
+        return n
 
     # -- threaded mode -----------------------------------------------------
     def start(self) -> None:
@@ -413,7 +603,11 @@ class FleetWorker:
                 # liveness check is what reaps it
                 self._stop.wait(0.02)
                 continue
-            batch = self.batcher.wait_next(timeout=0.05)
+            gen_busy = self.pump_generate()
+            # a busy decode plane polls tightly (every lane step emits
+            # a token); an idle one parks on the one-shot queue
+            batch = self.batcher.wait_next(
+                timeout=0.002 if gen_busy else 0.05)
             if batch is None:
                 continue
             self._dispatch(batch, self._clock())
@@ -429,13 +623,23 @@ class FleetWorker:
                 self._thread is not threading.current_thread():
             self._thread.join(timeout=1.0)
         self.batcher.close(error=error)
+        if self.generator is not None:
+            # every live lane fails with its partial-generation state
+            # attached (WorkerLost.partial) — the router's replay path
+            # folds that into the fleet request before re-dispatch
+            self.generator.close(error=error)
 
     # -- drain handoff -----------------------------------------------------
     def handoff(self) -> Dict[str, Any]:
         """The donor metadata a replacement warms from: which buckets
         this worker's ladder actually compiled (see
         ``ModelRunner.ladder_metadata``)."""
-        return self.runner.ladder_metadata()
+        meta = {} if self.runner is None \
+            else self.runner.ladder_metadata()
+        if self.generator is not None:
+            meta = dict(meta)
+            meta["generate"] = self.generator.runner.ladder_metadata()
+        return meta
 
 
 class _Pending:
@@ -579,14 +783,26 @@ class FleetRouter:
         was actually warmed — ``"donor"``, ``"disk_cache"``, or None
         (cold) — so callers (the Autoscaler) can label their events
         without re-probing the cache."""
+        warmed = None
         if warm_from is not None:
-            worker.runner.warm_from(warm_from)
-            warmed = "donor"
-        else:
-            # one ladder probe: warm_from_disk() returns the buckets
-            # it warmed (empty when there is no cache or no entries)
-            warmed = "disk_cache" if worker.runner.warm_from_disk() \
-                else None
+            if worker.runner is not None and \
+                    warm_from.get("compiled_buckets") is not None:
+                worker.runner.warm_from(warm_from)
+                warmed = "donor"
+            if worker.generator is not None and \
+                    warm_from.get("generate") is not None:
+                worker.generator.runner.warm_from(
+                    warm_from["generate"])
+                warmed = "donor"
+        if warmed is None:
+            # one ladder probe per plane: warm_from_disk() returns the
+            # buckets it warmed (empty when no cache / no entries)
+            hit = worker.runner is not None and \
+                bool(worker.runner.warm_from_disk())
+            if worker.generator is not None and \
+                    worker.generator.runner.warm_from_disk():
+                hit = True
+            warmed = "disk_cache" if hit else None
         with self._lock:
             if self._closed:
                 raise WorkerLost("serving: fleet router is closed")
@@ -597,11 +813,21 @@ class FleetRouter:
             if self._order:
                 r0 = self._workers[self._order[0]].runner
                 r = worker.runner
-                if r.max_batch_size != r0.max_batch_size or \
-                        r.seq_buckets != r0.seq_buckets:
+                if r is not None and r0 is not None and (
+                        r.max_batch_size != r0.max_batch_size or
+                        r.seq_buckets != r0.seq_buckets):
                     raise MXNetError(
                         "serving: fleet workers must share the bucket "
                         "ladder (max_batch_size/seq_buckets)")
+                g0 = self._workers[self._order[0]].generator
+                g = worker.generator
+                if g is not None and g0 is not None and (
+                        g.runner.max_lanes != g0.runner.max_lanes or
+                        g.runner.prompt_buckets !=
+                        g0.runner.prompt_buckets):
+                    raise MXNetError(
+                        "serving: fleet workers must share the decode "
+                        "ladder (max_lanes/prompt_buckets)")
             self._workers[worker.name] = worker
             self._order.append(worker.name)
             self._next_canary[worker.name] = self._clock()
@@ -695,7 +921,11 @@ class FleetRouter:
                 raise WorkerLost("serving: fleet router is closed")
             if not self._order:
                 raise MXNetError("serving: fleet has no workers")
-            r0 = self._workers[self._order[0]].runner
+            r0 = next((self._workers[n].runner for n in self._order
+                       if self._workers[n].runner is not None), None)
+            if r0 is None:
+                raise MXNetError("serving: fleet has no inference-"
+                                 "capable worker (runner)")
             if len(self._pending) >= self._max_pending:
                 self._shed_locked(cls, now, "backlog")
                 raise ServerBusy(
@@ -753,6 +983,114 @@ class FleetRouter:
         return req.result(timeout=None if timeout_s is None
                           else timeout_s + 5.0)
 
+    def submit_generate(self, prompt: Sequence[int], *,
+                        max_tokens: Optional[int] = None,
+                        eos_id: Optional[int] = None,
+                        top_k: int = 1, seed: int = 0,
+                        timeout_s: Optional[float] = None,
+                        priority: Optional[str] = None,
+                        on_token: Optional[Callable[[int, int], None]]
+                        = None) -> FleetGenerateRequest:
+        """Route one streamed GENERATION into the fleet (ISSUE 19).
+        Returns a :class:`FleetGenerateRequest`; ``on_token(tok,
+        index)`` fires exactly once per stream index across every
+        retry/steal.  Rides the same priority classes, backlog cap,
+        and admission control as :meth:`submit`, except the admission
+        ETA is TOKEN-aware: prefill queue ETA plus ``max_tokens``
+        decode steps priced from the per-token histogram."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("serving: generate needs a non-empty "
+                             "prompt")
+        if max_tokens is None:
+            max_tokens = knobs.get("MXTPU_GEN_MAX_TOKENS")
+        max_tokens = int(max_tokens)
+        if max_tokens < 1:
+            raise MXNetError("serving: generate needs max_tokens >= 1")
+        now = self._clock()
+        cname = self._default_class if priority is None else priority
+        cls = self._classes.get(cname)
+        if cls is None:
+            raise MXNetError(
+                f"serving: unknown priority class {cname!r} "
+                f"(have {sorted(self._classes)})")
+        with self._lock:
+            if self._closed:
+                raise WorkerLost("serving: fleet router is closed")
+            if not self._order:
+                raise MXNetError("serving: fleet has no workers")
+            if not any(self._workers[n].generator is not None
+                       for n in self._order):
+                raise MXNetError("serving: fleet has no decode-capable "
+                                 "worker (gen_runner)")
+            if len(self._pending) >= self._max_pending:
+                self._shed_locked(cls, now, "backlog")
+                raise ServerBusy(
+                    f"serving: fleet pending buffer full "
+                    f"({self._max_pending}); retry with backoff",
+                    retry_after_us=self._fleet_eta_locked(cls))
+            if cls.quota is not None:
+                with self._class_lock:
+                    n_cls = self._class_n.get(cls.name, 0)
+                if n_cls >= cls.quota:
+                    self._shed_locked(cls, now, "quota",
+                                      in_system=n_cls)
+                    raise ServerBusy(
+                        f"serving: class {cls.name!r} quota "
+                        f"({cls.quota}) exhausted",
+                        retry_after_us=self._fleet_eta_locked(cls))
+            if self._admission and timeout_s is not None:
+                # per-token admission: a rollout is only feasible if
+                # the queue wait PLUS the whole decode fits the budget
+                eta_us = self._gen_eta_locked(cls, max_tokens)
+                budget_us = timeout_s * 1e6
+                if eta_us is not None and \
+                        self._admission_margin * eta_us > budget_us:
+                    self._shed_locked(cls, now, "admission",
+                                      eta_us=round(eta_us, 1),
+                                      budget_us=round(budget_us, 1),
+                                      tokens=max_tokens)
+                    raise ServerBusy(
+                        f"serving: predicted generation ETA "
+                        f"{eta_us:.0f}us ({max_tokens} tokens) exceeds "
+                        f"the {budget_us:.0f}us deadline budget for "
+                        f"class {cls.name!r} — shed at submit",
+                        retry_after_us=eta_us)
+        freq = FleetGenerateRequest(
+            prompt, max_tokens=max_tokens, eos_id=eos_id, top_k=top_k,
+            seed=seed, t_submit=now,
+            deadline=None if timeout_s is None else now + timeout_s,
+            trace_id=obs.new_trace_id()
+            if profiler.is_active() else None,
+            priority=cls.name, on_token=on_token)
+        freq._on_done = self._note_request_done
+        with self._class_lock:
+            self._class_n[cls.name] = \
+                self._class_n.get(cls.name, 0) + 1
+        if freq.trace_id is not None:
+            obs.span(obs.SPAN_SUBMIT, now * 1e6, 0.0,
+                     trace_id=freq.trace_id, cls=cls.name,
+                     kind="generate", prompt_len=len(prompt),
+                     max_tokens=max_tokens)
+        with self._lock:
+            if not self._dispatch_locked(freq, now):
+                self._park_locked(freq, now, now)
+        return freq
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None, top_k: int = 1,
+                 seed: int = 0, timeout_s: Optional[float] = None,
+                 on_token: Optional[Callable[[int, int], None]] = None
+                 ) -> List[int]:
+        """Blocking convenience wrapper (threaded mode): the full
+        generated token list."""
+        req = self.submit_generate(
+            prompt, max_tokens=max_tokens, eos_id=eos_id, top_k=top_k,
+            seed=seed, timeout_s=timeout_s, on_token=on_token)
+        return req.result(timeout=None if timeout_s is None
+                          else timeout_s + 5.0)
+
     # -- admission control (ISSUE 11) --------------------------------------
     def _shed_locked(self, cls: PriorityClass, now: float, kind: str,
                      **detail: Any) -> None:
@@ -791,6 +1129,34 @@ class FleetRouter:
                 best = e
         return best
 
+    def _gen_eta_locked(self, cls: PriorityClass,
+                        max_tokens: int) -> Optional[float]:
+        """Per-token admission ETA (ISSUE 19): queue wait (class-aware,
+        as in :meth:`_fleet_eta_locked`) PLUS the decode time for
+        ``max_tokens`` steps priced from the per-token latency
+        histogram, minimized over decode-capable admitting workers.
+        None while any candidate is cold — a cold fleet admits
+        optimistically and lets real traffic build the histogram."""
+        admitting = [self._workers[n] for n in self._order
+                     if self._workers[n].generator is not None
+                     and self._workers[n].health.admits()]
+        if not admitting:
+            return None
+        with self._class_lock:
+            ahead = sum(n for c, n in self._class_n.items()
+                        if self._classes[c].weight >= cls.weight)
+        share = ahead / len(admitting)
+        best: Optional[float] = None
+        for w in admitting:
+            q = w.stats.queue_eta_us(depth=share)
+            t = w.stats.token_eta_us(max_tokens)
+            if t is None:
+                return None     # cold decode plane — admit
+            e = (q or 0.0) + t
+            if best is None or e < best:
+                best = e
+        return best
+
     def _note_request_done(self, freq: FleetRequest) -> None:
         # FleetRequest._notify_done hook — fires outside _wlock, may
         # run under a batcher lock; touches only the class leaf lock
@@ -819,14 +1185,27 @@ class FleetRouter:
                          hedge: bool = False) -> bool:
         """Try to place one attempt; False = no worker took it (park
         it).  Called with ``_lock`` held."""
+        is_gen = isinstance(freq, FleetGenerateRequest)
+        if is_gen and len(freq.tokens_snapshot()) >= freq.max_tokens:
+            # the dead worker's partial state already finished the
+            # stream — nothing left to replay, complete directly
+            if freq._complete(freq.tokens_snapshot(), now):
+                freq.finish_reason = freq.finish_reason or "length"
+                self.stats.record_completion(
+                    (now - freq.t_submit) * 1e6, 0.0)
+                freq._notify_done()
+            return True
         for _ in range(len(self._order)):
             worker = self._pick_locked(freq)
             if worker is None:
                 return False
             try:
-                attempt = worker.submit_attempt(
-                    freq.payload, freq.group, freq.seq_len,
-                    freq.deadline, now, trace_id=freq.trace_id)
+                if is_gen:
+                    attempt = worker.submit_generate_attempt(freq, now)
+                else:
+                    attempt = worker.submit_attempt(
+                        freq.payload, freq.group, freq.seq_len,
+                        freq.deadline, now, trace_id=freq.trace_id)
             except (WorkerLost, ServerBusy) as e:
                 # this worker refused; round-robin advances, try next.
                 # Keep the refusal: a ServerBusy's retry_after_us hint
@@ -837,6 +1216,11 @@ class FleetRouter:
             if hedge:
                 freq.hedges += 1
             if freq.trace_id is not None:
+                if is_gen and freq.requeues > 0:
+                    obs.span(obs.SPAN_REPLAY, now * 1e6, 0.0,
+                             trace_id=freq.trace_id,
+                             worker=worker.name,
+                             resumed=len(freq.tokens_snapshot()))
                 if hedge:
                     obs.span(obs.SPAN_HEDGE, now * 1e6, 0.0,
                              trace_id=freq.trace_id,
@@ -860,7 +1244,16 @@ class FleetRouter:
         def cb() -> None:
             now = self._clock()
             if attempt._error is None:
-                if freq._complete(attempt._value, now, hedge=hedge):
+                value = attempt._value
+                if isinstance(freq, FleetGenerateRequest):
+                    # the stream channel already deduped every token;
+                    # the ledger snapshot IS the authoritative result
+                    # (identical to attempt._value on a clean run,
+                    # still complete across a mid-stream steal)
+                    freq.finish_reason = getattr(
+                        attempt, "finish_reason", None)
+                    value = freq.tokens_snapshot()
+                if freq._complete(value, now, hedge=hedge):
                     self.stats.record_completion(
                         (now - freq.t_submit) * 1e6,
                         (attempt.queue_us or 0.0))
@@ -937,6 +1330,14 @@ class FleetRouter:
             # requeue-never-drop path, counted separately
             freq.requeues += 1
             self.stats.bump("requeues")
+            if isinstance(freq, FleetGenerateRequest) and \
+                    getattr(error, "partial", None):
+                # fold the dead lane's partial-generation state into
+                # the replay ledger: tokens the stream never delivered
+                # (MXTPU_GEN_STREAM=0) reach the caller here, and the
+                # next attempt's prefix resumes past them — replay
+                # never double-bills already-emitted tokens
+                freq._merge_partial(error.partial)
             if freq.trace_id is not None:
                 obs.span(obs.SPAN_STEAL, now * 1e6, 0.0,
                          trace_id=freq.trace_id, worker=wname)
@@ -960,6 +1361,8 @@ class FleetRouter:
         due = []
         for name in self._order:
             w = self._workers[name]
+            if w.runner is None:
+                continue        # decode-only worker: no canary payload
             if not w.health.admits_canary():
                 continue
             if now >= self._next_canary.get(name, now):
@@ -1005,10 +1408,10 @@ class FleetRouter:
 
     def _canary_group(self) -> Any:
         with self._lock:
-            if not self._order:
-                return None
-            r0 = self._workers[self._order[0]].runner
-        return r0.seq_bucket_for(self._canary_seq_len)
+            r0 = next((self._workers[n].runner for n in self._order
+                       if self._workers[n].runner is not None), None)
+        return None if r0 is None \
+            else r0.seq_bucket_for(self._canary_seq_len)
 
     # -- the tick ----------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
@@ -1029,6 +1432,11 @@ class FleetRouter:
                 for _ in range(64):     # bounded drain of ready work
                     if not w.pump(now):
                         break
+                # exactly ONE decode step per worker per tick: joiners
+                # land at step boundaries, so a hand-stepped clock sees
+                # deterministic join/evict ordering (lane accounting in
+                # the continuous-batching tests depends on this)
+                w.pump_generate(now)
         # liveness + death reaping
         for w in workers:
             w.health.liveness(now, w.inflight_age(now),
@@ -1142,6 +1550,10 @@ class FleetRouter:
             if self._hedge_after_us > 0:
                 for freq, attempt, wname, t0, hedge in list(self._live):
                     if hedge or freq.hedges > 0:
+                        continue
+                    if isinstance(freq, FleetGenerateRequest):
+                        # never hedge a stream: two lanes decoding the
+                        # same rollout would double-emit tokens
                         continue
                     if (now - t0) * 1e6 >= self._hedge_after_us:
                         if self._dispatch_locked(freq, now,

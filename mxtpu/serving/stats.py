@@ -58,6 +58,11 @@ class ServingStats:
         self._lat_us = deque(maxlen=window)  # guarded-by: _lock
         self._queue_us = deque(maxlen=window)  # guarded-by: _lock
         self._done_ts = deque()  # guarded-by: _lock
+        # generation rings (ISSUE 19): time-to-first-token and
+        # per-token decode latency
+        self._ttft_us = deque(maxlen=window)  # guarded-by: _lock
+        self._tok_us = deque(maxlen=window)  # guarded-by: _lock
+        self.tokens_emitted = 0  # guarded-by: _lock
         self._rate_window_s = rate_window_s
         self._log_every_s = log_every_s
         self._last_log = clock()  # guarded-by: _lock
@@ -114,6 +119,20 @@ class ServingStats:
         self._m_queue_wait = obs.histogram(
             "mxtpu_serving_queue_wait_seconds",
             "Submit-to-dequeue wait.",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_ttft = obs.histogram(
+            "mxtpu_serving_ttft_seconds",
+            "Submit-to-first-token latency of generation requests "
+            "(LatencySLO metric= target).",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_token = obs.histogram(
+            "mxtpu_serving_token_seconds",
+            "Per-token decode-step latency (LatencySLO metric= "
+            "target).",
+            labels=("endpoint",)).labels(endpoint=ep)
+        self._m_tokens = obs.counter(
+            "mxtpu_serving_tokens_total",
+            "Tokens emitted by generation endpoints.",
             labels=("endpoint",)).labels(endpoint=ep)
         self._m_fleet = obs.counter(
             "mxtpu_fleet_events_total",
@@ -177,7 +196,37 @@ class ServingStats:
             self._m_latency.observe(latency_us / 1e6)
             self._m_queue_wait.observe(queue_us / 1e6)
 
+    def record_ttft(self, ttft_us: float) -> None:
+        """Time-to-first-token of one generation request."""
+        with self._lock:
+            self._ttft_us.append(ttft_us)
+        if self._obs:
+            self._m_ttft.observe(ttft_us / 1e6)
+
+    def record_token(self, tok_us: float, n: int = 1) -> None:
+        """One (or ``n`` same-latency) emitted decode tokens."""
+        with self._lock:
+            self._tok_us.append(tok_us)
+            self.tokens_emitted += n
+        if self._obs:
+            self._m_token.observe(tok_us / 1e6)
+            self._m_tokens.inc(n)
+
     # -- views ----------------------------------------------------------
+    def token_eta_us(self, n_tokens: float,
+                     percentile: float = 95.0) -> Optional[float]:
+        """Predicted decode time for ``n_tokens`` more tokens at this
+        endpoint's observed per-token service rate — the generation
+        term of per-token-aware admission control (ISSUE 19): a
+        generation request's feasibility is queue ETA *plus* this.
+        None until a token has been emitted (cold: no prediction)."""
+        with self._lock:
+            if not self._tok_us:
+                return None
+            toks = sorted(list(self._tok_us)[-_ETA_SAMPLE:])
+        return _percentile(toks, percentile) * max(0.0,
+                                                   float(n_tokens))
+
     def queue_eta_us(self, depth: Optional[float] = None,
                      percentile: float = 95.0) -> Optional[float]:
         """Predicted wait for a request entering this endpoint's queue
@@ -232,8 +281,24 @@ class ServingStats:
         with self._lock:
             lat = sorted(self._lat_us)
             queued = sorted(self._queue_us)
+            ttft = sorted(self._ttft_us)
+            toks = sorted(self._tok_us)
             cap = self.batched_requests + self.padded_slots
+            gen = {}
+            if ttft or toks:
+                gen = {"generate": {
+                    "tokens_emitted": self.tokens_emitted,
+                    "ttft_ms": {
+                        "p50": round(_percentile(ttft, 50) / 1e3, 3),
+                        "p95": round(_percentile(ttft, 95) / 1e3, 3),
+                        "n": len(ttft)},
+                    "token_ms": {
+                        "p50": round(_percentile(toks, 50) / 1e3, 3),
+                        "p95": round(_percentile(toks, 95) / 1e3, 3),
+                        "n": len(toks)},
+                }}
             return {
+                **gen,
                 "completed": self.completed,
                 "timed_out": self.timed_out,
                 "rejected": self.rejected,

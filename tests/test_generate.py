@@ -1,0 +1,591 @@
+"""mxtpu.serving.generate — KV-cache incremental decode, continuous
+batching, token streaming, and replay-on-steal (ISSUE 19).
+
+Layered like the subsystem: incremental-model parity first (the
+hybrid-forward (step, cache) signature IS the substrate), then the
+seeded sampler, the GenerateRunner executable ladder + persistent
+cache, fake-clock GenerateBatcher units (join at step boundaries,
+lane reuse after EOS, deadline eviction mid-decode, partial state on
+close), and finally the fleet: a scripted kill mid-generation must
+yield ZERO wrong or duplicated tokens and an exactly resumed stream,
+reconstructable from the request's one trace id.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import obs, profiler
+from mxtpu.base import MXNetError
+from mxtpu.cache import ExecutableCache
+from mxtpu.models.transformer import BERTModel
+from mxtpu.serving import (FleetGenerateRequest, FleetRouter,
+                           FleetWorker, GenerateBatcher,
+                           GenerateRunner, InferenceServer,
+                           RequestTimeout, ServerBusy, WorkerLost,
+                           sample_token)
+
+V, U, HID, NL, NH, L = 32, 16, 32, 2, 2, 16
+LANES = 2
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def net():
+    n = BERTModel(V, U, HID, NL, NH, max_length=L, dropout=0.0,
+                  use_token_type=False, causal=True)
+    n.initialize()
+    n.hybridize()
+    # trace the incremental signature once so export carries the
+    # (tokens, step, cache) triple
+    n(mx.nd.array(np.ones((1, 3))), mx.nd.array(np.zeros(1)),
+      mx.nd.array(np.zeros(n.kv_cache_spec(1), np.float32)))
+    return n
+
+
+@pytest.fixture(scope="module")
+def export(net, tmp_path_factory):
+    d = tmp_path_factory.mktemp("genbert")
+    # re-trace the incremental signature: an earlier test may have run
+    # the plain forward last, and export serializes the latest trace
+    net(mx.nd.array(np.ones((1, 3))), mx.nd.array(np.zeros(1)),
+        mx.nd.array(np.zeros(net.kv_cache_spec(1), np.float32)))
+    sym_file, param_file = net.export(str(d / "genbert"))
+    return sym_file, param_file
+
+
+def _runner(export, **kw):
+    sym_file, param_file = export
+    net_spec = BERTModel(V, U, HID, NL, NH, max_length=L, dropout=0.0,
+                         use_token_type=False,
+                         causal=True).kv_cache_spec(LANES, L)
+    kw.setdefault("prompt_buckets", (4, 8))
+    kw.setdefault("cache", None)
+    return GenerateRunner.from_export(sym_file, param_file, net_spec,
+                                      **kw)
+
+
+@pytest.fixture(scope="module")
+def runner(export):
+    return _runner(export)
+
+
+def _ref_greedy(net, prompt, n):
+    """Reference decode: full forward re-run per token (the naive
+    baseline the KV path must match token-for-token)."""
+    toks = list(prompt)
+    for _ in range(n):
+        x = mx.nd.array(np.array(toks, np.float32)[None, :])
+        logits = net(x).asnumpy()[0]
+        toks.append(int(np.argmax(logits[len(toks) - 1])))
+    return toks[len(prompt):]
+
+
+def _batcher(runner, clk, **kw):
+    kw.setdefault("clock", clk)
+    return GenerateBatcher(runner, **kw)
+
+
+def _drive(b, clk, *reqs, n=30, dt=0.01):
+    for _ in range(n):
+        clk.advance(dt)
+        b.step()
+        if all(r.done() for r in reqs):
+            return
+    raise AssertionError(f"requests not done after {n} steps")
+
+
+# ----------------------------------- incremental forward parity (sat 1)
+
+def test_incremental_forward_matches_full(net):
+    """The hybrid-forward (step, cache) path must pin the full
+    forward's logits bit-close at every position: prefill a prompt,
+    then extend one token at a time through the cache and compare
+    each step's last-position logits against a from-scratch run."""
+    prompt = [3, 7, 1, 4]
+    cache = mx.nd.array(np.zeros(net.kv_cache_spec(1), np.float32))
+    x = mx.nd.array(np.array(prompt, np.float32)[None, :])
+    inc, cache = net(x, mx.nd.array(np.zeros(1)), cache)
+    full = net(x)
+    np.testing.assert_allclose(inc.asnumpy(), full.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    toks = list(prompt)
+    for step in range(4):
+        nxt = int(np.argmax(inc.asnumpy()[0, len(toks) - 1 if step == 0
+                                          else 0]))
+        toks.append(nxt)
+        inc, cache = net(
+            mx.nd.array(np.array([[nxt]], np.float32)),
+            mx.nd.array(np.array([len(toks) - 1], np.float32)), cache)
+        ref = net(mx.nd.array(np.array(toks, np.float32)[None, :]))
+        np.testing.assert_allclose(
+            inc.asnumpy()[0, 0], ref.asnumpy()[0, len(toks) - 1],
+            rtol=1e-5, atol=1e-5)
+
+
+def test_kv_cache_spec_shape(net):
+    assert net.kv_cache_spec(LANES, L) == (NL, 2, LANES, NH, L, U // NH)
+
+
+# ------------------------------------------------------- sample_token
+
+def test_sample_token_greedy_is_argmax():
+    logits = np.array([0.1, 2.0, -1.0, 0.5], np.float32)
+    assert sample_token(logits, position=5) == 1
+
+
+def test_sample_token_seeded_by_absolute_position():
+    """The draw is keyed by (seed, absolute position) ONLY — the same
+    position yields the same token no matter which attempt or process
+    samples it.  This is what makes a replayed stream identical."""
+    rng = np.random.RandomState(0)
+    logits = rng.randn(64).astype(np.float32)
+    a = [sample_token(logits, position=p, seed=9, top_k=8)
+         for p in range(12)]
+    b = [sample_token(logits, position=p, seed=9, top_k=8)
+         for p in range(12)]
+    assert a == b
+    assert len(set(a)) > 1          # top-k actually varies by position
+    c = [sample_token(logits, position=p, seed=10, top_k=8)
+         for p in range(12)]
+    assert a != c                   # seed matters
+
+
+# --------------------------------------------------- runner executables
+
+def test_runner_bucket_ladder(runner):
+    bk = runner.buckets()
+    assert ("decode", (LANES + 1,)) in bk
+    assert ("prefill", (1, 4)) in bk and ("prefill", (2, 8)) in bk
+    assert runner.prompt_bucket_for(3) == 4
+    assert runner.prompt_bucket_for(9) == 8   # capped: chunked prefill
+
+
+def test_runner_rejects_bad_kv_spec(export):
+    sym_file, param_file = export
+    with pytest.raises(MXNetError):
+        GenerateRunner.from_export(sym_file, param_file,
+                                   (NL, 2, LANES, NH, L),
+                                   prompt_buckets=(4,), cache=None)
+    with pytest.raises(MXNetError):
+        _runner(export, prompt_buckets=(64,))  # bucket > KV capacity
+
+
+def test_decode_program_contains_kv_update_write(runner):
+    """The decode-step program writes the KV cache IN PLACE at each
+    lane's own step index — per-lane ``lax.dynamic_update_slice``
+    vmapped over lanes, which lowers to scatter in the as-written HLO
+    (hlocheck pins the compiled artifact).  The cache must thread
+    through as an updated operand, never be rebuilt from scratch."""
+    text = runner.lowered_program_text(("decode", (LANES + 1,)))
+    assert "scatter" in text or "dynamic-update-slice" in text or \
+        "dynamic_update_slice" in text
+
+
+def test_greedy_decode_matches_full_forward(net, runner):
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    r = b.submit([1, 2, 3], max_tokens=5)
+    _drive(b, clk, r)
+    assert r.result(0) == _ref_greedy(net, [1, 2, 3], 5)
+    assert r.finish_reason == "length"
+
+
+def test_chunked_prefill_beyond_largest_bucket(net, runner):
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    r = b.submit([1] * 9, max_tokens=3)       # 9 > largest bucket 8
+    _drive(b, clk, r)
+    assert r.result(0) == _ref_greedy(net, [1] * 9, 3)
+
+
+# ------------------------------------ persistent cache: warm decode path
+
+def test_warmed_worker_has_zero_cold_compiles(export, tmp_path):
+    """THE first-token-is-never-a-compile acceptance: warm the ladder
+    through one runner, then a fresh runner (new process stand-in)
+    over the same disk cache must build every entry from disk —
+    zero cold compiles — and still decode correctly."""
+    # one prompt bucket keeps the ladder at 3 programs — the cache
+    # contract is per-entry, a taller ladder proves nothing more
+    donor = _runner(export, cache=ExecutableCache(tmp_path),
+                    prompt_buckets=(4,))
+    donor.warmup()
+    assert donor.cold_compiles() == len(donor.buckets())
+
+    fresh = _runner(export, cache=ExecutableCache(tmp_path),
+                    prompt_buckets=(4,))
+    warmed = fresh.warm_from_disk()
+    assert set(warmed) == set(fresh.buckets())
+    assert fresh.cold_compiles() == 0
+    assert set(fresh.compile_sources().values()) == {"disk"}
+
+    clk = FakeClock()
+    b = _batcher(fresh, clk)
+    r = b.submit([1, 2, 3], max_tokens=3)
+    _drive(b, clk, r)
+    assert len(r.result(0)) == 3
+    assert fresh.cold_compiles() == 0          # still nothing cold
+
+
+def test_int8_decode_keys_separately(export, tmp_path):
+    """int8-armed executables must key APART from the float path in
+    the persistent cache — a float warmup can never satisfy (or be
+    poisoned by) an int8 decode entry."""
+    cache = ExecutableCache(tmp_path)
+    f32 = _runner(export, cache=cache)
+    i8 = _runner(export, cache=cache, quant=True,
+                 quant_scales={"t": 1.0})
+    bucket = ("decode", (LANES + 1,))
+    assert f32._cache_key(bucket) != i8._cache_key(bucket)
+    f32.warmup([bucket])
+    assert f32.cached_buckets() == [bucket]
+    assert i8.cached_buckets() == []           # float entry invisible
+
+
+# ------------------------------------------ batcher: continuous batching
+
+def test_join_at_step_boundary_with_lane_accounting(net, runner):
+    """A request submitted mid-decode joins at the NEXT step boundary
+    by claiming a freed-or-free lane; both streams stay exact."""
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    r1 = b.submit([1, 2, 3], max_tokens=5)
+    out = b.step()
+    assert out["admitted"] == 1 and b.free_lanes() == LANES - 1
+    r2 = b.submit([4, 5], max_tokens=4)        # late joiner
+    assert b.depth == 1                        # queued, not in a lane
+    clk.advance(0.01)
+    out = b.step()                             # the join boundary
+    assert out["admitted"] == 1 and b.free_lanes() == LANES - 2
+    _drive(b, clk, r1, r2)
+    assert r1.result(0) == _ref_greedy(net, [1, 2, 3], 5)
+    assert r2.result(0) == _ref_greedy(net, [4, 5], 4)
+    assert b.joins == 2
+    assert b.free_lanes() == LANES             # both lanes reclaimed
+
+
+def test_lane_reuse_after_eos(net, runner):
+    """An EOS-finished lane frees at the step boundary and the next
+    queued request claims it — lane recycling must not leak the dead
+    stream's KV state into the new one (the attention mask caps at
+    the new lane's own frontier)."""
+    ref = _ref_greedy(net, [1, 2, 3], 5)
+    eos = ref[2]
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    # saturate both lanes (one step = prefill + first decode)
+    ra = b.submit([1, 2, 3], max_tokens=10, eos_id=eos)
+    rb = b.submit([1] * 4, max_tokens=6)
+    b.step()
+    assert b.free_lanes() == 0
+    rc = b.submit([4, 5], max_tokens=3)        # waits for a lane
+    _drive(b, clk, ra)
+    assert ra.finish_reason == "eos" and ra.result(0) == ref[:3]
+    _drive(b, clk, rb, rc)
+    assert rc.result(0) == _ref_greedy(net, [4, 5], 3)
+    assert rb.result(0) == _ref_greedy(net, [1] * 4, 6)
+    assert b.free_lanes() == LANES
+
+
+def test_deadline_eviction_mid_decode(runner):
+    clk = FakeClock()
+    b = _batcher(runner, clk, on_timeout=None)
+    r = b.submit([1, 2, 3], max_tokens=50, timeout_s=0.05)
+    b.step()                                   # prefill, 1 token out
+    clk.advance(1.0)
+    b.step()                                   # evicted at the boundary
+    with pytest.raises(RequestTimeout):
+        r.result(0)
+    assert b.free_lanes() == LANES
+
+
+def test_queue_full_raises_server_busy(runner):
+    clk = FakeClock()
+    b = _batcher(runner, clk, max_queue=1)
+    b.submit([1, 2], max_tokens=2)
+    with pytest.raises(ServerBusy):
+        for _ in range(3):
+            b.submit([1, 2], max_tokens=2)
+
+
+def test_max_lanes_knob_caps_batching_width(net, runner):
+    # MXTPU_GEN_MAX_LANES narrows continuous batching below the
+    # exported KV table width without re-exporting: with a 1-lane cap
+    # on a 2-lane runner the second request waits for the first to
+    # finish, and the result is still the greedy reference.
+    clk = FakeClock()
+    b = _batcher(runner, clk, max_lanes=1)
+    ra = b.submit([1, 2, 3], max_tokens=3)
+    rb = b.submit([4, 5], max_tokens=3)
+    clk.advance(0.01)
+    b.step()          # ra holds the only lane (prefill + 1st decode)
+    assert len(b.active()) == 1 and b.depth == 1
+    _drive(b, clk, ra, rb)
+    assert ra.result(0) == _ref_greedy(net, [1, 2, 3], 3)
+    assert rb.result(0) == _ref_greedy(net, [4, 5], 3)
+    assert b.joins == 2
+
+
+def test_stream_callbacks_carry_indices(net, runner):
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    got = []
+    r = b.submit([1, 2, 3], max_tokens=4,
+                 on_token=lambda t, i: got.append((i, t)))
+    _drive(b, clk, r)
+    exp = _ref_greedy(net, [1, 2, 3], 4)
+    assert [t for _, t in got] == exp
+    assert [i for i, _ in got] == [0, 1, 2, 3]
+
+
+# ------------------------------- partial state + replay economics (sat 2)
+
+def test_close_carries_partial_generation_state(runner):
+    """WorkerLost from a dying batcher carries prompt + emitted tokens
+    + the ORIGINAL t_submit/deadline, so a replay resumes without
+    double-billing the clock."""
+    clk = FakeClock(200.0)
+    b = _batcher(runner, clk)
+    r = b.submit([1, 2, 3], max_tokens=50, timeout_s=9.0)
+    clk.advance(0.5)
+    b.step()                            # prefill + first decode step
+    clk.advance(0.5)
+    b.step()                            # one more decode step
+    b.close()
+    with pytest.raises(WorkerLost) as ei:
+        r.result(0)
+    p = ei.value.partial
+    assert p["prompt"] == [1, 2, 3]
+    assert p["tokens"] == r.prefix + r.tokens and len(p["tokens"]) == 3
+    assert p["t_submit"] == 200.0              # original admission time
+    assert p["deadline"] == pytest.approx(209.0)
+
+
+def test_replay_prefix_resumes_exact_stream(net, runner):
+    """Resuming from a prefix (prompt + already-streamed tokens) must
+    produce the IDENTICAL remaining stream, with indices continuing
+    where the dead attempt stopped — seeded sampling is keyed by
+    absolute position, so the steal is invisible in the tokens."""
+    exp = _ref_greedy(net, [1, 2, 3], 5)
+    clk = FakeClock()
+    b = _batcher(runner, clk)
+    got = []
+    r = b.submit([1, 2, 3], max_tokens=5, prefix=exp[:2],
+                 on_token=lambda t, i: got.append((i, t)))
+    _drive(b, clk, r)
+    assert r.result(0) == exp                  # full stream, replayed
+    assert [i for i, _ in got] == [2, 3, 4]    # only NEW indices fired
+    assert [t for _, t in got] == exp[2:]
+
+
+def test_replay_never_double_bills_deadline(runner):
+    """A replay submitted with the original deadline already expired
+    fails fast as queued-deadline-expiry — it must NOT restart the
+    clock from the new submit."""
+    clk = FakeClock(300.0)
+    b = _batcher(runner, clk)
+    r = b.submit([1, 2, 3], max_tokens=5, prefix=[0],
+                 timeout_s=0.05)               # original budget spent
+    clk.advance(1.0)
+    b.step()
+    with pytest.raises(RequestTimeout):
+        r.result(0)
+
+
+# -------------------------------------------------- sampling determinism
+
+def test_topk_sampling_identical_across_runs_and_steal(net, runner):
+    """Seeded top-k: two full runs produce the same stream, and a
+    steal (replay from any prefix point) continues it exactly."""
+    def run(prefix=()):
+        clk = FakeClock()
+        b = _batcher(runner, clk)
+        r = b.submit([5, 6, 7], max_tokens=6, top_k=4, seed=13,
+                     prefix=list(prefix))
+        _drive(b, clk, r)
+        return r.result(0)
+
+    full_a, full_b = run(), run()
+    assert full_a == full_b                    # across runs
+    for cut in (1, 3, 5):
+        assert run(prefix=full_a[:cut]) == full_a   # across a steal
+
+
+# -------------------------------------------------------- fleet: replay
+
+def _gen_worker(export, clk, name):
+    # one prompt bucket (8 covers every fleet prompt + replay prefix)
+    # keeps each worker's ladder at 3 programs — fleet behavior, not
+    # ladder breadth, is under test here
+    return FleetWorker(None, name, clock=clk,
+                       gen_runner=_runner(export, prompt_buckets=(8,)))
+
+
+def _gen_router(clk, **kw):
+    kw.setdefault("backoff_base_us", 10_000)
+    kw.setdefault("backoff_cap_us", 50_000)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("hedge_after_us", 0)
+    return FleetRouter(clock=clk, threaded=False, canary=None, **kw)
+
+
+def _crank(router, clk, n=40, dt=0.05, until=None):
+    for _ in range(n):
+        clk.advance(dt)
+        router.tick()
+        if until is not None and until():
+            return
+
+
+def test_fleet_kill_mid_generation_exact_resume(net, export):
+    """THE acceptance scenario: kill the hosting worker mid-stream.
+    The request replays from prompt + already-streamed tokens on the
+    survivor, the caller sees every stream index exactly once, zero
+    wrong and zero duplicated tokens, and the final stream equals the
+    uninterrupted reference."""
+    clk = FakeClock(100.0)
+    profiler.set_state("run")
+    try:
+        router = _gen_router(clk)
+        router.add_worker(_gen_worker(export, clk, "w0"))
+        router.add_worker(_gen_worker(export, clk, "w1"))
+        exp = _ref_greedy(net, [1, 2, 3], 6)
+
+        streamed = []
+        freq = router.submit_generate(
+            [1, 2, 3], max_tokens=6, timeout_s=60.0,
+            on_token=lambda t, i: streamed.append((i, t)))
+        assert isinstance(freq, FleetGenerateRequest)
+        assert freq.trace_id is not None
+        _crank(router, clk, dt=0.01, until=lambda: len(streamed) >= 2)
+        assert len(streamed) >= 2 and not freq.done()
+
+        host = freq.tried[-1]
+        router.kill(host)
+        _crank(router, clk, until=freq.done)
+
+        assert freq.result(0) == exp
+        assert freq.requeues == 1
+        assert freq.anomalies() == {"duplicate_tokens": 0,
+                                    "wrong_tokens": 0}
+        assert [t for _, t in streamed] == exp     # exactly once, in
+        assert [i for i, _ in streamed] == list(range(6))  # order
+        surv = [w for w in ("w0", "w1") if w != host][0]
+        assert freq.tried == [host, surv]
+
+        # the whole story reconstructs from the one trace id: prefill
+        # on the first host, tokens, the replay marker on the survivor
+        events = json.loads(profiler.dumps())["traceEvents"]
+        timeline = obs.trace_of(freq.trace_id, events=events)
+        names = [e["name"] for e in timeline]
+        for span in (obs.SPAN_SUBMIT, obs.SPAN_PREFILL, obs.SPAN_TOKEN,
+                     obs.SPAN_STEAL, obs.SPAN_REPLAY):
+            assert span in names, f"missing {span} in {names}"
+        replay = next(e for e in timeline
+                      if e["name"] == obs.SPAN_REPLAY)
+        assert replay["args"]["worker"] == surv
+        assert 1 <= replay["args"]["resumed"] < 6  # mid-stream resume
+        token_idx = sorted(e["args"]["index"] for e in timeline
+                           if e["name"] == obs.SPAN_TOKEN)
+        assert token_idx[-1] == 5 and token_idx[0] == 0
+        router.close()
+    finally:
+        profiler.set_state("stop")
+        profiler.dumps(reset=True)
+
+
+def test_fleet_generate_continuous_batching_late_join(net, export):
+    """Two streams on ONE worker: the second submits mid-decode of the
+    first and joins at a step boundary (lane accounting asserted)."""
+    clk = FakeClock(100.0)
+    router = _gen_router(clk)
+    w = _gen_worker(export, clk, "w0")
+    router.add_worker(w)
+    f1 = router.submit_generate([1, 2, 3], max_tokens=5,
+                                timeout_s=60.0)
+    clk.advance(0.01)
+    router.tick()                              # f1 prefilled: 1 lane
+    assert w.generator.free_lanes() == LANES - 1
+    f2 = router.submit_generate([4, 5], max_tokens=4, timeout_s=60.0)
+    _crank(router, clk, until=lambda: f1.done() and f2.done())
+    assert f1.result(0) == _ref_greedy(net, [1, 2, 3], 5)
+    assert f2.result(0) == _ref_greedy(net, [4, 5], 4)
+    assert w.generator.joins == 2
+    assert w.generator.free_lanes() == LANES
+    router.close()
+
+
+def test_fleet_generate_never_hedges(export):
+    """Hedging a stream would double-emit tokens — generation requests
+    are excluded from the hedging loop by contract."""
+    clk = FakeClock(100.0)
+    router = _gen_router(clk, hedge_after_us=1)  # hedge ASAP
+    router.add_worker(_gen_worker(export, clk, "w0"))
+    router.add_worker(_gen_worker(export, clk, "w1"))
+    freq = router.submit_generate([1, 2, 3], max_tokens=4,
+                                  timeout_s=60.0)
+    _crank(router, clk, until=freq.done)
+    assert freq.hedges == 0 and len(freq.tried) == 1
+    assert freq.anomalies() == {"duplicate_tokens": 0,
+                                "wrong_tokens": 0}
+    router.close()
+
+
+def test_fleet_generate_deadline_never_stale_stream(export):
+    clk = FakeClock(100.0)
+    router = _gen_router(clk)
+    router.add_worker(_gen_worker(export, clk, "w0"))
+    freq = router.submit_generate([1, 2, 3], max_tokens=500,
+                                  timeout_s=0.2)
+    clk.advance(0.01)
+    router.tick()                              # starts decoding
+    clk.advance(5.0)
+    router.tick()
+    with pytest.raises(RequestTimeout):
+        freq.result(0)
+    router.close()
+
+
+# ----------------------------------------------------- server endpoint
+
+def test_server_generate_roundtrip(net, export):
+    """Streamed generation through InferenceServer's continuous
+    endpoint (threaded, real clock): result + per-token callbacks."""
+    srv = InferenceServer()
+    srv.register_generator("bert", _runner(export))
+    got = []
+    out = srv.generate("bert", [1, 2, 3], max_tokens=5, timeout_s=60.0,
+                       on_token=lambda t, i: got.append((i, t)))
+    assert out == _ref_greedy(net, [1, 2, 3], 5)
+    assert [t for _, t in sorted(got)] == out
+    snap = srv.stats("bert")
+    assert snap["lanes"] == LANES
+    # first emission lands in the TTFT histogram, the rest per-token
+    assert snap["generate"]["tokens_emitted"] >= 4
+    assert "bert:v1:gen" in srv.stats()
+    srv.close()
+
+
+def test_server_generator_registry_guards(export):
+    srv = InferenceServer()
+    srv.register_generator("g", _runner(export))
+    with pytest.raises(MXNetError):
+        srv.register_generator("g", _runner(export))  # dup version
+    with pytest.raises(MXNetError):
+        srv.generate("nope", [1], max_tokens=1)
+    srv.unregister("g")
+    with pytest.raises(MXNetError):
+        srv.generate("g", [1], max_tokens=1)
+    srv.close()

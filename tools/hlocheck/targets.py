@@ -354,6 +354,90 @@ def serving_bert_int8_prec() -> Dict:
             "param_sigs": None}
 
 
+def _generate_runner(amp: bool = False):
+    """Tiny causal BERT through the incremental-decode path (ISSUE
+    19): hybrid-forward with (step, cache) extra inputs exported, then
+    a GenerateRunner over a 2-lane bucket-paged KV cache.  The decode
+    contract this pins: the per-lane ``dynamic-update-slice`` KV
+    write + masked cached attention, single fused device program, no
+    host round-trips inside the step."""
+    import os
+    import tempfile
+    from mxtpu import nd
+    from mxtpu.models.transformer import BERTModel
+    from mxtpu.serving import GenerateRunner
+    net = BERTModel(_VOCAB, 64, 128, 2, 2, max_length=32,
+                    dropout=0.0, use_token_type=False, causal=True)
+    net.initialize(init="xavier")
+    net.hybridize()
+    rng = np.random.RandomState(0)
+    toks = nd.array(rng.randint(0, _VOCAB, (1, 8))
+                    .astype(np.float32))
+    step = nd.array(np.zeros((1,), np.float32))
+    cache = nd.array(np.zeros(net.kv_cache_spec(1), np.float32))
+    net(toks, step, cache)   # trace the incremental signature
+    d = tempfile.mkdtemp(prefix="hlocheck_gen_")
+    sym_file, param_file = net.export(os.path.join(d, "genbert"))
+    return GenerateRunner.from_export(
+        sym_file, param_file, net.kv_cache_spec(2, 32),
+        prompt_buckets=(16, 32), cache=None, amp=amp or None)
+
+
+@register_target("generate_decode")
+def generate_decode() -> Dict[str, Artifact]:
+    """Generation ladder: every (batch-rung x prompt-bucket) prefill
+    executable plus THE decode-step executable.  The decode entry is
+    the per-token serving contract — its compiled text must carry the
+    slot-table ``dynamic-update-slice`` KV writes (one per layer per
+    k/v) and no host transfer."""
+    runner = _generate_runner()
+    runner.warmup()
+    out: Dict[str, Artifact] = {}
+    for bucket in runner.buckets():
+        kind, shp = bucket
+        text, mem = runner.program_artifact(bucket)
+        if kind == "decode":
+            out["decode_step"] = (text, mem)
+        else:
+            out[f"prefill_b{shp[0]}_s{shp[1]}"] = (text, mem)
+    # pre-optimization view of the decode step: the level the mxprec
+    # ledger and dtypeflow hazard rules read (update-slice signature
+    # survives backend normalization here)
+    out["decode_step_as_written"] = \
+        (runner.lowered_program_text(runner.default_bucket()), None)
+    return out
+
+
+@register_prec("generate_decode")
+def generate_decode_prec() -> Dict:
+    # lowering only — no compile, the sweep stays fast on CPU
+    runner = _generate_runner()
+    programs = {}
+    for bucket in runner.buckets():
+        kind, shp = bucket
+        name = "decode_step" if kind == "decode" \
+            else f"prefill_b{shp[0]}_s{shp[1]}"
+        programs[name] = runner.lowered_program_text(bucket)
+    return {"programs": programs, "optimizer": None,
+            "param_sigs": None}
+
+
+@register_prec("generate_decode_amp")
+def generate_decode_amp_prec() -> Dict:
+    """bf16 decode with f32 accumulation: the amp ledger must show
+    zero hazards — attention scores and softmax stay f32 (ISSUE 16
+    layout contracts) while the matmul operands ride bf16."""
+    runner = _generate_runner(amp=True)
+    programs = {}
+    for bucket in runner.buckets():
+        kind, shp = bucket
+        name = "decode_step" if kind == "decode" \
+            else f"prefill_b{shp[0]}_s{shp[1]}"
+        programs[name] = runner.lowered_program_text(bucket)
+    return {"programs": programs, "optimizer": None,
+            "param_sigs": None}
+
+
 class _QuantEvidenceCollector:
     """MinMax activation collector that ALSO records the per-channel
     |w| scales the quantized trace computes in-graph — the policy's
