@@ -564,17 +564,20 @@ def bench_moe_ffn(T=8192, E=8, D=1024, H=4096, warmup=2, iters=8,
             out = run(x0)
             float(jnp.sum(out.astype(jnp.float32)))
             best = min(best, (time.perf_counter() - t0) / n)
-        # HBM high-water of the compiled loop program (``hbm`` keeps
-        # the historical temp+arg+output accounting; ``peak`` is the
-        # sweep-wide hbm_peak convention, temp+arg only)
+        # HBM high-water of the compiled loop program through the ONE
+        # memflow analyzer (``hbm`` keeps the historical
+        # temp+arg+output accounting; ``peak`` is the sweep-wide
+        # hbm_peak convention, temp+arg only)
+        from mxtpu import analysis
         try:
-            ma = run.lower(x0).compile().memory_analysis()
-            hbm = int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
-                      + ma.output_size_in_bytes)
-            peak = int(ma.temp_size_in_bytes
-                       + ma.argument_size_in_bytes)
+            mem = analysis.mem_stats(run.lower(x0).compile())
         except Exception:
+            mem = None
+        if mem is None:
             hbm = peak = None
+        else:
+            hbm = mem["hbm_peak"] + mem.get("output_size_in_bytes", 0)
+            peak = mem["hbm_peak"]
         return best, hbm, peak
 
     def moe_out(xx):
@@ -688,13 +691,14 @@ def bench_bert_zero(batch_size=32, seq_len=128, warmup=2, iters=8,
         })
         stats = zstats
     if dp < 8:
+        from mxtpu.analysis import memflow
         sigs = [(tuple(rstep._params[i]._data._data.shape),
                  str(rstep._params[i]._data._data.dtype))
                 for i in rstep._train_idx]
-        planned = parallel.plan_zero_buckets(sigs, 8)
-        # adam: two f32 state leaves (m, v) per bucket, dp-sharded
-        info["zero_dp8_planned_opt_state_bytes_per_device"] = sum(
-            2 * b["padded_bytes"] // 8 for b in planned)
+        # adam: two f32 state leaves (m, v) per bucket, dp-sharded —
+        # the same plan_zero_buckets oracle the mem ledgers commit
+        info["zero_dp8_planned_opt_state_bytes_per_device"] = \
+            memflow.planned_shard_bytes(sigs, 8)
     stats = dict(stats)
     stats["info"] = info
     return stats, _METRIC_NAMES["bert_zero"], "tokens/sec"
@@ -1669,9 +1673,23 @@ def main():
                      f"contracts/prec/; inspect `python -m "
                      f"tools.mxprec` and either fix the drift or "
                      f"regenerate with --update before benching")
+        # and for the memory ledgers: an HBM decomposition that
+        # drifted from contracts/mem/ means the footprint being
+        # benched (opt-state sharding, KV geometry, donation) is not
+        # the one that was reviewed.
+        rc = subprocess.call(
+            [sys.executable, "-m", "tools.mxmem", "--check"],
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if rc != 0:
+            sys.exit(f"bench: --contracts gate failed (mxmem "
+                     f"rc={rc}) — the memory footprint drifted from "
+                     f"contracts/mem/; inspect `python -m "
+                     f"tools.mxmem` and either fix the drift or "
+                     f"regenerate with --update before benching")
         print("bench: --contracts gate passed (programs match "
               "contracts/, lock graph matches lockorder.json, "
-              "dtype flow matches contracts/prec/)")
+              "dtype flow matches contracts/prec/, memory ledgers "
+              "match contracts/mem/)")
     if "--preflight" in sys.argv[1:]:
         # Answer "will the selected sweep fit the wall budget?" without
         # touching the TPU.  Non-zero exit = the sweep as configured

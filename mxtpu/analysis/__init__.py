@@ -21,6 +21,11 @@ applies the contract-free hygiene subset — no host transfers, no f64
 creep, no bracketed custom calls — to every program ``TrainStep`` and
 serving's ``ModelRunner`` compile: ``1`` warns, ``2`` raises, unset
 costs nothing.
+
+:mod:`.memflow` (ISSUE 20) is the memory sibling: the ONE ``hbm_peak``
+analyzer, per-device HBM decomposition, the five memory hazard rules,
+and the committed ledgers under ``contracts/mem/`` (``python -m
+tools.mxmem`` is the CLI; knob ``MXTPU_MEM_AUDIT``).
 """
 from __future__ import annotations
 
@@ -35,6 +40,10 @@ from . import dtypeflow
 from .dtypeflow import (cast_flows, dtype_summary, format_hazard,
                         hazard_findings, master_weight_findings,
                         program_ledger)
+from . import memflow
+from .memflow import (collective_scratch_bytes, decompose,
+                      hazard_findings_mem, mem_audit_findings,
+                      mem_stats)
 from .contracts import (CONTRACTS_DIR, DEFAULT_TOLERANCES, Violation,
                         check_contract, contract_path, load_contract,
                         make_contract, save_contract)
@@ -51,16 +60,9 @@ __all__ = [
     "hazard_findings", "format_hazard", "master_weight_findings",
     "program_ledger", "lowered_text", "lowered_summary",
     "prec_audit_mode", "audit_stamp", "needs_reaudit",
+    "memflow", "decompose", "collective_scratch_bytes",
+    "hazard_findings_mem", "mem_audit_findings", "mem_audit_mode",
 ]
-
-
-def mem_stats(compiled) -> Optional[Dict[str, int]]:
-    """``memory_analysis()`` of a compiled program as the
-    ``hbm_peak``-bearing dict (same shape as
-    ``mxtpu.parallel._mem_stats``); None when the backend doesn't
-    report."""
-    from mxtpu.parallel import _mem_stats
-    return _mem_stats(compiled)
 
 
 def compiled_artifact(fn, *args, **jit_kwargs
@@ -143,13 +145,19 @@ def prec_audit_mode() -> int:
     return _knob_mode("MXTPU_PREC_AUDIT")
 
 
+def mem_audit_mode() -> int:
+    """``MXTPU_MEM_AUDIT``: 0 off (default), 1 warn, 2 raise."""
+    return _knob_mode("MXTPU_MEM_AUDIT")
+
+
 def audit_stamp() -> Dict[str, int]:
     """This process's audit modes as the persistent-cache entry meta
     (``mxtpu.cache``): the knobs are per-process, so a disk entry
     records how strictly its WRITER audited and a reader with
     stricter modes re-audits the reloaded program instead of trusting
     the writer's (possibly absent) cold-birth audit."""
-    return {"hlo_audit": audit_mode(), "prec_audit": prec_audit_mode()}
+    return {"hlo_audit": audit_mode(), "prec_audit": prec_audit_mode(),
+            "mem_audit": mem_audit_mode()}
 
 
 def needs_reaudit(meta: Dict) -> bool:
@@ -159,7 +167,8 @@ def needs_reaudit(meta: Dict) -> bool:
     def _m(v) -> int:
         return v if isinstance(v, int) else 0
     return (audit_mode() > _m(meta.get("hlo_audit"))
-            or prec_audit_mode() > _m(meta.get("prec_audit")))
+            or prec_audit_mode() > _m(meta.get("prec_audit"))
+            or mem_audit_mode() > _m(meta.get("mem_audit")))
 
 
 def maybe_audit(compiled, label: str = "",
@@ -175,14 +184,22 @@ def maybe_audit(compiled, label: str = "",
     compiled text; post-optimization dumps lack source metadata and
     normalize some sub-f32 math, so it catches the surviving forms
     (f64 creep, narrowing-accumulator reduce regions, sub-f32 dots) —
-    the full pre-opt analysis lives in ``python -m tools.mxprec``."""
+    the full pre-opt analysis lives in ``python -m tools.mxprec``.
+
+    The memory audit (``MXTPU_MEM_AUDIT``) checks the program's peak
+    HBM per device against the device-class budget
+    (``MXTPU_MEM_BUDGET`` override, else contracts/mem/budgets.json)
+    — the ledger-level decomposition lives in ``python -m
+    tools.mxmem``."""
     mode = audit_mode()
     pmode = prec_audit_mode()
-    if not mode and not pmode:
+    mmode = mem_audit_mode()
+    if not mode and not pmode and not mmode:
         return None
+    if mem is None:
+        mem = mem_stats(compiled)
     program = parse_hlo(compiled.as_text())
-    summ = summarize(program,
-                     mem if mem is not None else mem_stats(compiled))
+    summ = summarize(program, mem)
     if mode:
         findings = audit_findings(summ, label)
         if findings:
@@ -200,5 +217,13 @@ def maybe_audit(compiled, label: str = "",
             if pmode >= 2:
                 from mxtpu.base import MXNetError
                 raise MXNetError(msg + " (MXTPU_PREC_AUDIT=2)")
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+    if mmode:
+        mfindings = mem_audit_findings(mem, label)
+        if mfindings:
+            msg = "memory audit: " + "; ".join(mfindings)
+            if mmode >= 2:
+                from mxtpu.base import MXNetError
+                raise MXNetError(msg + " (MXTPU_MEM_AUDIT=2)")
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
     return summ

@@ -209,6 +209,22 @@ register("MXTPU_PREC_AUDIT", "", "str",
          "checks against contracts/prec/ live in `python -m "
          "tools.mxprec`.", "guards")
 
+register("MXTPU_MEM_AUDIT", "", "str",
+         "Memory audit (mxtpu.analysis.memflow) of every program "
+         "TrainStep / serving ModelRunner / GenerateRunner compiles: "
+         "`1` warn when the program's peak HBM per device (temp + "
+         "argument bytes) exceeds the device-class budget; `2` "
+         "raise; unset/`0` = off with zero overhead.  Ledger checks "
+         "against contracts/mem/ live in `python -m tools.mxmem`.",
+         "guards")
+
+register("MXTPU_MEM_BUDGET", 0, "int",
+         "Per-device HBM byte budget the MXTPU_MEM_AUDIT runtime "
+         "check enforces.  `0` (default) = use the default device "
+         "class from contracts/mem/budgets.json; any other value "
+         "overrides the limit in bytes (tests and constrained "
+         "deploys).", "guards")
+
 # -- observability (mxtpu.obs) -----------------------------------------
 register("MXTPU_OBS", True, "bool",
          "Unified observability layer (mxtpu.obs): metrics registry, "

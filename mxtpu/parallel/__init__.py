@@ -263,25 +263,11 @@ def plan_zero_buckets(sigs, dp: int, stack_axis_only: bool = False):
 
 def _mem_stats(compiled):
     """``memory_analysis()`` of a compiled program as a plain dict
-    (None when the backend doesn't report).  ``hbm_peak`` is
-    temp + argument bytes — the resident high-water the program needs
-    beyond its outputs."""
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:
-        return None
-    if ma is None:
-        return None
-    out = {}
-    for k in ("argument_size_in_bytes", "output_size_in_bytes",
-              "temp_size_in_bytes", "alias_size_in_bytes",
-              "generated_code_size_in_bytes"):
-        v = getattr(ma, k, None)
-        if v is not None:
-            out[k] = int(v)
-    out["hbm_peak"] = (out.get("temp_size_in_bytes", 0) +
-                       out.get("argument_size_in_bytes", 0))
-    return out
+    (None when the backend doesn't report) — delegates to the ONE
+    memory analyzer, :func:`mxtpu.analysis.memflow.mem_stats`, which
+    owns the ``hbm_peak`` = temp + argument convention."""
+    from mxtpu.analysis import memflow
+    return memflow.mem_stats(compiled)
 
 
 class TrainStep:
@@ -1367,6 +1353,20 @@ class TrainStep:
         mem["opt_state_bytes"] = self.opt_state_bytes()
         return mem
 
+    def memory_summary(self, x, y):
+        """The sanctioned memory view (``mxtpu.analysis.memflow``) of
+        the one-step program for this batch signature: peak HBM per
+        device decomposed into params / optimizer state /
+        activations+temps / collectives scratch / donated bytes, the
+        ZeRO shard oracle when a dp>1 mesh is active, and any memory
+        hazard findings — what tests and operators read instead of
+        raw ``memory_analysis()`` grepping (mxlint ``mem-hygiene``)."""
+        from mxtpu.analysis import memflow
+        record = memflow.train_step_record(self, x, y)
+        budgets = memflow.load_budgets(
+            memflow.REPO_ROOT / "contracts")
+        return memflow.summary_view(record, budgets)
+
     def hlo_text(self, x, y):
         """Compiled HLO of the one-step program for this batch
         signature.  Tests should prefer :meth:`program_summary` —
@@ -1439,14 +1439,8 @@ class TrainStep:
             raise MXNetError(
                 "opt_state_bytes before parameter collection — run a "
                 "step (or _collect) first")
-        total = 0
-        for leaf in jax.tree_util.tree_leaves(self._opt_state):
-            shards = getattr(leaf, "addressable_shards", None)
-            if shards:
-                total += shards[0].data.nbytes
-            else:
-                total += int(getattr(leaf, "nbytes", 0))
-        return total
+        from mxtpu.analysis import memflow
+        return memflow.opt_state_leaf_bytes(self._opt_state)
 
     # -- checkpoint/resume (SURVEY §5.4: preemption-safe from day one) --
     def _canonical_state(self):

@@ -201,6 +201,7 @@ class GenerateRunner:
                 "cache) triple of the incremental export")
         self._input_names = tuple(input_names)
         kv_spec = tuple(int(d) for d in kv_spec)
+        self.kv_spec = kv_spec  # the declared cache geometry mxmem audits
         if len(kv_spec) != 6 or kv_spec[1] != 2:
             raise MXNetError(
                 "generate: kv_spec must be (num_layers, 2, lanes, "
@@ -228,8 +229,11 @@ class GenerateRunner:
         self.batch_buckets = batch_ladder(self.max_lanes)
         self._device = device if device is not None else jax.devices()[0]
         if donate is None:
-            donate = knobs.get("MXTPU_SERVING_DONATE") and \
-                jax.default_backend() != "cpu"  # cpu: donation no-ops
+            donate = knobs.get("MXTPU_SERVING_DONATE")
+        # _donate records the INTENT (what mxmem's donation-missed
+        # rule audits); the CPU backend, where XLA drops donation,
+        # is gated at the jit site in _entry so compiled programs
+        # stay byte-identical there.
         self._donate = bool(donate)  # mxlint: disable=host-sync
 
         # -- one weight upload shared by prefill AND decode ------------
@@ -559,11 +563,16 @@ class GenerateRunner:
             if compiled is None:
                 fn = self._prefill_pure() if kind == "prefill" \
                     else self._decode_pure()
+                # donation applied only where XLA honors it; on cpu
+                # it is a silent no-op, so skipping it keeps that
+                # backend's programs byte-identical
+                apply_donate = (self._donate and
+                                jax.default_backend() != "cpu")
                 with profiler.Task(f"generate:compile:{kind}"
                                    f"{bucket[1]}"):
                     jitted = jax.jit(
                         fn, donate_argnums=(kv_argnum,)
-                        if self._donate else ())
+                        if apply_donate else ())
                     compiled = jitted.lower(
                         *in_structs, self._param_structs).compile()
                 analysis.maybe_audit(compiled,
@@ -673,6 +682,21 @@ class GenerateRunner:
         from mxtpu import analysis
         text, mem = self.program_artifact(bucket)
         return analysis.summarize(text, mem)
+
+    def memory_summary(self, buckets: Optional[Sequence[Tuple]] = None):
+        """The sanctioned memory view (``mxtpu.analysis.memflow``) of
+        this runner's ladder (decode step + largest prefill rung by
+        default): per-program HBM decomposition with the KV slot
+        table attributed, the kv-geometry oracle, and any memory
+        hazard findings."""
+        from mxtpu.analysis import memflow
+        if buckets is None:
+            buckets = [self.default_bucket("prefill"),
+                       self.default_bucket("decode")]
+        record = memflow.generate_record(self, buckets=buckets)
+        budgets = memflow.load_budgets(
+            memflow.REPO_ROOT / "contracts")
+        return memflow.summary_view(record, budgets)
 
     def lowered_program_text(self, bucket: Optional[Tuple] = None
                              ) -> str:

@@ -132,8 +132,11 @@ class ModelRunner:
         self._pad_value = pad_value
         self._device = device if device is not None else jax.devices()[0]
         if donate is None:
-            donate = knobs.get("MXTPU_SERVING_DONATE") and \
-                jax.default_backend() != "cpu"  # cpu: donation is a no-op
+            donate = knobs.get("MXTPU_SERVING_DONATE")
+        # _donate records the INTENT (what mxmem's donation-missed
+        # rule audits); the CPU backend, where XLA drops donation,
+        # is gated at the jit site in _entry so compiled programs
+        # stay byte-identical there.
         self._donate = bool(donate)  # mxlint: disable=host-sync
 
         # -- one weight upload, shared by every bucket executable ------
@@ -543,9 +546,14 @@ class ModelRunner:
             if compiled is None:
                 with profiler.Task(f"serving:compile:b{batch}"
                                    f"{'' if seq is None else f's{seq}'}"):
+                    # donation applied only where XLA honors it; on
+                    # cpu it is a silent no-op, so skipping it keeps
+                    # that backend's programs byte-identical
+                    apply_donate = (self._donate and
+                                    jax.default_backend() != "cpu")
                     jitted = jax.jit(
                         self._pure_fn(),
-                        donate_argnums=(0,) if self._donate else ())
+                        donate_argnums=(0,) if apply_donate else ())
                     compiled = jitted.lower(in_structs,
                                             self._param_structs).compile()
                 # MXTPU_HLO_AUDIT: static hygiene pass over every
@@ -724,6 +732,21 @@ class ModelRunner:
         from mxtpu import analysis
         text, mem = self.program_artifact(bucket)
         return analysis.summarize(text, mem)
+
+    def memory_summary(self, buckets: Optional[Sequence[Tuple]] = None):
+        """The sanctioned memory view (``mxtpu.analysis.memflow``) of
+        this runner's bucket ladder (largest bucket by default):
+        per-program HBM decomposition with weights attributed, plus
+        any memory hazard findings — what tests and operators read
+        instead of raw ``memory_analysis()`` grepping (mxlint
+        ``mem-hygiene``)."""
+        from mxtpu.analysis import memflow
+        if buckets is None:
+            buckets = [self.buckets()[-1]]
+        record = memflow.runner_record(self, buckets=buckets)
+        budgets = memflow.load_budgets(
+            memflow.REPO_ROOT / "contracts")
+        return memflow.summary_view(record, budgets)
 
     def lowered_program_text(self, bucket: Tuple) -> str:
         """PRE-optimization HLO (with source metadata) of one

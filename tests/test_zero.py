@@ -149,16 +149,19 @@ def test_zero_opt_state_bytes_sharded(_data, monkeypatch):
                        snap, monkeypatch, steps=1)
     _, _, rstep = _run("adam", {"learning_rate": 1e-3}, False, x, y,
                        snap, monkeypatch, steps=1)
-    z, r = zstep.opt_state_bytes(), rstep.opt_state_bytes()
+    zsum = zstep.memory_summary(x, y)
+    rsum = rstep.memory_summary(x, y)
+    z = zsum["zero"]["opt_state_bytes"]
+    r = rsum["zero"]["opt_state_bytes"]
     assert z <= r / 8 * 1.15, (z, r)
     # adam: two f32 leaves (m, v) per bucket, each 1/8 of the padded
-    # stacked array
-    planned = sum(2 * b["padded_bytes"] // 8 for b in
-                  zstep._zero_buckets)
-    assert z == planned, (z, planned)
-    mem = zstep.memory_analysis(x, y)
-    assert mem["opt_state_bytes"] == z
-    assert mem.get("hbm_peak", 0) >= 0
+    # stacked array — the plan_zero_buckets oracle memflow carries
+    assert z == zsum["zero"]["planned_shard_bytes"], zsum["zero"]
+    assert not [h for h in zsum["hazards"]
+                if h["rule"] == "zero-replication"], zsum["hazards"]
+    dec = zsum["programs"]["train_step"]
+    assert dec["opt_state"] == z
+    assert dec["peak_hbm"] >= 0
 
 
 def test_zero_bucket_axis_geometry():

@@ -18,6 +18,9 @@ contracts.
 * ``python -m tools.mxprec --check`` (pre-optimization dtype flow vs
   the committed ``contracts/prec/`` ledgers + the derived
   ``contracts/amp_policy.json``), then
+* ``python -m tools.mxmem --check`` (per-device HBM decomposition and
+  memory hazard rules vs the committed ``contracts/mem/`` ledgers +
+  the declarative device-class budgets), then
 * ``python -m mxtpu.amp --self-check`` (the AMP pass's three
   contracts: policy parse/classes, an autocast round-trip on the
   selftest program — bf16 edges, zero hazards, no leak outside the
@@ -49,6 +52,7 @@ STAGES = (
     ("cache-self-check", ("-m", "mxtpu.cache", "--self-check"), False),
     ("mxrace", ("-m", "tools.mxrace", "--check"), True),
     ("mxprec", ("-m", "tools.mxprec", "--check"), True),
+    ("mxmem", ("-m", "tools.mxmem", "--check"), True),
     ("amp-self-check", ("-m", "mxtpu.amp", "--self-check"), False),
     ("quant-self-check", ("-m", "mxtpu.quant", "--self-check"), False),
 )

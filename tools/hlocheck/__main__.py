@@ -86,9 +86,12 @@ def main(argv=None) -> int:
         # check everything that has a committed lockfile AND is still
         # a registered target; a contract whose target vanished is an
         # error, not silence.  contracts/ is shared with mxrace
-        # (lockorder.json, checked by `python -m tools.mxrace`) and
+        # (lockorder.json, checked by `python -m tools.mxrace`),
         # mxprec (amp_policy.json + quant_policy.json + prec/, checked
-        # by `python -m tools.mxprec`), not here.
+        # by `python -m tools.mxprec`), and mxmem (mem/ — the memory
+        # ledgers + budgets.json, checked by `python -m tools.mxmem`);
+        # the glob below only sees top-level files, so the prec/ and
+        # mem/ subdirectories are naturally out of scope here.
         foreign = {"lockorder", "amp_policy", "quant_policy"}
         names = sorted(p.stem for p in directory.glob("*.json")
                        if p.stem not in foreign)

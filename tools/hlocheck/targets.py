@@ -36,6 +36,14 @@ PrecBuilder = Callable[[], Dict]
 
 PREC_TARGETS: Dict[str, PrecBuilder] = {}
 
+# mxmem (ISSUE 20) rides the same fixtures a third time: each mem
+# builder returns a memflow *record* (programs + byte attributions +
+# the zero/kv oracles) that ``tools.mxmem`` turns into the committed
+# ``contracts/mem/<target>.json`` ledger.
+MemBuilder = Callable[[], Dict]
+
+MEM_TARGETS: Dict[str, MemBuilder] = {}
+
 
 def register_target(name: str):
     def deco(fn: Builder) -> Builder:
@@ -47,6 +55,13 @@ def register_target(name: str):
 def register_prec(name: str):
     def deco(fn: PrecBuilder) -> PrecBuilder:
         PREC_TARGETS[name] = fn
+        return fn
+    return deco
+
+
+def register_mem(name: str):
+    def deco(fn: MemBuilder) -> MemBuilder:
+        MEM_TARGETS[name] = fn
         return fn
     return deco
 
@@ -64,6 +79,14 @@ def build_prec(name: str) -> Dict:
     substrate) — lowering only, never a compile, so the sweep stays
     cheap on CPU."""
     return PREC_TARGETS[name]()
+
+
+def build_mem(name: str) -> Dict:
+    """Memory record for ``name`` (mxmem's substrate): compiled
+    ``memory_analysis()`` stats plus the byte attributions and
+    geometry oracles ``mxtpu.analysis.memflow`` decomposes into the
+    committed ledger."""
+    return MEM_TARGETS[name]()
 
 
 # ----------------------------------------------------------------------
@@ -556,3 +579,86 @@ def selftest_amp_prec() -> Dict:
     _, a, b = _selftest_parts()
     return {"programs": {"eigh_matmul": lowered_text(f, a, b)},
             "optimizer": None, "param_sigs": None}
+
+
+# ----------------------------------------------------------------------
+# mxmem records (ISSUE 20) — same fixtures, byte-attribution view
+# ----------------------------------------------------------------------
+@register_mem("bert_replicated")
+def bert_replicated_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    step, x, y = _bert_parts(zero=0)
+    return memflow.train_step_record(step, x, y, "bert_replicated")
+
+
+@register_mem("bert_zero")
+def bert_zero_mem() -> Dict:
+    """The ZeRO-1 ledger: measured per-device optimizer-state bytes
+    against the ``plan_zero_buckets`` shard geometry — the committed
+    proof of the dp8 opt-state saving (BASELINE.md r7's 2784.6 ->
+    348.1 MiB/device at bench scale)."""
+    from mxtpu.analysis import memflow
+    step, x, y = _bert_parts(zero=1)
+    return memflow.train_step_record(step, x, y, "bert_zero",
+                                     zero_expected=True)
+
+
+@register_mem("bert_zero_amp")
+def bert_zero_amp_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    step, x, y = _bert_parts(zero=1, amp=True)
+    return memflow.train_step_record(step, x, y, "bert_zero_amp",
+                                     zero_expected=True)
+
+
+@register_mem("transformer")
+def transformer_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    step, x, y = _transformer_parts()
+    return memflow.train_step_record(step, x, y, "transformer")
+
+
+@register_mem("resnet18")
+def resnet18_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    step, x, y = _resnet_parts()
+    return memflow.train_step_record(step, x, y, "resnet18")
+
+
+@register_mem("serving_bert")
+def serving_bert_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    return memflow.runner_record(_serving_runner(), "serving_bert")
+
+
+@register_mem("serving_bert_int8")
+def serving_bert_int8_mem() -> Dict:
+    from mxtpu.analysis import memflow
+    return memflow.runner_record(_serving_runner(quant=True),
+                                 "serving_bert_int8")
+
+
+@register_mem("generate_decode")
+def generate_decode_mem() -> Dict:
+    """The KV-table ledger: per-program decomposition with the slot
+    table attributed, plus the kv section whose ``table_bytes ==
+    expected_bytes`` equality (declared ``kv_cache_spec`` geometry +
+    1 scratch slot) is the committed anti-overcommit proof."""
+    from mxtpu.analysis import memflow
+    return memflow.generate_record(_generate_runner(),
+                                   "generate_decode")
+
+
+@register_mem("selftest")
+def selftest_mem() -> Dict:
+    """The cheap end-to-end CLI specimen (mirrors hlocheck/mxprec):
+    one compiled program, no params/opt attribution — pure
+    activations+temps decomposition."""
+    from mxtpu.analysis import compiled_artifact, memflow
+    f, a, b = _selftest_parts()
+    text, mem = compiled_artifact(f, a, b)
+    return {"target": "selftest",
+            "programs": {"eigh_matmul": {
+                "mem": mem or {},
+                "collective_scratch":
+                    memflow.collective_scratch_bytes(text)}}}

@@ -545,6 +545,40 @@ class HloRawAssert(Rule):
         return out
 
 
+class MemHygiene(Rule):
+    """Tests must not grep memory facts raw: ``.memory_analysis()``
+    and ``.opt_state_bytes()`` calls in ``tests/`` fragment the
+    byte-accounting story ISSUE 20 consolidated into
+    ``mxtpu.analysis.memflow`` — assert on the sanctioned
+    ``memory_summary()`` view (TrainStep / ModelRunner /
+    GenerateRunner) or ``last_memory_analysis()`` instead, so the
+    ``hbm_peak`` convention and the decomposition stay on one
+    analyzer.  Suppress a deliberate exception with
+    ``# mxlint: disable=mem-hygiene``."""
+
+    name = "mem-hygiene"
+    _MEM_ATTRS = ("memory_analysis", "opt_state_bytes")
+
+    def applies(self, ctx: FileCtx) -> bool:
+        return ctx.rel.startswith("tests/")
+
+    def check(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._MEM_ATTRS:
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    f"raw `.{attr}()` in a test — assert on "
+                    f"`memory_summary()` (or "
+                    f"`last_memory_analysis()`) so byte accounting "
+                    f"stays on the one memflow analyzer"))
+        return out
+
+
 class ObsRegistry(Rule):
     """Metrics go through the ``mxtpu.obs`` registry, correctly named
     (ISSUE 8).  Three checks:
@@ -921,8 +955,9 @@ def file_rules() -> List[Rule]:
     return [RetraceImpureCall(), RetraceTracedBranch(),
             RetraceInlineJit(), RetraceConcretize(), HostSync(),
             LockDiscipline(), KnobRawEnv(), KnobUnregistered(),
-            HloRawAssert(), ObsRegistry(), ThreadHygiene(),
-            DtypeHygiene(), NoAdhocBf16(), RawDeserialize()]
+            HloRawAssert(), MemHygiene(), ObsRegistry(),
+            ThreadHygiene(), DtypeHygiene(), NoAdhocBf16(),
+            RawDeserialize()]
 
 
 def repo_checks(ctxs: Sequence[FileCtx], root: Path) -> List[Finding]:

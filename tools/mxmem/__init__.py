@@ -1,0 +1,9 @@
+"""tools.mxmem — static memory-footprint analysis with committed HBM
+ledgers (ISSUE 20).
+
+The analyzer lives in :mod:`mxtpu.analysis.memflow`; this package is
+the CLI shell (``python -m tools.mxmem``) that builds per-target
+memory records from the shared hlocheck fixtures
+(``tools.hlocheck.targets.MEM_TARGETS``) and round-trips them against
+``contracts/mem/<target>.json``.
+"""

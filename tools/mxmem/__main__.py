@@ -1,0 +1,208 @@
+"""mxmem CLI.
+
+Exit codes (the contract tests/test_mem.py pins, mirroring mxlint /
+hlocheck / mxprec / mxrace):
+
+* 0 — every checked ledger matches; budgets + README table fresh;
+* 1 — memory-ledger drift (or missing ledger in --check mode);
+* 2 — usage / internal error (unknown target, unreadable ledger,
+      orphaned ledger, empty baseline).
+
+``--update`` re-compiles the named targets (default: all) on the CPU
+backend and rewrites ``contracts/mem/<target>.json``; it also
+bootstraps ``contracts/mem/budgets.json`` (the declarative per-
+device-class HBM budgets) when — and only when — that file is
+missing: budgets are hand-edited policy, never regenerated.  The
+README HBM-decomposition table drift check rides only on a full
+default check (no explicit targets), so a single-target round trip
+stays cheap for tier-1 tests.  Compilation happens on the CPU backend
+with the 8-virtual-device topology the test suite uses, so ledgers
+are reproducible on any box.
+"""
+from __future__ import annotations
+
+import os
+
+# pin the environment BEFORE jax (imported via mxtpu) loads: memory
+# ledgers are CPU-backend artifacts by definition
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse   # noqa: E402
+import json       # noqa: E402
+import sys        # noqa: E402
+import time       # noqa: E402
+from pathlib import Path  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxmem",
+        description="Static memory-footprint analysis over the "
+                    "compiled hlocheck targets: peak HBM per device "
+                    "decomposed into params / optimizer state / "
+                    "activations / collectives scratch / KV table, "
+                    "checked against committed memory ledgers "
+                    "(contracts/mem/) and the declarative device-"
+                    "class budgets (contracts/mem/budgets.json).")
+    ap.add_argument("targets", nargs="*",
+                    help="targets to process (default: every "
+                         "committed ledger for --check, every "
+                         "registered target for --update)")
+    ap.add_argument("--check", action="store_true",
+                    help="counts-only output; exit 1 on drift (CI "
+                         "mode — this is also the default behaviour)")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate ledgers for the named targets "
+                         "(bootstraps budgets.json if missing) and "
+                         "exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit results as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets and exit")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="regenerate the README memory table from "
+                         "the COMMITTED ledgers (no compiling) and "
+                         "exit")
+    ap.add_argument("--contracts-dir", type=Path, default=None,
+                    help="lockfile directory (default: contracts/)")
+    args = ap.parse_args(argv)
+
+    from mxtpu.analysis import contracts as C
+    from mxtpu.analysis import memflow as M
+    from tools.hlocheck import targets as T
+
+    directory = args.contracts_dir or C.CONTRACTS_DIR
+
+    if args.list:
+        for name in sorted(T.MEM_TARGETS):
+            state = "ledger" if M.ledger_path(
+                name, directory).exists() else "NO LEDGER"
+            print(f"{name:20s} [{state}]")
+        return 0
+
+    if args.fix_readme:
+        ledgers = M.committed_ledgers(directory)
+        if not ledgers:
+            print(f"mxmem: no ledgers in {M.mem_dir(directory)}"
+                  f" — run --update first", file=sys.stderr)
+            return 2
+        changed = M.fix_readme(M.REPO_ROOT, ledgers)
+        print("mxmem: README memory table "
+              + ("rewritten" if changed else "already fresh"))
+        return 0
+
+    if args.targets:
+        unknown = [t for t in args.targets
+                   if t not in T.MEM_TARGETS]
+        if unknown:
+            print(f"mxmem: unknown target(s): "
+                  f"{', '.join(unknown)} (see --list)",
+                  file=sys.stderr)
+            return 2
+        names = list(args.targets)
+    elif args.update:
+        names = sorted(T.MEM_TARGETS)
+    else:
+        # check everything that has a committed ledger AND is still a
+        # registered target; a ledger whose target vanished is an
+        # error, not silence
+        names = sorted(p.stem for p in
+                       M.mem_dir(directory).glob("*.json")
+                       if p.stem != M.BUDGETS_NAME) \
+            if M.mem_dir(directory).is_dir() else []
+        orphans = [n for n in names if n not in T.MEM_TARGETS]
+        if orphans:
+            print(f"mxmem: ledger(s) without a registered target: "
+                  f"{', '.join(orphans)}", file=sys.stderr)
+            return 2
+        if not names:
+            print(f"mxmem: no ledgers in "
+                  f"{M.mem_dir(directory)} — run --update first",
+                  file=sys.stderr)
+            return 2
+
+    # budgets: hand-edited policy.  --update bootstraps a missing
+    # file (so a tmp-dir round trip is self-contained); --check
+    # treats an unreadable file as an internal error
+    bpath = M.budgets_path(directory)
+    if args.update and not bpath.exists():
+        M.save_budgets(dict(M.DEFAULT_BUDGETS), directory)
+        if not args.as_json:
+            print(f"mxmem: bootstrapped {bpath}")
+    try:
+        budgets = M.load_budgets(directory)
+    except (ValueError, OSError) as e:
+        print(f"mxmem: cannot read {bpath}: {e}", file=sys.stderr)
+        return 2
+
+    # README drift rides only on a FULL sweep (it is a whole-tree
+    # artifact); explicit-target runs stay cheap
+    full = not args.targets
+
+    t0 = time.perf_counter()
+    all_violations: list = []
+    results = {}
+    for name in names:
+        t1 = time.perf_counter()
+        record = T.build_mem(name)
+        ledger = M.build_ledger(record, budgets)
+        dt = time.perf_counter() - t1
+        if args.update:
+            path = M.save_ledger(ledger, directory)
+            results[name] = {"updated": str(path),
+                             "programs": sorted(ledger["programs"]),
+                             "hazards": len(ledger["hazards"]),
+                             "seconds": round(dt, 1)}
+            if not args.as_json:
+                print(f"mxmem: wrote {path} "
+                      f"({len(ledger['programs'])} program(s), "
+                      f"{len(ledger['hazards'])} hazard(s), "
+                      f"{dt:.1f}s)")
+            continue
+        try:
+            committed = M.load_ledger(name, directory)
+        except FileNotFoundError:
+            all_violations.append(
+                f"{name}: no ledger "
+                f"{M.ledger_path(name, directory)} — run "
+                f"--update {name}")
+            continue
+        except (ValueError, OSError) as e:
+            print(f"mxmem: cannot read ledger for {name}: {e}",
+                  file=sys.stderr)
+            return 2
+        drift = M.compare_ledgers(committed, ledger)
+        all_violations += [f"{name}: {d}" for d in drift]
+        results[name] = {"drift": drift, "seconds": round(dt, 1)}
+        if not args.as_json and not args.check:
+            print(f"mxmem: {name}: {len(drift)} drift(s) "
+                  f"({dt:.1f}s)")
+
+    if args.update:
+        if args.as_json:
+            print(json.dumps(results, indent=1))
+        return 0
+
+    if full:
+        all_violations += M.readme_drift(
+            M.REPO_ROOT, M.committed_ledgers(directory))
+
+    dt = time.perf_counter() - t0
+    if args.as_json:
+        print(json.dumps({"results": results,
+                          "violations": all_violations,
+                          "seconds": round(dt, 1)}, indent=1))
+    else:
+        for v in all_violations:
+            print("  " + v)
+        print(f"mxmem: {len(names)} target(s), "
+              f"{len(all_violations)} violation(s) ({dt:.1f}s)")
+    return 1 if all_violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
